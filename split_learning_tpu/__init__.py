@@ -1,0 +1,23 @@
+"""split_learning_tpu — a TPU-native split/federated learning framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capability surface of the
+reference `eliasandronicou/split-learning-k8s` (see SURVEY.md):
+
+- models split at a cut layer into client/server stages
+  (reference: ``src/model_def.py``),
+- a swappable transport carrying cut-layer activations down and gradients
+  back (reference: pickle-over-HTTP in ``src/client_part.py:117-131`` and
+  ``src/server_part.py:25-58``) — here: in-process, HTTP (safe codec, no
+  pickle), and fused in-XLA ICI collectives,
+- split and federated training modes (reference: ``src/client_part.py:200-209``),
+- experiment tracking (reference: MLflow, ``src/server_part.py:18-23``),
+- dataset caching (reference: S3, ``src/client_part.py:20-95``),
+
+re-expressed TPU-first: pure functional stages, pjit/shard_map over a device
+mesh, `ppermute`/`psum` collectives over ICI instead of pickled POSTs, GPipe
+microbatching, and Pallas kernels on the hot path.
+"""
+
+from split_learning_tpu.version import __version__
+
+__all__ = ["__version__"]

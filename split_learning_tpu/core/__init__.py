@@ -1,0 +1,4 @@
+from split_learning_tpu.core.stage import SplitPlan, Stage, from_flax
+from split_learning_tpu.core.losses import accuracy, cross_entropy
+
+__all__ = ["SplitPlan", "Stage", "from_flax", "cross_entropy", "accuracy"]

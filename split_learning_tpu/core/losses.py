@@ -1,0 +1,21 @@
+"""Loss functions.
+
+The reference uses ``nn.CrossEntropyLoss`` on logits (``src/server_part.py:16,49``
+server-side in split mode; ``src/client_part.py:18,158`` client-side in
+federated mode). Mean reduction over the batch, integer class labels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean softmax cross-entropy with integer labels (torch CE semantics)."""
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+
+def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))

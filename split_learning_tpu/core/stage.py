@@ -1,0 +1,115 @@
+"""Stage abstraction — the core of the split-model design.
+
+The reference hard-codes exactly two halves (`ModelPartA` / `ModelPartB`,
+``src/model_def.py:5-28``) plus a hand-fused `FullModel`
+(``src/model_def.py:31-46``) whose layers must be kept manually in sync.
+
+Here a model *is* an ordered sequence of pure stages; "full model" is the
+composition of the stages, so split-vs-monolithic equivalence is
+by-construction (and tested, see tests/test_equivalence.py). Stages are
+pure functions of (params, x) — no module-global mutable state (the
+reference's server mutates a module-global model inside async handlers,
+``src/server_part.py:14-15,47-52``, a data race with >1 client; purity
+removes that class of bug, SURVEY.md §5 "Race detection").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Any  # a pytree of arrays
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class Stage:
+    """One pure, differentiable segment of a split model.
+
+    ``apply(params, x) -> y`` must be jit-traceable (static shapes, no
+    Python side effects) so that a stage can live inside a pjit'd pipeline
+    or be jitted standalone on the client/server.
+    """
+
+    name: str
+    init: Callable[[jax.Array, Array], Params]  # (rng, sample_input) -> params
+    apply: Callable[[Params, Array], Array]     # (params, x) -> y
+
+    def out_spec(self, params: Params, x_spec: jax.ShapeDtypeStruct) -> jax.ShapeDtypeStruct:
+        """Shape-infer this stage's output without running it."""
+        out = jax.eval_shape(self.apply, params, x_spec)
+        return jax.ShapeDtypeStruct(out.shape, out.dtype)
+
+
+def from_flax(name: str, module: Any) -> Stage:
+    """Wrap a flax.linen Module as a Stage."""
+    return Stage(
+        name=name,
+        init=lambda rng, sample: module.init(rng, sample),
+        apply=lambda params, x: module.apply(params, x),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class SplitPlan:
+    """An ordered pipeline of stages plus the ownership split.
+
+    ``boundaries[i]`` is the party owning stage i ("client" or "server").
+    The classic 2-party split (reference) is ("client", "server"); the
+    U-shaped split (BASELINE.md config 5) is ("client", "server", "client")
+    — the label-holding head stays on the client.
+    """
+
+    stages: Tuple[Stage, ...]
+    owners: Tuple[str, ...]
+
+    def __post_init__(self) -> None:
+        if len(self.stages) != len(self.owners):
+            raise ValueError("stages and owners must have equal length")
+        for o in self.owners:
+            if o not in ("client", "server"):
+                raise ValueError(f"unknown owner {o!r}")
+
+    @property
+    def num_stages(self) -> int:
+        return len(self.stages)
+
+    def stages_of(self, owner: str) -> Tuple[int, ...]:
+        return tuple(i for i, o in enumerate(self.owners) if o == owner)
+
+    def init(self, rng: jax.Array, sample: Array) -> Tuple[Params, ...]:
+        """Initialize every stage, threading a real forward through (once)."""
+        params = []
+        x = jnp.asarray(sample)
+        for stage in self.stages:
+            rng, sub = jax.random.split(rng)
+            p = stage.init(sub, x)
+            params.append(p)
+            x = stage.apply(p, x)
+        return tuple(params)
+
+    def apply(self, params: Sequence[Params], x: Array) -> Array:
+        """Monolithic forward = composition of all stages.
+
+        This is the `FullModel` equivalent (``src/model_def.py:31-46``)
+        except it can never drift from the split: same stage functions,
+        same params.
+        """
+        if len(params) != self.num_stages:
+            raise ValueError(
+                f"expected {self.num_stages} per-stage param trees, got {len(params)}"
+            )
+        for stage, p in zip(self.stages, params):
+            x = stage.apply(p, x)
+        return x
+
+    def apply_range(self, params: Sequence[Params], x: Array,
+                    start: int, stop: Optional[int] = None) -> Array:
+        """Run stages [start, stop) — one party's contiguous span."""
+        stop = self.num_stages if stop is None else stop
+        for i in range(start, stop):
+            x = self.stages[i].apply(params[i], x)
+        return x

@@ -1,0 +1,3 @@
+from split_learning_tpu.models.factory import get_model, get_plan, register_model
+
+__all__ = ["get_model", "get_plan", "register_model"]

@@ -1,0 +1,113 @@
+"""The split MNIST CNN — TPU-native re-expression of the reference model.
+
+Reference (PyTorch, NCHW):
+- ``ModelPartA``: Conv2d(1→32, k3, s1) + ReLU   (``src/model_def.py:5-12``)
+- ``ModelPartB``: Conv2d(32→64, k3) + ReLU → MaxPool2d(2) → Flatten →
+  Linear(9216, 10)                               (``src/model_def.py:15-28``)
+- ``FullModel``: the two fused                   (``src/model_def.py:31-46``)
+
+Here (JAX/flax, **NHWC** — the TPU-native layout; convs map onto the MXU
+without transposes): same arithmetic, same parameter counts (PartA = 320,
+PartB = 110,666, full = 110,986 — SURVEY.md §2 derived facts), cut-layer
+tensor ``[B, 26, 26, 32]`` (the reference's ``[B, 32, 26, 26]`` in NHWC).
+The U-shaped variant moves the final Dense layer into a third, client-owned
+head stage (BASELINE.md config 5) so labels never leave the client.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from split_learning_tpu.core.stage import SplitPlan, Stage, from_flax
+
+
+class CNNPartA(nn.Module):
+    """Client bottom stage: Conv(1→32, 3x3, VALID) + ReLU.
+
+    [B, 28, 28, 1] → [B, 26, 26, 32]; 320 params.
+    """
+
+    features: int = 32
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.features, (3, 3), padding="VALID",
+                    dtype=self.dtype, name="conv1")(x)
+        return nn.relu(x)
+
+
+class CNNPartB(nn.Module):
+    """Server top stage: Conv(32→64) + ReLU → MaxPool(2) → Flatten → Dense(10).
+
+    [B, 26, 26, 32] → [B, 10]; 110,666 params (18,496 conv + 92,170 dense).
+    """
+
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(64, (3, 3), padding="VALID", dtype=self.dtype, name="conv2")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))  # [B, 12*12*64] = [B, 9216]
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="fc")(x)
+        return x
+
+
+class CNNTrunkB(nn.Module):
+    """Server middle stage for the U-shaped split: PartB minus the head.
+
+    [B, 26, 26, 32] → [B, 9216]; 18,496 params.
+    """
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(64, (3, 3), padding="VALID", dtype=self.dtype, name="conv2")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        return x.reshape((x.shape[0], -1))
+
+
+class CNNHeadC(nn.Module):
+    """Client head stage for the U-shaped split: Dense(9216→10); 92,170 params."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(self.num_classes, dtype=self.dtype, name="fc")(x)
+
+
+def split_cnn_plan(dtype: Any = jnp.float32) -> SplitPlan:
+    """The classic 2-party split: client(A) → server(B).
+
+    Mirrors the reference's split mode (``src/model_def.py:49-67``)."""
+    return SplitPlan(
+        stages=(
+            from_flax("part_a", CNNPartA(dtype=dtype)),
+            from_flax("part_b", CNNPartB(dtype=dtype)),
+        ),
+        owners=("client", "server"),
+    )
+
+
+def u_split_cnn_plan(dtype: Any = jnp.float32) -> SplitPlan:
+    """U-shaped 3-stage split: client(A) → server(trunk) → client(head).
+
+    Labels and logits stay with the client (BASELINE.md config 5)."""
+    return SplitPlan(
+        stages=(
+            from_flax("part_a", CNNPartA(dtype=dtype)),
+            from_flax("trunk_b", CNNTrunkB(dtype=dtype)),
+            from_flax("head_c", CNNHeadC(dtype=dtype)),
+        ),
+        owners=("client", "server", "client"),
+    )

@@ -1,0 +1,78 @@
+"""Model factory — role+mode dispatch, mirroring the reference's `get_model`.
+
+Reference (``src/model_def.py:49-71``): federated → `FullModel` for both
+roles; split → `ModelPartA` for client / `ModelPartB` for server; unknown
+mode → ``ValueError``. Here the factory returns a :class:`SplitPlan` plus
+the stage indices the role owns — the "model" is always the plan; a party
+just owns a subset of stages.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax.numpy as jnp
+
+from split_learning_tpu.core.stage import SplitPlan
+from split_learning_tpu.models.cnn import split_cnn_plan, u_split_cnn_plan
+
+_FAMILIES = {}
+
+
+def register_model(name: str):
+    def deco(fn):
+        _FAMILIES[name] = fn
+        return fn
+    return deco
+
+
+def _dtype_of(dtype: Any) -> Any:
+    if isinstance(dtype, str):
+        return jnp.dtype(dtype)
+    return dtype
+
+
+@register_model("split_cnn")
+def _split_cnn(mode: str, dtype: Any) -> SplitPlan:
+    if mode == "u_split":
+        return u_split_cnn_plan(dtype=dtype)
+    # both "split" and "federated" use the same 2-stage plan: federated mode
+    # trains the composition (the reference's FullModel, src/model_def.py:31-46)
+    return split_cnn_plan(dtype=dtype)
+
+
+@register_model("resnet18")
+def _resnet18(mode: str, dtype: Any) -> SplitPlan:
+    try:
+        from split_learning_tpu.models.resnet import resnet18_plan
+    except ImportError as exc:
+        raise ValueError("model family 'resnet18' is not available") from exc
+    return resnet18_plan(mode=mode, dtype=dtype)
+
+
+def get_plan(model: str = "split_cnn", mode: str = "split",
+             dtype: Any = jnp.float32) -> SplitPlan:
+    """Build the SplitPlan for a model family under a learning mode."""
+    if mode not in ("split", "federated", "u_split"):
+        # preserve the reference's ValueError contract (src/model_def.py:70-71)
+        raise ValueError(f"Unknown learning mode: {mode!r}")
+    if model not in _FAMILIES:
+        raise ValueError(
+            f"Unknown model family: {model!r} (have {sorted(_FAMILIES)})")
+    return _FAMILIES[model](mode, _dtype_of(dtype))
+
+
+def get_model(role: str, mode: str = "split", model: str = "split_cnn",
+              dtype: Any = jnp.float32) -> Tuple[SplitPlan, Tuple[int, ...]]:
+    """Reference-shaped entry point: (plan, indices of stages `role` owns).
+
+    Mirrors ``get_model(role)`` at ``src/model_def.py:49-71``:
+    - federated: both parties own/train the full composition,
+    - split/u_split: each party owns its side of the cut(s).
+    """
+    if role not in ("client", "server"):
+        raise ValueError(f"Unknown role: {role!r}")
+    plan = get_plan(model=model, mode=mode, dtype=dtype)
+    if mode == "federated":
+        return plan, tuple(range(plan.num_stages))
+    return plan, plan.stages_of(role)

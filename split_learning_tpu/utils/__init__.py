@@ -1,0 +1,3 @@
+from split_learning_tpu.utils.config import Config
+
+__all__ = ["Config"]

@@ -1,0 +1,40 @@
+"""Test harness: 8 virtual CPU devices replace multi-chip hardware.
+
+The reference uses k3d (Docker-in-Docker k8s) as its fake cluster
+(SURVEY.md §4); here the fake backend is XLA's host-platform device count —
+mesh/ppermute/psum tests run against 8 virtual CPU devices. Must be set
+before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def rng():
+    return jax.random.PRNGKey(0)
+
+
+@pytest.fixture()
+def mnist_batch(rng):
+    """A deterministic fake MNIST batch (reference batch size 64)."""
+    kx, ky = jax.random.split(rng)
+    x = jax.random.normal(kx, (64, 28, 28, 1), jnp.float32)
+    y = jax.random.randint(ky, (64,), 0, 10)
+    return x, y

@@ -1,0 +1,89 @@
+"""Stage math: shapes, parameter counts, factory dispatch (SURVEY.md §4 item 1).
+
+Derived facts from the reference (SURVEY.md §2): PartA = 320 params,
+PartB = 110,666, full = 110,986; cut tensor [64, 26, 26, 32] (NHWC).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from split_learning_tpu.models import get_model, get_plan
+from split_learning_tpu.models.cnn import split_cnn_plan, u_split_cnn_plan
+
+
+def n_params(tree):
+    return sum(x.size for x in jax.tree_util.tree_leaves(tree))
+
+
+def test_split_cnn_shapes_and_param_counts(rng, mnist_batch):
+    x, _ = mnist_batch
+    plan = split_cnn_plan()
+    params = plan.init(rng, x)
+
+    acts = plan.stages[0].apply(params[0], x)
+    assert acts.shape == (64, 26, 26, 32)  # cut-layer tensor, 5.28 MiB fp32
+    assert acts.dtype == jnp.float32
+
+    logits = plan.stages[1].apply(params[1], acts)
+    assert logits.shape == (64, 10)
+
+    assert n_params(params[0]) == 320
+    assert n_params(params[1]) == 110_666
+    assert n_params(params) == 110_986
+
+
+def test_u_split_preserves_total_params(rng, mnist_batch):
+    x, _ = mnist_batch
+    plan = u_split_cnn_plan()
+    params = plan.init(rng, x)
+    assert plan.num_stages == 3
+    assert plan.owners == ("client", "server", "client")
+    assert n_params(params) == 110_986
+    logits = plan.apply(params, x)
+    assert logits.shape == (64, 10)
+
+
+def test_composition_equals_stagewise(rng, mnist_batch):
+    """FullModel ≡ composition of stages, by construction (ref src/model_def.py:31-46)."""
+    x, _ = mnist_batch
+    plan = split_cnn_plan()
+    params = plan.init(rng, x)
+    full = plan.apply(params, x)
+    staged = plan.stages[1].apply(params[1], plan.stages[0].apply(params[0], x))
+    assert jnp.array_equal(full, staged)
+
+
+def test_factory_dispatch():
+    # mirrors get_model role/mode dispatch (ref src/model_def.py:49-71)
+    plan, owned = get_model("client", mode="split")
+    assert owned == (0,)
+    plan, owned = get_model("server", mode="split")
+    assert owned == (1,)
+    plan, owned = get_model("client", mode="federated")
+    assert owned == (0, 1)
+    plan, owned = get_model("client", mode="u_split")
+    assert owned == (0, 2)
+    plan, owned = get_model("server", mode="u_split")
+    assert owned == (1,)
+
+
+def test_factory_rejects_unknown_mode_and_role():
+    # ValueError contract (ref src/model_def.py:70-71, src/client_part.py:208-209)
+    with pytest.raises(ValueError):
+        get_model("client", mode="quantum")
+    with pytest.raises(ValueError):
+        get_model("supervisor", mode="split")
+    with pytest.raises(ValueError):
+        get_plan(model="not_a_model")
+
+
+def test_config_env_parsing(monkeypatch):
+    from split_learning_tpu.utils import Config
+    cfg = Config.from_env(env={"LEARNING_MODE": "federated", "SLT_BATCH_SIZE": "32"})
+    assert cfg.mode == "federated"
+    assert cfg.batch_size == 32
+    cfg2 = Config.from_env(env={}, mode="split", lr=0.1)
+    assert cfg2.lr == 0.1
+    with pytest.raises(ValueError):
+        Config.from_env(env={"LEARNING_MODE": "bogus"})
