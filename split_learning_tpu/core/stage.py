@@ -44,6 +44,24 @@ class Stage:
         return jax.ShapeDtypeStruct(out.shape, out.dtype)
 
 
+def stage_backward(stage: "Stage", params: Params, x: Array,
+                   g_out: Array) -> Params:
+    """Rematerialized backward through one stage: re-run the forward under
+    ``jax.vjp`` and pull the transported cotangent ``g_out`` through it.
+
+    This is the JAX form of the reference's manual tape splice
+    (``requires_grad_(True)`` at ``src/server_part.py:45`` +
+    ``activations.backward(grad)`` at ``src/client_part.py:132``): the
+    cotangent crosses the party boundary as data, and the local forward is
+    recomputed rather than stored — the TPU-idiomatic FLOPs-for-memory
+    trade, and it keeps each side independently jittable around the
+    host-side transport call.
+    """
+    _, vjp = jax.vjp(lambda p: stage.apply(p, x), params)
+    (g_params,) = vjp(g_out)
+    return g_params
+
+
 def from_flax(name: str, module: Any) -> Stage:
     """Wrap a flax.linen Module as a Stage."""
     return Stage(

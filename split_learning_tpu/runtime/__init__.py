@@ -1,0 +1,19 @@
+from split_learning_tpu.runtime.client import (
+    FailurePolicy,
+    FederatedClientTrainer,
+    SplitClientTrainer,
+    StepRecord,
+    USplitClientTrainer,
+)
+from split_learning_tpu.runtime.server import (
+    FedAvgAggregator,
+    ProtocolError,
+    ServerRuntime,
+)
+from split_learning_tpu.runtime.state import TrainState, apply_grads, make_state, sgd
+
+__all__ = [
+    "SplitClientTrainer", "USplitClientTrainer", "FederatedClientTrainer",
+    "FailurePolicy", "StepRecord", "ServerRuntime", "FedAvgAggregator",
+    "ProtocolError", "TrainState", "make_state", "apply_grads", "sgd",
+]

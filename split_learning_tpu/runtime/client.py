@@ -1,0 +1,264 @@
+"""Client-party trainers: split, U-shaped split, and federated loops.
+
+Re-expresses ``src/client_part.py``'s three loops TPU-natively:
+
+- split loop ≡ ``train_split_learning()`` (``src/client_part.py:103-141``):
+  forward the bottom stage, ship activations through the transport, receive
+  the cut-layer gradient, backprop it into the bottom stage, SGD step.
+  The reference splices the autograd tape manually
+  (``requires_grad_(True)`` + ``activations.backward(grad)``,
+  ``src/server_part.py:45`` / ``src/client_part.py:132``); here the splice
+  is a ``jax.vjp`` whose cotangent arrives from the transport. The backward
+  recomputes the bottom-stage forward (rematerialization — the
+  TPU-idiomatic trade of FLOPs for memory, and it keeps both halves of the
+  step independently jittable around the host-side transport boundary).
+- U-shaped loop (BASELINE.md config 5): client owns bottom A and head C;
+  labels never leave the client — two transport hops per step.
+- federated loop ≡ ``train_federated_learning()``
+  (``src/client_part.py:143-198``): local epochs on the full composition,
+  per-epoch FedAvg through the transport.
+
+Failure policy is explicit (SURVEY.md §3.4): the reference silently drops
+batches on any error (``continue`` at ``src/client_part.py:127-129,140-141``);
+here the policy is configurable — "raise" (default), "retry" (bounded), or
+"skip" (reference-compatible, but counted and reported).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from split_learning_tpu.core.losses import cross_entropy
+from split_learning_tpu.core.stage import SplitPlan, stage_backward
+from split_learning_tpu.runtime.state import TrainState, apply_grads, make_state, sgd
+from split_learning_tpu.transport.base import Transport, TransportError
+from split_learning_tpu.utils.config import Config
+
+
+@dataclasses.dataclass
+class StepRecord:
+    step: int
+    loss: float
+    epoch: int
+
+
+class FailurePolicy:
+    RAISE = "raise"
+    RETRY = "retry"
+    SKIP = "skip"
+
+
+class SplitClientTrainer:
+    """The classic 2-party split client (bottom stage A)."""
+
+    def __init__(self, plan: SplitPlan, cfg: Config, rng: jax.Array,
+                 transport: Transport,
+                 failure_policy: str = FailurePolicy.RAISE,
+                 max_retries: int = 3,
+                 logger: Optional[Any] = None) -> None:
+        self.plan = plan
+        self.cfg = cfg
+        self.transport = transport
+        self.failure_policy = failure_policy
+        self.max_retries = max_retries
+        self.logger = logger
+        self.dropped_batches = 0
+
+        client_idx = plan.stages_of("client")
+        if client_idx != (0,):
+            raise ValueError("SplitClientTrainer expects the client to own "
+                             "exactly stage 0; use USplitClientTrainer for "
+                             "U-shaped plans")
+        self.stage = plan.stages[0]
+        # init only the client stage (server inits its own half)
+        self._tx = sgd(cfg.lr, cfg.momentum)
+        self.state: Optional[TrainState] = None
+        self._rng = rng
+
+        stage = self.stage
+        self._fwd = jax.jit(stage.apply)
+        self._bwd = jax.jit(
+            lambda p, x, g: stage_backward(stage, p, x, g))
+
+    def ensure_init(self, sample_x: np.ndarray) -> None:
+        if self.state is None:
+            # Convention: every party runs plan.init from the shared seed and
+            # keeps its own stages — so a split run and a monolithic run with
+            # the same seed start from identical parameters (the equivalence
+            # property SURVEY.md §4 item 3 requires).
+            params = self.plan.init(self._rng, jnp.asarray(sample_x))[0]
+            self.state = make_state(params, self._tx)
+
+    def train_step(self, x: np.ndarray, y: np.ndarray,
+                   step: int) -> Optional[float]:
+        """One split step; returns the loss, or None if the batch was
+        dropped under the 'skip' policy."""
+        self.ensure_init(x)
+        acts = self._fwd(self.state.params, jnp.asarray(x))
+
+        attempt = 0
+        while True:
+            try:
+                g_acts, loss = self.transport.split_step(
+                    np.asarray(acts), np.asarray(y), step)
+                break
+            except TransportError:
+                attempt += 1
+                if (self.failure_policy == FailurePolicy.RETRY
+                        and attempt <= self.max_retries):
+                    continue
+                if self.failure_policy == FailurePolicy.SKIP:
+                    # reference behavior: drop the batch, keep going
+                    # (src/client_part.py:127-129) — but count it.
+                    self.dropped_batches += 1
+                    return None
+                raise
+
+        g_params = self._bwd(self.state.params, jnp.asarray(x),
+                             jnp.asarray(g_acts))
+        self.state = apply_grads(self._tx, self.state, g_params)
+        return loss
+
+    def train(self, data_iter: Callable[[], Iterable[Tuple[np.ndarray, np.ndarray]]],
+              epochs: Optional[int] = None) -> List[StepRecord]:
+        """Full training run ≡ train_split_learning (3 epochs default)."""
+        records: List[StepRecord] = []
+        step = 0
+        for epoch in range(epochs if epochs is not None else self.cfg.epochs):
+            for x, y in data_iter():
+                loss = self.train_step(x, y, step)
+                if loss is not None:
+                    records.append(StepRecord(step=step, loss=loss, epoch=epoch))
+                    if self.logger is not None:
+                        self.logger.log_metric("loss", loss, step=step)
+                step += 1
+        return records
+
+
+class USplitClientTrainer:
+    """U-shaped client: owns bottom stage A and head stage C; labels and
+    logits never leave the client (BASELINE.md config 5)."""
+
+    def __init__(self, plan: SplitPlan, cfg: Config, rng: jax.Array,
+                 transport: Transport, logger: Optional[Any] = None) -> None:
+        if plan.owners != ("client", "server", "client"):
+            raise ValueError("USplitClientTrainer expects owners "
+                             "(client, server, client)")
+        self.plan = plan
+        self.cfg = cfg
+        self.transport = transport
+        self.logger = logger
+        self._tx = sgd(cfg.lr, cfg.momentum)
+        self.state_a: Optional[TrainState] = None
+        self.state_c: Optional[TrainState] = None
+        self._rng = rng
+
+        stage_a, _, stage_c = plan.stages
+
+        self._fwd_a = jax.jit(lambda p, x: stage_a.apply(p, x))
+
+        def head_step(params_c, feats, labels):
+            def loss_fn(p, f):
+                return cross_entropy(stage_c.apply(p, f), labels)
+            loss, (g_c, g_feats) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1))(params_c, feats)
+            return loss, g_c, g_feats
+
+        self._head_step = jax.jit(head_step)
+        self._bwd_a = jax.jit(
+            lambda p, x, g: stage_backward(stage_a, p, x, g))
+
+    def ensure_init(self, sample_x: np.ndarray) -> None:
+        if self.state_a is None:
+            # shared-seed convention (see SplitClientTrainer.ensure_init):
+            # init the whole plan, keep the client-owned stages (0 and 2);
+            # the trunk params computed in passing are discarded.
+            params = self.plan.init(self._rng, jnp.asarray(sample_x))
+            self.state_a = make_state(params[0], self._tx)
+            self.state_c = make_state(params[2], self._tx)
+
+    def train_step(self, x: np.ndarray, y: np.ndarray, step: int) -> float:
+        self.ensure_init(x)
+        acts = self._fwd_a(self.state_a.params, jnp.asarray(x))
+        # hop 1: activations -> trunk features
+        feats = self.transport.u_forward(np.asarray(acts), step)
+        # local head: loss + grads (labels stay here)
+        loss, g_c, g_feats = self._head_step(
+            self.state_c.params, jnp.asarray(feats), jnp.asarray(y))
+        self.state_c = apply_grads(self._tx, self.state_c, g_c)
+        # hop 2: feature grads -> activation grads (server updates trunk)
+        g_acts = self.transport.u_backward(np.asarray(g_feats), step)
+        g_a = self._bwd_a(self.state_a.params, jnp.asarray(x),
+                          jnp.asarray(g_acts))
+        self.state_a = apply_grads(self._tx, self.state_a, g_a)
+        return float(loss)
+
+    def train(self, data_iter, epochs: Optional[int] = None) -> List[StepRecord]:
+        records: List[StepRecord] = []
+        step = 0
+        for epoch in range(epochs if epochs is not None else self.cfg.epochs):
+            for x, y in data_iter():
+                loss = self.train_step(x, y, step)
+                records.append(StepRecord(step=step, loss=loss, epoch=epoch))
+                if self.logger is not None:
+                    self.logger.log_metric("loss", loss, step=step)
+                step += 1
+        return records
+
+
+class FederatedClientTrainer:
+    """Federated client ≡ train_federated_learning (src/client_part.py:143-198):
+    local full-model epochs, per-epoch weight sync through the transport."""
+
+    def __init__(self, plan: SplitPlan, cfg: Config, rng: jax.Array,
+                 transport: Transport, logger: Optional[Any] = None) -> None:
+        self.plan = plan
+        self.cfg = cfg
+        self.transport = transport
+        self.logger = logger
+        self._tx = sgd(cfg.lr, cfg.momentum)
+        self.state: Optional[TrainState] = None
+        self._rng = rng
+
+        def step_fn(state: TrainState, x, y):
+            def loss_fn(params):
+                logits = plan.apply(params, x)
+                return cross_entropy(logits, y)
+            loss, grads = jax.value_and_grad(loss_fn)(state.params)
+            return apply_grads(self._tx, state, grads), loss
+
+        self._step = jax.jit(step_fn, donate_argnums=(0,))
+
+    def ensure_init(self, sample_x: np.ndarray) -> None:
+        if self.state is None:
+            params = tuple(self.plan.init(self._rng, jnp.asarray(sample_x)))
+            self.state = make_state(params, self._tx)
+
+    def train(self, data_iter, epochs: Optional[int] = None) -> List[StepRecord]:
+        records: List[StepRecord] = []
+        step = 0
+        for epoch in range(epochs if epochs is not None else self.cfg.epochs):
+            epoch_losses = []
+            for x, y in data_iter():
+                self.ensure_init(x)
+                self.state, loss = self._step(
+                    self.state, jnp.asarray(x), jnp.asarray(y))
+                epoch_losses.append(float(loss))
+                step += 1
+            avg_loss = float(np.mean(epoch_losses)) if epoch_losses else float("nan")
+            # per-epoch sync ≡ src/client_part.py:171-194
+            params_np = jax.tree_util.tree_map(np.asarray, self.state.params)
+            agg = self.transport.aggregate(params_np, epoch, avg_loss, step)
+            agg = jax.tree_util.tree_map(jnp.asarray, agg)
+            self.state = TrainState(params=agg, opt_state=self.state.opt_state,
+                                    step=self.state.step)
+            records.append(StepRecord(step=step, loss=avg_loss, epoch=epoch))
+            if self.logger is not None:
+                self.logger.log_metric("loss", avg_loss, step=step)
+                self.logger.log_metric("epoch", epoch, step=step)
+        return records

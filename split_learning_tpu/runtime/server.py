@@ -1,0 +1,217 @@
+"""Server-party runtime: the top-half step, U-trunk hops, and FedAvg.
+
+Re-expresses the reference's FastAPI handler bodies (``src/server_part.py``)
+as pure jitted functions over explicit state:
+
+- split step  ≡ ``/forward_pass``  (``src/server_part.py:25-58``): receive
+  activations+labels, forward top half, CE loss, backward, SGD step, return
+  the cut-layer gradient and the loss.
+- aggregate   ≡ ``/aggregate_weights`` (``src/server_part.py:60-93``), but
+  with real N-client FedAvg (the reference's averaging is a TODO comment at
+  ``src/server_part.py:81-82``; with one client the mean degenerates to the
+  reference's overwrite, bit-for-bit).
+- health      ≡ ``/health`` (``src/server_part.py:95-102``).
+
+Plus what the reference lacks (SURVEY.md §5): a step handshake — the server
+validates that client step counters advance monotonically, instead of
+silently desyncing after a client restart.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from split_learning_tpu.core.losses import cross_entropy
+from split_learning_tpu.core.stage import SplitPlan
+from split_learning_tpu.runtime.state import TrainState, apply_grads, make_state, sgd
+from split_learning_tpu.utils.config import Config
+
+
+class ProtocolError(RuntimeError):
+    """Step-handshake violation (non-monotonic client step)."""
+
+
+class ServerRuntime:
+    """Holds the server-owned stage state and serves the three ops.
+
+    Thread-safe: HTTP transports may call from handler threads; all state
+    transitions happen under one lock, and the math itself is pure."""
+
+    def __init__(self, plan: SplitPlan, cfg: Config, rng: jax.Array,
+                 sample_input: np.ndarray, strict_steps: bool = True) -> None:
+        self.plan = plan
+        self.cfg = cfg
+        self.mode = cfg.mode
+        self.strict_steps = strict_steps
+        self._lock = threading.RLock()
+        self._last_step = -1
+
+        all_params = plan.init(rng, jnp.asarray(sample_input))
+        self._tx = sgd(cfg.lr, cfg.momentum)
+
+        if cfg.mode == "federated":
+            # federated server keeps the full model (ref src/model_def.py:56-57)
+            self.state = make_state(tuple(all_params), self._tx)
+            self._agg = FedAvgAggregator(cfg.num_clients)
+        else:
+            server_idx = plan.stages_of("server")
+            if len(server_idx) != 1:
+                raise ValueError("server must own exactly one contiguous stage")
+            self.server_stage = server_idx[0]
+            self.state = make_state(all_params[self.server_stage], self._tx)
+            self._agg = None
+            self._build_jitted()
+        # residuals for the U-shaped two-hop step, keyed by step
+        self._u_residual: Dict[int, Any] = {}
+
+    # ------------------------------------------------------------------ #
+    def _build_jitted(self) -> None:
+        stage = self.plan.stages[self.server_stage]
+        tx = self._tx
+        is_last = self.server_stage == self.plan.num_stages - 1
+
+        if is_last:
+            # classic split: server half computes the loss (ref
+            # src/server_part.py:45-52) and returns d(loss)/d(acts).
+            def step_fn(state: TrainState, acts, labels):
+                def loss_fn(params, acts):
+                    logits = stage.apply(params, acts)
+                    return cross_entropy(logits, labels)
+                loss, (g_params, g_acts) = jax.value_and_grad(
+                    loss_fn, argnums=(0, 1))(state.params, acts)
+                new_state = apply_grads(tx, state, g_params)
+                return new_state, g_acts, loss
+
+            self._split_step = jax.jit(step_fn, donate_argnums=(0,))
+        else:
+            # U-shaped trunk: forward produces features; backward receives
+            # d(loss)/d(features) from the client head and returns
+            # d(loss)/d(acts), updating trunk params on the way.
+            def fwd_fn(params, acts):
+                return stage.apply(params, acts)
+
+            def bwd_fn(state: TrainState, acts, g_feats):
+                def trunk(params, acts):
+                    return stage.apply(params, acts)
+                _, vjp = jax.vjp(trunk, state.params, acts)
+                g_params, g_acts = vjp(g_feats)
+                new_state = apply_grads(tx, state, g_params)
+                return new_state, g_acts
+
+            self._u_fwd = jax.jit(fwd_fn)
+            self._u_bwd = jax.jit(bwd_fn, donate_argnums=(0,))
+
+    # ------------------------------------------------------------------ #
+    def _check_step(self, step: int) -> None:
+        if self.strict_steps and step <= self._last_step:
+            raise ProtocolError(
+                f"non-monotonic step {step} (last seen {self._last_step}); "
+                "client restarted or replayed — refusing to desync")
+
+    def split_step(self, activations: np.ndarray, labels: np.ndarray,
+                   step: int) -> Tuple[np.ndarray, float]:
+        if self.mode != "split":
+            # mode guard ≡ HTTP 400 (ref src/server_part.py:31-36)
+            raise ProtocolError(f"split_step called in mode {self.mode!r}")
+        with self._lock:
+            self._check_step(step)
+            self.state, g_acts, loss = self._split_step(
+                self.state, jnp.asarray(activations), jnp.asarray(labels))
+            self._last_step = step
+            return np.asarray(g_acts), float(loss)
+
+    # bound on residuals awaiting their hop-2 u_backward: if a client dies
+    # between hops, old entries are evicted instead of pinning cut-layer
+    # batches in device memory forever.
+    MAX_PENDING_RESIDUALS = 8
+
+    def u_forward(self, activations: np.ndarray, step: int) -> np.ndarray:
+        if self.mode != "u_split":
+            raise ProtocolError(f"u_forward called in mode {self.mode!r}")
+        with self._lock:
+            self._check_step(step)
+            acts = jnp.asarray(activations)
+            feats = self._u_fwd(self.state.params, acts)
+            self._u_residual[step] = acts
+            while len(self._u_residual) > self.MAX_PENDING_RESIDUALS:
+                evicted = min(self._u_residual)
+                del self._u_residual[evicted]
+            return np.asarray(feats)
+
+    def u_backward(self, feat_grads: np.ndarray, step: int) -> np.ndarray:
+        if self.mode != "u_split":
+            raise ProtocolError(f"u_backward called in mode {self.mode!r}")
+        with self._lock:
+            acts = self._u_residual.pop(step, None)
+            if acts is None:
+                raise ProtocolError(f"u_backward for unknown step {step}")
+            self.state, g_acts = self._u_bwd(
+                self.state, acts, jnp.asarray(feat_grads))
+            self._last_step = step
+            return np.asarray(g_acts)
+
+    def aggregate(self, params: Any, epoch: int, loss: float,
+                  step: int) -> Any:
+        if self.mode != "federated":
+            raise ProtocolError(f"aggregate called in mode {self.mode!r}")
+        # submit() blocks until the FedAvg round is full — it must run
+        # OUTSIDE the runtime lock or concurrent clients deadlock.
+        mean_params = self._agg.submit(params)
+        with self._lock:
+            self.state = TrainState(
+                params=mean_params,
+                opt_state=self.state.opt_state,
+                step=self.state.step + 1)
+            self._last_step = step
+        return mean_params
+
+    def health(self) -> Dict[str, Any]:
+        model_type = ("FullModel" if self.mode == "federated"
+                      else self.plan.stages[self.plan.stages_of('server')[0]].name)
+        return {"status": "healthy", "mode": self.mode, "model_type": model_type}
+
+
+class FedAvgAggregator:
+    """Real FedAvg over a round of ``num_clients`` submissions.
+
+    The reference aggregates by overwriting with the single client's weights
+    (``src/server_part.py:81-83``). The mean over one submission is that
+    same overwrite, so 1-client behavior is preserved exactly.
+    """
+
+    def __init__(self, num_clients: int) -> None:
+        if num_clients < 1:
+            raise ValueError("num_clients must be >= 1")
+        self.num_clients = num_clients
+        self._pending: list = []
+        self._result: Optional[Any] = None
+        self._round = 0
+        self._cond = threading.Condition()
+
+    def submit(self, params: Any, timeout: float = 120.0) -> Any:
+        """Blocks until the round is full, then returns the mean pytree."""
+        with self._cond:
+            round_id = self._round
+            self._pending.append(params)
+            if len(self._pending) >= self.num_clients:
+                stacked = [jax.tree_util.tree_map(jnp.asarray, p)
+                           for p in self._pending]
+                self._result = jax.tree_util.tree_map(
+                    lambda *xs: jnp.mean(jnp.stack(xs), axis=0), *stacked)
+                self._pending = []
+                self._round += 1
+                self._cond.notify_all()
+            else:
+                if not self._cond.wait_for(
+                        lambda: self._round != round_id, timeout=timeout):
+                    raise TimeoutError(
+                        f"FedAvg round incomplete: {len(self._pending)}/"
+                        f"{self.num_clients} clients reported")
+            return self._result
+
+

@@ -1,0 +1,44 @@
+"""Functional training state.
+
+The reference mutates module-global model/optimizer objects inside async
+HTTP handlers (``src/server_part.py:14-15,47-52,83``) — a data race with >1
+client (SURVEY.md §5). Here all training state is an explicit, immutable
+pytree threaded through pure jitted step functions; concurrency becomes a
+visible ordering decision instead of an accident.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import optax
+
+Params = Any
+
+
+class TrainState(NamedTuple):
+    params: Params
+    opt_state: Any
+    step: jax.Array  # int32 scalar
+
+
+def sgd(lr: float, momentum: float = 0.0) -> optax.GradientTransformation:
+    """The reference's optimizer: SGD(lr=0.01), no momentum
+    (``src/client_part.py:17``, ``src/server_part.py:15``)."""
+    if momentum:
+        return optax.sgd(lr, momentum=momentum)
+    return optax.sgd(lr)
+
+
+def make_state(params: Params, tx: optax.GradientTransformation) -> TrainState:
+    import jax.numpy as jnp
+    return TrainState(params=params, opt_state=tx.init(params),
+                      step=jnp.zeros((), jnp.int32))
+
+
+def apply_grads(tx: optax.GradientTransformation, state: TrainState,
+                grads: Params) -> TrainState:
+    updates, opt_state = tx.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    return TrainState(params=params, opt_state=opt_state, step=state.step + 1)

@@ -1,0 +1,13 @@
+from split_learning_tpu.transport.base import (
+    FaultInjector,
+    FaultyTransport,
+    Transport,
+    TransportError,
+    TransportStats,
+)
+from split_learning_tpu.transport.local import LocalTransport
+
+__all__ = [
+    "Transport", "TransportError", "TransportStats",
+    "FaultInjector", "FaultyTransport", "LocalTransport",
+]

@@ -1,0 +1,187 @@
+"""Transport — the swappable boundary between split-learning parties.
+
+This is the plugin boundary the reference realizes as pickle-over-HTTP
+(SURVEY.md §1 L2): ``POST /forward_pass`` carries activations+labels down
+and the cut-layer gradient back (``src/client_part.py:117-131``,
+``src/server_part.py:25-58``); ``POST /aggregate_weights`` carries weights
+both ways per federated epoch (``src/client_part.py:178-193``,
+``src/server_part.py:60-93``); ``GET /health`` reports mode/model
+(``src/server_part.py:95-102``).
+
+Implementations:
+- :class:`~split_learning_tpu.transport.local.LocalTransport` — in-process
+  (the test fake, SURVEY.md §4 item 2),
+- ``HttpTransport`` — wire-compatible route layout, safe codec,
+- the fused ICI path — inside jit, the "transport" is a mesh collective
+  (``ppermute``) and never leaves XLA (see parallel/pipeline.py); zero
+  serialization, the BASELINE.json north star.
+
+All payloads are host numpy arrays at this boundary; device placement is
+the runtime's concern.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+Params = Any
+
+
+class TransportError(RuntimeError):
+    """A transport round-trip failed (network error, bad status, codec)."""
+
+
+@dataclasses.dataclass
+class TransportStats:
+    """Per-op latency accounting — the reference has no timing at all
+    (SURVEY.md §5 tracing); round-trip latency is the north-star metric,
+    so every transport self-instruments."""
+
+    round_trips: int = 0
+    bytes_sent: int = 0
+    bytes_received: int = 0
+    total_seconds: float = 0.0
+    _latencies: list = dataclasses.field(default_factory=list)
+    _lock: threading.Lock = dataclasses.field(default_factory=threading.Lock)
+
+    def record(self, seconds: float, sent: int = 0, received: int = 0) -> None:
+        with self._lock:
+            self.round_trips += 1
+            self.bytes_sent += sent
+            self.bytes_received += received
+            self.total_seconds += seconds
+            self._latencies.append(seconds)
+
+    def percentile(self, q: float) -> float:
+        with self._lock:
+            if not self._latencies:
+                return float("nan")
+            return float(np.percentile(np.asarray(self._latencies), q))
+
+    def summary(self) -> Dict[str, float]:
+        return {
+            "round_trips": self.round_trips,
+            "p50_ms": self.percentile(50) * 1e3,
+            "p99_ms": self.percentile(99) * 1e3,
+            "mean_ms": (self.total_seconds / self.round_trips * 1e3)
+            if self.round_trips else float("nan"),
+            "bytes_sent": self.bytes_sent,
+            "bytes_received": self.bytes_received,
+        }
+
+
+class Transport(abc.ABC):
+    """Client-side handle to the server party."""
+
+    def __init__(self) -> None:
+        self.stats = TransportStats()
+
+    # -- classic 2-party split: one round trip per step ------------------
+    @abc.abstractmethod
+    def split_step(self, activations: np.ndarray, labels: np.ndarray,
+                   step: int) -> Tuple[np.ndarray, float]:
+        """Send cut-layer activations + labels; receive (grad, loss).
+
+        Contract of ``POST /forward_pass`` (``src/server_part.py:25-58``),
+        with the loss returned explicitly instead of living only in MLflow.
+        """
+
+    # -- U-shaped split: two round trips per step ------------------------
+    @abc.abstractmethod
+    def u_forward(self, activations: np.ndarray, step: int) -> np.ndarray:
+        """Hop 1: client acts -> server trunk features (labels stay home)."""
+
+    @abc.abstractmethod
+    def u_backward(self, feat_grads: np.ndarray, step: int) -> np.ndarray:
+        """Hop 2: d(loss)/d(features) -> d(loss)/d(activations)."""
+
+    # -- federated mode: one round trip per epoch ------------------------
+    @abc.abstractmethod
+    def aggregate(self, params: Params, epoch: int, loss: float,
+                  step: int) -> Params:
+        """Submit local weights; receive the aggregated (FedAvg) weights.
+
+        Contract of ``POST /aggregate_weights`` (``src/server_part.py:60-93``)
+        — except aggregation here is a real mean, not the reference's
+        single-client overwrite (``src/server_part.py:81-83``)."""
+
+    @abc.abstractmethod
+    def health(self) -> Dict[str, Any]:
+        """Contract of ``GET /health`` (``src/server_part.py:95-102``)."""
+
+    def close(self) -> None:
+        pass
+
+
+class FaultInjector:
+    """Deterministic fault-injection hook (SURVEY.md §5 failure detection:
+    'a fault-injection hook in the transport plugin').
+
+    Raises TransportError on a seeded schedule so failure-handling policies
+    (skip / retry / raise) are testable without a flaky network.
+    """
+
+    def __init__(self, failure_rate: float = 0.0, seed: int = 0,
+                 fail_steps: Optional[set] = None) -> None:
+        self._rng = np.random.RandomState(seed)
+        self.failure_rate = failure_rate
+        self.fail_steps = fail_steps or set()
+        self.injected = 0
+
+    def maybe_fail(self, op: str, step: int) -> None:
+        if step in self.fail_steps or (
+                self.failure_rate > 0 and self._rng.rand() < self.failure_rate):
+            self.injected += 1
+            raise TransportError(f"injected fault in {op!r} at step {step}")
+
+
+class FaultyTransport(Transport):
+    """Wraps any transport with a FaultInjector."""
+
+    def __init__(self, inner: Transport, injector: FaultInjector) -> None:
+        super().__init__()
+        self.inner = inner
+        self.injector = injector
+        self.stats = inner.stats
+
+    def split_step(self, activations, labels, step):
+        self.injector.maybe_fail("split_step", step)
+        return self.inner.split_step(activations, labels, step)
+
+    def u_forward(self, activations, step):
+        self.injector.maybe_fail("u_forward", step)
+        return self.inner.u_forward(activations, step)
+
+    def u_backward(self, feat_grads, step):
+        self.injector.maybe_fail("u_backward", step)
+        return self.inner.u_backward(feat_grads, step)
+
+    def aggregate(self, params, epoch, loss, step):
+        self.injector.maybe_fail("aggregate", step)
+        return self.inner.aggregate(params, epoch, loss, step)
+
+    def health(self):
+        return self.inner.health()
+
+    def close(self):
+        self.inner.close()
+
+
+def timed(stats: TransportStats):
+    """Context manager measuring one round trip."""
+    class _Timer:
+        def __enter__(self):
+            self.t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            if exc[0] is None:
+                stats.record(time.perf_counter() - self.t0)
+            return False
+    return _Timer()

@@ -1,0 +1,86 @@
+"""Wire codec — safe, zero-copy-ish serialization of array pytrees.
+
+The reference pickles torch tensors straight onto the wire
+(``src/client_part.py:122,131,184,193``; ``src/server_part.py:39,58,74,93``)
+— insecure by design (SURVEY.md §2: "must not be reproduced"). Here the wire
+format is msgpack with a custom ext type for ndarrays (dtype, shape, raw
+buffer): no code execution on decode, and the array payload is a raw memory
+view (no base64, no copies beyond the socket).
+
+The pytree structure is encoded as plain msgpack containers (dict/list/
+scalars), so any JSON-ish tree of numpy/JAX arrays round-trips.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import msgpack
+import numpy as np
+
+_NDARRAY_EXT = 42
+
+# allow-list of dtypes permitted on the wire (no object arrays)
+_SAFE_DTYPES = frozenset(
+    ["float32", "float64", "float16", "bfloat16",
+     "int8", "int16", "int32", "int64",
+     "uint8", "uint16", "uint32", "uint64", "bool"]
+)
+
+
+class CodecError(ValueError):
+    pass
+
+
+def _pack_array(arr: np.ndarray) -> bytes:
+    name = arr.dtype.name
+    if name not in _SAFE_DTYPES:
+        raise CodecError(f"refusing to serialize dtype {name!r}")
+    header = msgpack.packb((name, list(arr.shape)))
+    return header + np.ascontiguousarray(arr).tobytes()
+
+
+def _unpack_array(data: bytes) -> np.ndarray:
+    unpacker = msgpack.Unpacker(max_buffer_size=len(data))
+    unpacker.feed(data)
+    name, shape = unpacker.unpack()
+    if name not in _SAFE_DTYPES:
+        raise CodecError(f"refusing to deserialize dtype {name!r}")
+    offset = unpacker.tell()
+    if name == "bfloat16":
+        import ml_dtypes
+        dtype = np.dtype(ml_dtypes.bfloat16)
+    else:
+        dtype = np.dtype(name)
+    arr = np.frombuffer(data, dtype=dtype, offset=offset)
+    return arr.reshape(shape)
+
+
+def _default(obj: Any) -> Any:
+    # numpy scalars also expose __array__ — check them first so they
+    # round-trip as native ints/floats, not 0-d arrays
+    if isinstance(obj, np.integer):
+        return int(obj)
+    if isinstance(obj, (np.floating, np.bool_)):
+        return obj.item()
+    # jax.Array and np.ndarray both expose __array__
+    if hasattr(obj, "__array__") or isinstance(obj, np.ndarray):
+        return msgpack.ExtType(_NDARRAY_EXT, _pack_array(np.asarray(obj)))
+    raise CodecError(f"cannot serialize {type(obj)!r}")
+
+
+def _ext_hook(code: int, data: bytes) -> Any:
+    if code == _NDARRAY_EXT:
+        return _unpack_array(data)
+    raise CodecError(f"unknown ext type {code}")
+
+
+def encode(obj: Any) -> bytes:
+    """Pytree of dict/list/scalars/arrays -> bytes."""
+    return msgpack.packb(obj, default=_default, use_bin_type=True)
+
+
+def decode(data: bytes) -> Any:
+    """bytes -> pytree with numpy arrays at the leaves."""
+    return msgpack.unpackb(data, ext_hook=_ext_hook, raw=False,
+                           strict_map_key=False)

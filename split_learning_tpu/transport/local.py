@@ -1,0 +1,73 @@
+"""In-process transport — the protocol-level test fake (SURVEY.md §4 item 2).
+
+Exercises the exact split-step contract (activations down, same-shaped grad
+back, step echo) with zero network, the equivalent of faking the reference's
+``/forward_pass`` route. Optionally round-trips every payload through the
+wire codec so serialization is covered even in-process.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from split_learning_tpu.transport import codec
+from split_learning_tpu.transport.base import Transport, TransportError, timed
+
+
+class LocalTransport(Transport):
+    """Exception contract (uniform across all ops): server-side
+    ProtocolError propagates as-is — it is a *permanent* contract
+    violation (mode mismatch, step replay) that retry/skip policies must
+    not mask; anything else becomes TransportError (transient)."""
+
+    def __init__(self, server: Any, through_codec: bool = False) -> None:
+        """server: a ServerRuntime (duck-typed: split_step/u_forward/
+        u_backward/aggregate/health)."""
+        super().__init__()
+        self.server = server
+        self.through_codec = through_codec
+
+    def _roundtrip(self, obj: Any) -> Any:
+        return codec.decode(codec.encode(obj)) if self.through_codec else obj
+
+    def _call(self, fn, *args):
+        from split_learning_tpu.runtime.server import ProtocolError
+        try:
+            return fn(*args)
+        except ProtocolError:
+            raise
+        except Exception as exc:
+            raise TransportError(str(exc)) from exc
+
+    def split_step(self, activations: np.ndarray, labels: np.ndarray,
+                   step: int) -> Tuple[np.ndarray, float]:
+        with timed(self.stats):
+            acts = self._roundtrip(np.asarray(activations))
+            labs = self._roundtrip(np.asarray(labels))
+            grads, loss = self._call(self.server.split_step, acts, labs, step)
+            return self._roundtrip(grads), float(loss)
+
+    def u_forward(self, activations: np.ndarray, step: int) -> np.ndarray:
+        with timed(self.stats):
+            feats = self._call(
+                self.server.u_forward,
+                self._roundtrip(np.asarray(activations)), step)
+            return self._roundtrip(feats)
+
+    def u_backward(self, feat_grads: np.ndarray, step: int) -> np.ndarray:
+        with timed(self.stats):
+            g = self._call(
+                self.server.u_backward,
+                self._roundtrip(np.asarray(feat_grads)), step)
+            return self._roundtrip(g)
+
+    def aggregate(self, params: Any, epoch: int, loss: float, step: int) -> Any:
+        with timed(self.stats):
+            return self._roundtrip(self._call(
+                self.server.aggregate,
+                self._roundtrip(params), epoch, loss, step))
+
+    def health(self) -> Dict[str, Any]:
+        return self.server.health()

@@ -8,7 +8,7 @@ before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # force, ambient env says "axon"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -18,10 +18,24 @@ import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 import pytest  # noqa: E402
 
+# Hermeticity: the image's sitecustomize registers an "axon" TPU backend
+# that proxies to a local tunnel; its lazy init runs even under
+# JAX_PLATFORMS=cpu and hangs when the tunnel is wedged. Tests never want
+# the real chip — drop the factory before any backend initializes.
+jax.config.update("jax_platforms", "cpu")
+try:
+    import jax._src.xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+
 
 @pytest.fixture(scope="session")
 def devices():
-    devs = jax.devices()
+    # NOTE: ask for the cpu backend explicitly — bare jax.devices() resolves
+    # the *default* backend, which the installed axon TPU shim hijacks to
+    # open a (possibly hanging) tunnel connection even under JAX_PLATFORMS=cpu.
+    devs = jax.devices("cpu")
     assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
     return devs
 

@@ -1,0 +1,133 @@
+"""Numerical equivalence: split (2-party) and U-shaped (3-hop) training must
+match monolithic training exactly (SURVEY.md §4 item 3 — the property the
+reference only eyeballs via MLflow loss curves).
+
+Key fact making this exact: SGD without momentum updates each parameter
+independently, so per-stage optimizers ≡ one joint optimizer.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from split_learning_tpu.core import cross_entropy
+from split_learning_tpu.models import get_plan
+from split_learning_tpu.runtime import (
+    FederatedClientTrainer, ServerRuntime, SplitClientTrainer,
+    USplitClientTrainer, apply_grads, make_state, sgd)
+from split_learning_tpu.transport import LocalTransport
+from split_learning_tpu.utils import Config
+
+SEED = 42
+N_STEPS = 8
+BATCH = 16
+
+
+def data_stream():
+    rs = np.random.RandomState(123)
+    batches = []
+    for _ in range(N_STEPS):
+        x = rs.randn(BATCH, 28, 28, 1).astype(np.float32)
+        y = (rs.randint(0, 10, (BATCH,))).astype(np.int64)
+        batches.append((x, y))
+    return batches
+
+
+def monolithic_losses(mode="split"):
+    """Ground truth: jointly train the full composition with one SGD."""
+    plan = get_plan(mode=mode)
+    batches = data_stream()
+    params = tuple(plan.init(jax.random.PRNGKey(SEED),
+                             jnp.asarray(batches[0][0])))
+    tx = sgd(0.01)
+    state = make_state(params, tx)
+
+    @jax.jit
+    def step(state, x, y):
+        def loss_fn(p):
+            return cross_entropy(plan.apply(p, x), y)
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        return apply_grads(tx, state, grads), loss
+
+    losses = []
+    for x, y in batches:
+        state, loss = step(state, jnp.asarray(x), jnp.asarray(y))
+        losses.append(float(loss))
+    return np.asarray(losses), state.params
+
+
+def test_split_equals_monolithic():
+    cfg = Config(mode="split", batch_size=BATCH, lr=0.01)
+    plan = get_plan(mode="split")
+    batches = data_stream()
+    # both parties share the init seed (see SplitClientTrainer.ensure_init)
+    server = ServerRuntime(plan, cfg, jax.random.PRNGKey(SEED), batches[0][0])
+    client = SplitClientTrainer(plan, cfg, jax.random.PRNGKey(SEED),
+                                LocalTransport(server, through_codec=True))
+    split_losses = []
+    for step, (x, y) in enumerate(batches):
+        split_losses.append(client.train_step(x, y, step))
+
+    mono_losses, mono_params = monolithic_losses()
+    np.testing.assert_allclose(split_losses, mono_losses, rtol=1e-5, atol=1e-6)
+    # final params of both halves must match too
+    flat_split = jax.tree_util.tree_leaves(
+        (client.state.params, server.state.params))
+    flat_mono = jax.tree_util.tree_leaves(mono_params)
+    for a, b in zip(flat_split, flat_mono):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_u_split_equals_monolithic():
+    """3-hop U-shaped training (labels never leave the client) trains the
+    same function as the monolithic model (BASELINE.md config 5)."""
+    cfg = Config(mode="u_split", batch_size=BATCH, lr=0.01)
+    plan = get_plan(mode="u_split")
+    batches = data_stream()
+    server = ServerRuntime(plan, cfg, jax.random.PRNGKey(SEED), batches[0][0])
+    client = USplitClientTrainer(plan, cfg, jax.random.PRNGKey(SEED),
+                                 LocalTransport(server))
+    u_losses = []
+    for step, (x, y) in enumerate(batches):
+        u_losses.append(client.train_step(x, y, step))
+
+    mono_losses, _ = monolithic_losses(mode="u_split")
+    np.testing.assert_allclose(u_losses, mono_losses, rtol=1e-5, atol=1e-6)
+
+
+def test_federated_single_client_equals_local_training():
+    """With one client, FedAvg degenerates to the reference's overwrite
+    (src/server_part.py:81-83) — federated training ≡ plain local training."""
+    cfg = Config(mode="federated", batch_size=BATCH, lr=0.01, epochs=2)
+    plan = get_plan(mode="federated")
+    batches = data_stream()
+    server = ServerRuntime(plan, cfg, jax.random.PRNGKey(SEED), batches[0][0])
+    client = FederatedClientTrainer(plan, cfg, jax.random.PRNGKey(SEED),
+                                    LocalTransport(server))
+    records = client.train(lambda: iter(batches), epochs=2)
+    assert len(records) == 2  # one record per epoch
+
+    # plain local training, same data, same seed
+    mono_plan = get_plan(mode="federated")
+    params = tuple(mono_plan.init(jax.random.PRNGKey(SEED),
+                                  jnp.asarray(batches[0][0])))
+    tx = sgd(0.01)
+    state = make_state(params, tx)
+
+    @jax.jit
+    def step(state, x, y):
+        def loss_fn(p):
+            return cross_entropy(mono_plan.apply(p, x), y)
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        return apply_grads(tx, state, grads), loss
+
+    for _ in range(2):
+        for x, y in batches:
+            state, _ = step(state, jnp.asarray(x), jnp.asarray(y))
+
+    for a, b in zip(jax.tree_util.tree_leaves(client.state.params),
+                    jax.tree_util.tree_leaves(state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)

@@ -1,0 +1,175 @@
+"""Protocol-contract tests against the in-memory transport fake
+(SURVEY.md §4 item 2): activations down, same-shaped grad back, step echo,
+mode guards, handshake, fault injection, codec safety."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from split_learning_tpu.models import get_plan
+from split_learning_tpu.runtime import ProtocolError, ServerRuntime
+from split_learning_tpu.transport import (
+    FaultInjector, FaultyTransport, LocalTransport, TransportError)
+from split_learning_tpu.transport import codec
+from split_learning_tpu.utils import Config
+
+
+def make_server(mode="split", **kw):
+    cfg = Config(mode=mode, **kw)
+    plan = get_plan(mode=mode)
+    sample = np.zeros((8, 28, 28, 1), np.float32)
+    return ServerRuntime(plan, cfg, jax.random.PRNGKey(1), sample)
+
+
+def test_split_step_contract(rng):
+    server = make_server()
+    t = LocalTransport(server, through_codec=True)
+    acts = np.random.RandomState(0).randn(8, 26, 26, 32).astype(np.float32)
+    labels = np.arange(8) % 10
+    grads, loss = t.split_step(acts, labels, step=0)
+    # same-shaped gradient back (ref contract: src/server_part.py:57-58)
+    assert grads.shape == acts.shape
+    assert grads.dtype == np.float32
+    assert np.isfinite(loss) and loss > 0
+    assert t.stats.round_trips == 1
+    # a second step with a larger counter is accepted
+    t.split_step(acts, labels, step=1)
+
+
+def test_step_handshake_rejects_replay():
+    """The reference silently desyncs after a client restart (SURVEY.md §5);
+    we refuse non-monotonic steps. ProtocolError is permanent — it must NOT
+    be masked as a transient TransportError (skip/retry would hide it)."""
+    server = make_server()
+    t = LocalTransport(server)
+    acts = np.zeros((4, 26, 26, 32), np.float32)
+    labels = np.zeros((4,), np.int64)
+    t.split_step(acts, labels, step=5)
+    with pytest.raises(ProtocolError):
+        t.split_step(acts, labels, step=5)  # replay
+    with pytest.raises(ProtocolError):
+        t.split_step(acts, labels, step=3)  # rollback
+
+
+def test_mode_guards():
+    """split ops on a federated server (and vice versa) are rejected —
+    the reference returns HTTP 400 (src/server_part.py:31-36, 66-71).
+    Uniform contract: ProtocolError through every transport op."""
+    fed_server = make_server(mode="federated")
+    t = LocalTransport(fed_server)
+    with pytest.raises(ProtocolError):
+        t.split_step(np.zeros((1, 26, 26, 32), np.float32),
+                     np.zeros((1,), np.int64), 0)
+    with pytest.raises(ProtocolError):
+        t.u_forward(np.zeros((1, 26, 26, 32), np.float32), 0)
+    split_server = make_server(mode="split")
+    with pytest.raises(ProtocolError):
+        LocalTransport(split_server).aggregate({}, 0, 0.0, 0)
+
+
+def test_health_contract():
+    # {status, mode, model_type} ≡ src/server_part.py:97-102
+    h = make_server().health()
+    assert h["status"] == "healthy"
+    assert h["mode"] == "split"
+    assert h["model_type"] == "part_b"
+    assert make_server(mode="federated").health()["model_type"] == "FullModel"
+
+
+def test_fault_injection_and_policies():
+    server = make_server()
+    inj = FaultInjector(fail_steps={1, 2})
+    t = FaultyTransport(LocalTransport(server), inj)
+    acts = np.zeros((4, 26, 26, 32), np.float32)
+    labels = np.zeros((4,), np.int64)
+    t.split_step(acts, labels, 0)
+    with pytest.raises(TransportError):
+        t.split_step(acts, labels, 1)
+    assert inj.injected == 1
+
+
+def test_codec_roundtrip_pytrees():
+    tree = {
+        "activations": np.random.randn(4, 26, 26, 32).astype(np.float32),
+        "labels": np.arange(4, dtype=np.int64),
+        "step": 7,
+        "nested": {"lr": 0.01, "name": "part_a",
+                   "bf16": jnp.ones((8, 128), jnp.bfloat16)},
+        "list": [np.float32(1.5), True, None],
+    }
+    out = codec.decode(codec.encode(tree))
+    assert out["step"] == 7
+    assert out["nested"]["name"] == "part_a"
+    np.testing.assert_array_equal(out["labels"], tree["labels"])
+    np.testing.assert_array_equal(out["activations"], tree["activations"])
+    assert np.asarray(out["nested"]["bf16"]).dtype.name == "bfloat16"
+    assert out["list"] == [1.5, True, None]
+
+
+def test_codec_rejects_object_dtype():
+    with pytest.raises(codec.CodecError):
+        codec.encode({"evil": np.array([object()])})
+
+
+def test_codec_no_arbitrary_code_execution():
+    """Unlike the reference's pickle wire format (src/client_part.py:122),
+    decoding attacker bytes must never execute code — unknown ext types are
+    rejected."""
+    import msgpack
+    evil = msgpack.packb(msgpack.ExtType(99, b"payload"))
+    with pytest.raises(codec.CodecError):
+        codec.decode(evil)
+
+
+def test_fedavg_is_a_real_mean():
+    from split_learning_tpu.runtime import FedAvgAggregator
+    import threading
+    agg = FedAvgAggregator(2)
+    results = {}
+
+    def client(name, value):
+        results[name] = agg.submit({"w": np.full((2,), value, np.float32)})
+
+    t1 = threading.Thread(target=client, args=("a", 1.0))
+    t2 = threading.Thread(target=client, args=("b", 3.0))
+    t1.start(); t2.start(); t1.join(); t2.join()
+    np.testing.assert_allclose(np.asarray(results["a"]["w"]), [2.0, 2.0])
+    np.testing.assert_allclose(np.asarray(results["b"]["w"]), [2.0, 2.0])
+
+
+def test_multiclient_fedavg_through_server_runtime():
+    """Regression: aggregate() must not hold the runtime lock across the
+    blocking FedAvg round barrier, or two clients deadlock."""
+    import threading
+    server = make_server(mode="federated", num_clients=2)
+    t = LocalTransport(server)
+    results = {}
+
+    def client(name, value):
+        params = {"w": np.full((3,), value, np.float32)}
+        results[name] = t.aggregate(params, epoch=0, loss=1.0, step=1)
+
+    threads = [threading.Thread(target=client, args=(n, v))
+               for n, v in [("a", 2.0), ("b", 4.0)]]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=30)
+        assert not th.is_alive(), "FedAvg round deadlocked"
+    np.testing.assert_allclose(np.asarray(results["a"]["w"]), [3.0] * 3)
+    np.testing.assert_allclose(np.asarray(results["b"]["w"]), [3.0] * 3)
+
+
+def test_u_residual_eviction():
+    """Server must bound residuals pending their hop-2 backward."""
+    server = make_server(mode="u_split")
+    t = LocalTransport(server)
+    acts = np.zeros((2, 26, 26, 32), np.float32)
+    cap = server.MAX_PENDING_RESIDUALS
+    for s in range(cap + 3):
+        t.u_forward(acts, step=s)  # client "crashes" before every hop 2
+    assert len(server._u_residual) == cap
+    # oldest entries were evicted; their backward now fails loudly
+    with pytest.raises(ProtocolError):
+        t.u_backward(np.zeros((2, 12 * 12 * 64), np.float32), step=0)
