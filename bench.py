@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Headline benchmark: MNIST split-CNN training throughput (BASELINE.md).
+
+Prints ONE JSON line:
+  {"metric": "mnist_split_cnn_steps_per_sec", "value": N,
+   "unit": "steps/sec", "vs_baseline": R}
+
+- baseline: the reference architecture — per-step HTTP round trip of the
+  5.28 MiB cut-layer tensor between a client and a server process path
+  (loopback, CPU, safe codec — strictly *generous* to the reference, which
+  also paid pickle + k8s networking; ``src/client_part.py:110-138``).
+- value: the fused TPU-native path — the whole split step (both stages,
+  loss, both SGD updates, in-XLA cut-layer exchange) as one jitted program
+  on the default backend (TPU when available).
+- vs_baseline = value / baseline_steps_per_sec.
+
+Run with --quick for a fast smoke (fewer timed steps).
+Internal: --role {baseline,fused} runs one measurement subprocess.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+BATCH = 64  # reference batch size (src/client_part.py:98)
+
+
+def _data(n_steps: int):
+    import numpy as np
+    rs = np.random.RandomState(0)
+    x = rs.randn(n_steps, BATCH, 28, 28, 1).astype(np.float32)
+    y = rs.randint(0, 10, (n_steps, BATCH)).astype(np.int64)
+    return x, y
+
+
+def measure_baseline(quick: bool) -> dict:
+    """Reference-architecture path: HTTP loopback split step on CPU."""
+    import jax
+    import numpy as np
+
+    from split_learning_tpu.models import get_plan
+    from split_learning_tpu.runtime import ServerRuntime, SplitClientTrainer
+    from split_learning_tpu.transport.http import HttpTransport, SplitHTTPServer
+    from split_learning_tpu.utils import Config
+
+    warmup, steps = (2, 10) if quick else (5, 40)
+    cfg = Config(mode="split", batch_size=BATCH)
+    plan = get_plan(mode="split")
+    x, y = _data(warmup + steps)
+    runtime = ServerRuntime(plan, cfg, jax.random.PRNGKey(0), x[0])
+    server = SplitHTTPServer(runtime).start()
+    transport = HttpTransport(server.url)
+    client = SplitClientTrainer(plan, cfg, jax.random.PRNGKey(0), transport)
+    try:
+        for i in range(warmup):
+            client.train_step(x[i], y[i], i)
+        t0 = time.perf_counter()
+        for i in range(warmup, warmup + steps):
+            client.train_step(x[i], y[i], i)
+        dt = time.perf_counter() - t0
+    finally:
+        transport.close()
+        server.stop()
+    return {
+        "steps_per_sec": steps / dt,
+        "roundtrip_p50_ms": transport.stats.percentile(50) * 1e3,
+        "platform": "cpu+http-loopback",
+    }
+
+
+def measure_fused(quick: bool) -> dict:
+    """TPU-native path: one jitted split step, async dispatch."""
+    import jax
+    import numpy as np
+
+    from split_learning_tpu.models import get_plan
+    from split_learning_tpu.runtime.fused import FusedSplitTrainer
+    from split_learning_tpu.utils import Config
+
+    warmup, steps = (3, 20) if quick else (10, 200)
+    cfg = Config(mode="split", batch_size=BATCH)
+    plan = get_plan(mode="split")
+    x, y = _data(1)
+    trainer = FusedSplitTrainer(plan, cfg, jax.random.PRNGKey(0), x[0])
+    platform = trainer.state.step.devices().pop().platform
+
+    import jax.numpy as jnp
+    xd, yd = jnp.asarray(x[0]), jnp.asarray(y[0])
+    for _ in range(warmup):
+        trainer.train_step_async(xd, yd)
+    jax.block_until_ready(trainer.state)
+    t0 = time.perf_counter()
+    loss = None
+    for _ in range(steps):
+        loss = trainer.train_step_async(xd, yd)
+    jax.block_until_ready((trainer.state, loss))
+    dt = time.perf_counter() - t0
+    return {
+        "steps_per_sec": steps / dt,
+        "step_ms": dt / steps * 1e3,
+        "platform": platform,
+        "loss": float(loss),
+    }
+
+
+def _run_subprocess(role: str, quick: bool, env_overrides: dict,
+                    timeout: float) -> dict | None:
+    env = dict(os.environ)
+    env.update(env_overrides)
+    cmd = [sys.executable, os.path.abspath(__file__), "--role", role]
+    if quick:
+        cmd.append("--quick")
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=timeout, env=env,
+                             cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        print(f"[bench] {role} timed out", file=sys.stderr)
+        return None
+    if out.returncode != 0:
+        print(f"[bench] {role} failed:\n{out.stderr[-2000:]}", file=sys.stderr)
+        return None
+    for line in out.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    print(f"[bench] {role}: no JSON in output", file=sys.stderr)
+    return None
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--role", choices=["baseline", "fused"], default=None)
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+
+    if args.role == "baseline":
+        print(json.dumps(measure_baseline(args.quick)))
+        return
+    if args.role == "fused":
+        print(json.dumps(measure_fused(args.quick)))
+        return
+
+    # orchestrator: baseline on hermetic CPU; fused on the default backend
+    # (TPU via the axon tunnel), falling back to CPU if the tunnel is down.
+    cpu_env = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""}
+    baseline = _run_subprocess("baseline", args.quick, cpu_env, timeout=900)
+    fused = _run_subprocess("fused", args.quick, {}, timeout=900)
+    if fused is None:
+        print("[bench] fused on default backend failed; CPU fallback",
+              file=sys.stderr)
+        fused = _run_subprocess("fused", args.quick, cpu_env, timeout=900)
+
+    if fused is None or baseline is None:
+        print(json.dumps({"metric": "mnist_split_cnn_steps_per_sec",
+                          "value": None, "unit": "steps/sec",
+                          "vs_baseline": None}))
+        sys.exit(1)
+
+    detail = {"baseline": baseline, "fused": fused}
+    print(f"[bench] detail: {json.dumps(detail)}", file=sys.stderr)
+    print(json.dumps({
+        "metric": "mnist_split_cnn_steps_per_sec",
+        "value": round(fused["steps_per_sec"], 2),
+        "unit": "steps/sec",
+        "vs_baseline": round(fused["steps_per_sec"] / baseline["steps_per_sec"], 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

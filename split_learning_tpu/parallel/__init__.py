@@ -1,0 +1,9 @@
+from split_learning_tpu.parallel.mesh import (
+    DATA_AXIS,
+    PIPE_AXIS,
+    batch_sharding,
+    make_mesh,
+    replicated,
+)
+
+__all__ = ["make_mesh", "batch_sharding", "replicated", "DATA_AXIS", "PIPE_AXIS"]

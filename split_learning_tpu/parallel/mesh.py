@@ -1,0 +1,51 @@
+"""Device-mesh construction — the TPU replacement for the reference's
+Kubernetes pod topology (SURVEY.md §1 L0: "the JAX device mesh + multi-host
+runtime replaces pod scheduling").
+
+Axes:
+- ``data``: data-parallel client replicas (the reference's `split-client`
+  Deployment replica count, pinned to 1 at ``k8s/split-learning.yaml:49``;
+  here a real axis with psum gradient aggregation — BASELINE.md config 3),
+- ``pipe``: pipeline stages (the client/server cut generalized to N stages
+  — BASELINE.md configs 2, 4, 5).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+PIPE_AXIS = "pipe"
+
+
+def make_mesh(num_clients: int = 1, num_stages: int = 1,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """A (data × pipe) mesh over the first num_clients*num_stages devices."""
+    if devices is None:
+        devices = jax.devices()
+    need = num_clients * num_stages
+    if len(devices) < need:
+        raise ValueError(
+            f"mesh needs {need} devices ({num_clients} clients x "
+            f"{num_stages} stages), only {len(devices)} available")
+    grid = np.asarray(devices[:need]).reshape(num_clients, num_stages)
+    return Mesh(grid, (DATA_AXIS, PIPE_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch dim sharded across data-parallel clients."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def host_device_count_flags(n: int = 8) -> str:
+    """The XLA flag that simulates an n-device host (the framework's
+    k3d-equivalent fake cluster, SURVEY.md §4)."""
+    return f"--xla_force_host_platform_device_count={n}"

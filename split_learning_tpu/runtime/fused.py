@@ -1,0 +1,127 @@
+"""Fused SPMD trainer — the TpuTransport fast path (BASELINE.json north star).
+
+The reference's hot loop pays a 2 x 5.28 MiB pickle/HTTP round trip per step
+(SURVEY.md §3.1). Here the whole split step — client stage forward, cut-layer
+"send", server stage forward, loss, backward, cut-layer gradient "return",
+both SGD updates — is ONE jitted XLA program over a device mesh:
+
+- the cut-layer exchange serializes nothing; under a sharded mesh it lowers
+  to ICI collectives chosen by XLA, and on one chip it fuses away entirely;
+- multi-client data parallelism (BASELINE.md config 3) is the mesh's
+  ``data`` axis: the global batch is sharded across clients and gradient
+  psum over ICI replaces the reference's per-epoch weight shipping;
+- GPipe-style microbatching (config 4) is a ``lax.scan`` accumulating
+  gradients over microbatches — compiler-friendly control flow, constant
+  memory in the number of microbatches.
+
+The split structure is preserved *functionally* (same SplitPlan, same
+per-stage params as the MPMD runtimes), so fused and transport-based
+training are numerically interchangeable — tested in
+tests/test_fused.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from split_learning_tpu.core.losses import cross_entropy
+from split_learning_tpu.core.stage import SplitPlan
+from split_learning_tpu.parallel.mesh import DATA_AXIS, batch_sharding, replicated
+from split_learning_tpu.runtime.state import TrainState, apply_grads, make_state, sgd
+from split_learning_tpu.utils.config import Config
+
+
+class FusedSplitTrainer:
+    """Single-program split training over an optional (data, pipe) mesh."""
+
+    def __init__(self, plan: SplitPlan, cfg: Config, rng: jax.Array,
+                 sample_input: np.ndarray,
+                 mesh: Optional[Mesh] = None) -> None:
+        self.plan = plan
+        self.cfg = cfg
+        self.mesh = mesh
+        self._tx = sgd(cfg.lr, cfg.momentum)
+
+        params = tuple(plan.init(rng, jnp.asarray(sample_input)))
+        state = make_state(params, self._tx)
+        if mesh is not None:
+            # params replicated across the mesh; batch sharded over 'data'
+            state = jax.device_put(state, replicated(mesh))
+            self._x_sharding = batch_sharding(mesh)
+        else:
+            self._x_sharding = None
+        self.state = state
+
+        microbatches = cfg.microbatches
+        tx = self._tx
+
+        def loss_fn(params, x, y):
+            logits = plan.apply(params, x)
+            return cross_entropy(logits, y)
+
+        def step_fn(state: TrainState, x, y):
+            if microbatches == 1:
+                loss, grads = jax.value_and_grad(loss_fn)(state.params, x, y)
+            else:
+                # GPipe-style gradient accumulation: scan over microbatches.
+                mb = microbatches
+                xs = x.reshape((mb, x.shape[0] // mb) + x.shape[1:])
+                ys = y.reshape((mb, y.shape[0] // mb) + y.shape[1:])
+
+                def micro(carry, xy):
+                    g_acc, l_acc = carry
+                    xmb, ymb = xy
+                    l, g = jax.value_and_grad(loss_fn)(state.params, xmb, ymb)
+                    g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+                    return (g_acc, l_acc + l), None
+
+                zeros = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+                (g_sum, l_sum), _ = jax.lax.scan(
+                    micro, (zeros, jnp.zeros(())), (xs, ys))
+                grads = jax.tree_util.tree_map(lambda g: g / mb, g_sum)
+                loss = l_sum / mb
+            new_state = apply_grads(tx, state, grads)
+            return new_state, loss
+
+        if mesh is not None:
+            state_sh = jax.tree_util.tree_map(
+                lambda _: replicated(mesh), state)
+            data_sh = batch_sharding(mesh)
+            self._step = jax.jit(
+                step_fn,
+                in_shardings=(state_sh, data_sh, data_sh),
+                out_shardings=(state_sh, replicated(mesh)),
+                donate_argnums=(0,),
+            )
+        else:
+            self._step = jax.jit(step_fn, donate_argnums=(0,))
+
+    def train_step(self, x: np.ndarray, y: np.ndarray) -> float:
+        """One fused step on the global batch (sharded over clients)."""
+        x = jnp.asarray(x)
+        y = jnp.asarray(y)
+        if self._x_sharding is not None:
+            x = jax.device_put(x, self._x_sharding)
+            y = jax.device_put(y, self._x_sharding)
+        self.state, loss = self._step(self.state, x, y)
+        return float(loss)
+
+    def train_step_async(self, x, y) -> jax.Array:
+        """Like train_step but does not block on the loss transfer —
+        use in throughput benchmarks to keep the device queue full."""
+        x = jnp.asarray(x)
+        y = jnp.asarray(y)
+        if self._x_sharding is not None:
+            x = jax.device_put(x, self._x_sharding)
+            y = jax.device_put(y, self._x_sharding)
+        self.state, loss = self._step(self.state, x, y)
+        return loss
+
+    @property
+    def params(self) -> Tuple[Any, ...]:
+        return self.state.params

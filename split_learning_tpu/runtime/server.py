@@ -33,7 +33,14 @@ from split_learning_tpu.utils.config import Config
 
 
 class ProtocolError(RuntimeError):
-    """Step-handshake violation (non-monotonic client step)."""
+    """Permanent protocol violation (mode mismatch, step replay, unknown
+    residual). ``status`` carries the HTTP status the wire transport maps
+    it to: 400 = mode guard (reference behavior, src/server_part.py:31-36),
+    409 = handshake/state conflict."""
+
+    def __init__(self, message: str, status: int = 409) -> None:
+        super().__init__(message)
+        self.status = status
 
 
 class ServerRuntime:
@@ -117,7 +124,8 @@ class ServerRuntime:
                    step: int) -> Tuple[np.ndarray, float]:
         if self.mode != "split":
             # mode guard ≡ HTTP 400 (ref src/server_part.py:31-36)
-            raise ProtocolError(f"split_step called in mode {self.mode!r}")
+            raise ProtocolError(
+                f"split_step called in mode {self.mode!r}", status=400)
         with self._lock:
             self._check_step(step)
             self.state, g_acts, loss = self._split_step(
@@ -132,7 +140,8 @@ class ServerRuntime:
 
     def u_forward(self, activations: np.ndarray, step: int) -> np.ndarray:
         if self.mode != "u_split":
-            raise ProtocolError(f"u_forward called in mode {self.mode!r}")
+            raise ProtocolError(
+                f"u_forward called in mode {self.mode!r}", status=400)
         with self._lock:
             self._check_step(step)
             acts = jnp.asarray(activations)
@@ -145,7 +154,8 @@ class ServerRuntime:
 
     def u_backward(self, feat_grads: np.ndarray, step: int) -> np.ndarray:
         if self.mode != "u_split":
-            raise ProtocolError(f"u_backward called in mode {self.mode!r}")
+            raise ProtocolError(
+                f"u_backward called in mode {self.mode!r}", status=400)
         with self._lock:
             acts = self._u_residual.pop(step, None)
             if acts is None:
@@ -158,7 +168,8 @@ class ServerRuntime:
     def aggregate(self, params: Any, epoch: int, loss: float,
                   step: int) -> Any:
         if self.mode != "federated":
-            raise ProtocolError(f"aggregate called in mode {self.mode!r}")
+            raise ProtocolError(
+                f"aggregate called in mode {self.mode!r}", status=400)
         # submit() blocks until the FedAvg round is full — it must run
         # OUTSIDE the runtime lock or concurrent clients deadlock.
         mean_params = self._agg.submit(params)
@@ -195,12 +206,13 @@ class FedAvgAggregator:
 
     def submit(self, params: Any, timeout: float = 120.0) -> Any:
         """Blocks until the round is full, then returns the mean pytree."""
-        with self._cond:
+        entry = (object(), params)  # unique token: a retry after timeout
+        with self._cond:            # must not leave a stale double-count
             round_id = self._round
-            self._pending.append(params)
+            self._pending.append(entry)
             if len(self._pending) >= self.num_clients:
                 stacked = [jax.tree_util.tree_map(jnp.asarray, p)
-                           for p in self._pending]
+                           for _, p in self._pending]
                 self._result = jax.tree_util.tree_map(
                     lambda *xs: jnp.mean(jnp.stack(xs), axis=0), *stacked)
                 self._pending = []
@@ -209,6 +221,8 @@ class FedAvgAggregator:
             else:
                 if not self._cond.wait_for(
                         lambda: self._round != round_id, timeout=timeout):
+                    self._pending = [e for e in self._pending
+                                     if e[0] is not entry[0]]
                     raise TimeoutError(
                         f"FedAvg round incomplete: {len(self._pending)}/"
                         f"{self.num_clients} clients reported")

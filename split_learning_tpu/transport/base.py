@@ -58,6 +58,11 @@ class TransportStats:
             self.total_seconds += seconds
             self._latencies.append(seconds)
 
+    def add_bytes(self, sent: int = 0, received: int = 0) -> None:
+        with self._lock:
+            self.bytes_sent += sent
+            self.bytes_received += received
+
     def percentile(self, q: float) -> float:
         with self._lock:
             if not self._latencies:

@@ -1,0 +1,186 @@
+"""HTTP transport — the reference-shaped wire protocol, made safe.
+
+Route layout mirrors the reference server exactly (for conceptual parity
+and latency baselining): ``POST /forward_pass`` (``src/server_part.py:25``),
+``POST /aggregate_weights`` (``src/server_part.py:60``), ``GET /health``
+(``src/server_part.py:95``), plus ``/u_forward``/``/u_backward`` for the
+U-shaped mode. Bodies are raw octet streams like the reference
+(``src/server_part.py:58,93``) but encoded with the msgpack codec instead
+of pickle (the reference's pickle wire format is insecure by design —
+SURVEY.md §2 "must not be reproduced").
+
+Status mapping: 400 = mode guard (reference behavior,
+``src/server_part.py:31-36``), 409 = step-handshake violation (permanent),
+500 = server fault (transient). The client raises ProtocolError for
+400/409 and TransportError otherwise, preserving the permanent/transient
+split the failure policies rely on.
+
+Server runs the same ServerRuntime as every other transport — one step
+logic, N wire formats (SURVEY.md §7 layering).
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+import requests
+
+from split_learning_tpu.transport import codec
+from split_learning_tpu.transport.base import Transport, TransportError, timed
+
+
+class SplitHTTPServer:
+    """Serves a ServerRuntime over HTTP (stdlib; no FastAPI dependency)."""
+
+    def __init__(self, runtime: Any, host: str = "127.0.0.1",
+                 port: int = 0) -> None:
+        self.runtime = runtime
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # quiet: the reference leans on uvicorn access logs; we expose
+            # stats through TransportStats instead
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, status: int, body: bytes,
+                       ctype: str = "application/octet-stream") -> None:
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    self._reply(200, codec.encode(outer.runtime.health()))
+                else:
+                    self._reply(404, codec.encode({"error": "not found"}))
+
+            def do_POST(self):
+                from split_learning_tpu.runtime.server import ProtocolError
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length)
+                try:
+                    req = codec.decode(raw)
+                    if self.path == "/forward_pass":
+                        grads, loss = outer.runtime.split_step(
+                            req["activations"], req["labels"], int(req["step"]))
+                        body = codec.encode(
+                            {"grads": grads, "loss": loss, "step": req["step"]})
+                    elif self.path == "/u_forward":
+                        feats = outer.runtime.u_forward(
+                            req["activations"], int(req["step"]))
+                        body = codec.encode({"features": feats})
+                    elif self.path == "/u_backward":
+                        g = outer.runtime.u_backward(
+                            req["feat_grads"], int(req["step"]))
+                        body = codec.encode({"grads": g})
+                    elif self.path == "/aggregate_weights":
+                        agg = outer.runtime.aggregate(
+                            req["model_state"], int(req["epoch"]),
+                            float(req["loss"]), int(req["step"]))
+                        body = codec.encode({"model_state": agg})
+                    else:
+                        self._reply(404, codec.encode({"error": "not found"}))
+                        return
+                    self._reply(200, body)
+                except ProtocolError as exc:
+                    self._reply(exc.status, codec.encode({"error": str(exc)}))
+                except Exception as exc:  # noqa: BLE001 — server must not die
+                    self._reply(500, codec.encode({"error": str(exc)}))
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._httpd.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "SplitHTTPServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+class HttpTransport(Transport):
+    """Client side: blocking POSTs like the reference client
+    (``src/client_part.py:125,186``), with permanent/transient error
+    classification instead of silent batch drops."""
+
+    def __init__(self, base_url: str, timeout: float = 60.0) -> None:
+        super().__init__()
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        self._session = requests.Session()
+
+    def _post(self, path: str, payload: Dict[str, Any]) -> Dict[str, Any]:
+        from split_learning_tpu.runtime.server import ProtocolError
+        body = codec.encode(payload)
+        try:
+            resp = self._session.post(
+                f"{self.base_url}{path}", data=body, timeout=self.timeout,
+                headers={"Content-Type": "application/octet-stream"})
+        except requests.RequestException as exc:
+            raise TransportError(f"POST {path} failed: {exc}") from exc
+        self.stats.add_bytes(sent=len(body), received=len(resp.content))
+        if resp.status_code in (400, 409):
+            raise ProtocolError(codec.decode(resp.content).get("error", ""))
+        if resp.status_code != 200:
+            raise TransportError(
+                f"POST {path} -> {resp.status_code}: {resp.content[:200]!r}")
+        return codec.decode(resp.content)
+
+    def split_step(self, activations: np.ndarray, labels: np.ndarray,
+                   step: int) -> Tuple[np.ndarray, float]:
+        with timed(self.stats):
+            out = self._post("/forward_pass", {
+                "activations": np.asarray(activations),
+                "labels": np.asarray(labels),
+                "step": step,
+            })
+            return out["grads"], float(out["loss"])
+
+    def u_forward(self, activations: np.ndarray, step: int) -> np.ndarray:
+        with timed(self.stats):
+            return self._post("/u_forward", {
+                "activations": np.asarray(activations), "step": step,
+            })["features"]
+
+    def u_backward(self, feat_grads: np.ndarray, step: int) -> np.ndarray:
+        with timed(self.stats):
+            return self._post("/u_backward", {
+                "feat_grads": np.asarray(feat_grads), "step": step,
+            })["grads"]
+
+    def aggregate(self, params: Any, epoch: int, loss: float, step: int) -> Any:
+        with timed(self.stats):
+            return self._post("/aggregate_weights", {
+                "model_state": params, "epoch": epoch,
+                "loss": loss, "step": step,
+            })["model_state"]
+
+    def health(self) -> Dict[str, Any]:
+        try:
+            resp = self._session.get(f"{self.base_url}/health",
+                                     timeout=self.timeout)
+        except requests.RequestException as exc:
+            raise TransportError(f"GET /health failed: {exc}") from exc
+        if resp.status_code != 200:
+            raise TransportError(
+                f"GET /health -> {resp.status_code}: {resp.content[:200]!r}")
+        return codec.decode(resp.content)
+
+    def close(self) -> None:
+        self._session.close()

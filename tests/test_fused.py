@@ -1,0 +1,81 @@
+"""Fused SPMD trainer: must equal the MPMD transport path numerically, scale
+over the data axis, and keep microbatch accumulation equivalent
+(SURVEY.md §4 item 4: mesh tests on the 8-device virtual CPU backend)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from split_learning_tpu.models import get_plan
+from split_learning_tpu.parallel import make_mesh
+from split_learning_tpu.runtime import ServerRuntime, SplitClientTrainer
+from split_learning_tpu.runtime.fused import FusedSplitTrainer
+from split_learning_tpu.transport import LocalTransport
+from split_learning_tpu.utils import Config
+
+SEED = 3
+BATCH = 32
+N_STEPS = 6
+
+
+def batches():
+    rs = np.random.RandomState(9)
+    return [(rs.randn(BATCH, 28, 28, 1).astype(np.float32),
+             rs.randint(0, 10, (BATCH,)).astype(np.int64))
+            for _ in range(N_STEPS)]
+
+
+def test_fused_equals_transport_path():
+    """The in-XLA cut-layer exchange and the explicit transport exchange
+    are the same computation."""
+    cfg = Config(mode="split", batch_size=BATCH)
+    plan = get_plan(mode="split")
+    data = batches()
+
+    fused = FusedSplitTrainer(plan, cfg, jax.random.PRNGKey(SEED), data[0][0])
+    fused_losses = [fused.train_step(x, y) for x, y in data]
+
+    server = ServerRuntime(plan, cfg, jax.random.PRNGKey(SEED), data[0][0])
+    client = SplitClientTrainer(plan, cfg, jax.random.PRNGKey(SEED),
+                                LocalTransport(server))
+    mpmd_losses = [client.train_step(x, y, i) for i, (x, y) in enumerate(data)]
+
+    np.testing.assert_allclose(fused_losses, mpmd_losses, rtol=1e-5, atol=1e-6)
+
+
+def test_fused_dp_mesh_matches_single_device(devices):
+    """Config 3: batch sharded over 4 data-parallel clients with psum
+    gradient aggregation must equal single-device training."""
+    cfg = Config(mode="split", batch_size=BATCH, num_clients=4)
+    plan = get_plan(mode="split")
+    data = batches()
+
+    mesh = make_mesh(num_clients=4, num_stages=1, devices=devices[:4])
+    dp = FusedSplitTrainer(plan, cfg, jax.random.PRNGKey(SEED), data[0][0],
+                           mesh=mesh)
+    dp_losses = [dp.train_step(x, y) for x, y in data]
+
+    single = FusedSplitTrainer(plan, cfg, jax.random.PRNGKey(SEED), data[0][0])
+    single_losses = [single.train_step(x, y) for x, y in data]
+
+    np.testing.assert_allclose(dp_losses, single_losses, rtol=1e-4, atol=1e-5)
+    # params stay replicated and identical to the single-device run
+    for a, b in zip(jax.tree_util.tree_leaves(dp.params),
+                    jax.tree_util.tree_leaves(single.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_microbatched_matches_full_batch():
+    """Config 4 groundwork: scan-accumulated microbatch gradients equal the
+    full-batch gradient (mean-of-means with equal microbatch sizes)."""
+    plan = get_plan(mode="split")
+    data = batches()
+    full = FusedSplitTrainer(plan, Config(mode="split", batch_size=BATCH),
+                             jax.random.PRNGKey(SEED), data[0][0])
+    micro = FusedSplitTrainer(
+        plan, Config(mode="split", batch_size=BATCH, microbatches=4),
+        jax.random.PRNGKey(SEED), data[0][0])
+    f_losses = [full.train_step(x, y) for x, y in data]
+    m_losses = [micro.train_step(x, y) for x, y in data]
+    np.testing.assert_allclose(f_losses, m_losses, rtol=1e-5, atol=1e-6)
