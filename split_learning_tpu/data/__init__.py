@@ -1,0 +1,16 @@
+from split_learning_tpu.data.datasets import (
+    Dataset,
+    DatasetStore,
+    LocalStore,
+    S3Store,
+    Split,
+    batches,
+    epoch_steps,
+    load_dataset,
+    synthetic,
+)
+
+__all__ = [
+    "Dataset", "Split", "DatasetStore", "LocalStore", "S3Store",
+    "load_dataset", "synthetic", "batches", "epoch_steps",
+]

@@ -1,0 +1,346 @@
+"""Datasets with pluggable caching — the C6/C7 analog (SURVEY.md §2).
+
+The reference's data path (``src/client_part.py:20-98``): probe an S3 cache,
+download on hit, torchvision-download + upload on miss, normalize MNIST with
+(0.1307, 0.3081), then DataLoader(batch=64, shuffle=True).
+
+Here the same capability, TPU-first and network-optional:
+- a :class:`DatasetStore` protocol with Local and S3 backends (S3 is
+  gated on boto3 being importable; the probe/download/upload/404 semantics
+  mirror ``src/client_part.py:39-95``),
+- loaders for real MNIST (IDX files) and CIFAR-10 (binary batches) parsed
+  with numpy — no torchvision, no pickle,
+- a deterministic synthetic fallback for hermetic/zero-egress environments
+  (class-conditional Gaussian images, so training visibly learns),
+- a shuffling batcher ≡ DataLoader(batch, shuffle=True) with seeded order.
+
+Arrays are NHWC float32, normalized like the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import gzip
+import os
+import struct
+import tarfile
+from typing import Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+MNIST_MEAN, MNIST_STD = 0.1307, 0.3081  # src/client_part.py:61-64
+CIFAR_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+CIFAR_STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
+
+
+@dataclasses.dataclass
+class Split:
+    x: np.ndarray  # [N, H, W, C] float32, normalized
+    y: np.ndarray  # [N] int64
+
+    def __len__(self) -> int:
+        return len(self.y)
+
+
+@dataclasses.dataclass
+class Dataset:
+    train: Split
+    test: Split
+    name: str
+    num_classes: int
+    synthetic: bool = False
+
+
+# --------------------------------------------------------------------- #
+# stores (the reference's S3 cache boundary, pluggable)
+
+class DatasetStore:
+    """Cache backend: probe / fetch / put of opaque blobs."""
+
+    def exists(self, key: str) -> bool:
+        raise NotImplementedError
+
+    def fetch(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def put(self, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+
+class LocalStore(DatasetStore):
+    """Filesystem cache (the off-cluster default)."""
+
+    def __init__(self, root: str) -> None:
+        self.root = os.path.expanduser(root)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, key)
+
+    def exists(self, key: str) -> bool:
+        return os.path.exists(self._path(key))
+
+    def fetch(self, key: str) -> bytes:
+        with open(self._path(key), "rb") as f:
+            return f.read()
+
+    def put(self, key: str, data: bytes) -> None:
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+
+class S3Store(DatasetStore):
+    """S3/SeaweedFS cache ≡ src/client_part.py:28-34 (boto3-gated).
+
+    head_object probe, 404 -> miss, other errors re-raised — the exact
+    error discipline of src/client_part.py:39-95."""
+
+    def __init__(self, endpoint: str, access_key: str, secret_key: str,
+                 bucket: str) -> None:
+        try:
+            import boto3  # noqa: PLC0415
+            from botocore.exceptions import ClientError  # noqa: PLC0415
+        except ImportError as exc:
+            raise ImportError(
+                "S3Store requires boto3; install it or use LocalStore") from exc
+        self._ClientError = ClientError
+        self.bucket = bucket
+        self.client = boto3.client(
+            "s3", endpoint_url=endpoint,
+            aws_access_key_id=access_key, aws_secret_access_key=secret_key)
+
+    def exists(self, key: str) -> bool:
+        try:
+            self.client.head_object(Bucket=self.bucket, Key=key)
+            return True
+        except self._ClientError as exc:
+            if exc.response["Error"]["Code"] in ("404", "NoSuchKey"):
+                return False
+            raise  # non-404 re-raised, ≡ src/client_part.py:94-95
+
+    def fetch(self, key: str) -> bytes:
+        import io
+        buf = io.BytesIO()
+        self.client.download_fileobj(self.bucket, key, buf)
+        return buf.getvalue()
+
+    def put(self, key: str, data: bytes) -> None:
+        import io
+        self.client.upload_fileobj(io.BytesIO(data), self.bucket, key)
+
+
+# --------------------------------------------------------------------- #
+# npz blob codec for the cache (no pickle; ≡ the reference's .pkl blob)
+
+def _to_blob(ds: Dataset) -> bytes:
+    import io
+    buf = io.BytesIO()
+    np.savez_compressed(
+        buf, train_x=ds.train.x, train_y=ds.train.y,
+        test_x=ds.test.x, test_y=ds.test.y,
+        meta=np.array([ds.num_classes, int(ds.synthetic)], np.int64))
+    return buf.getvalue()
+
+
+def _from_blob(name: str, data: bytes) -> Dataset:
+    import io
+    with np.load(io.BytesIO(data), allow_pickle=False) as z:
+        meta = z["meta"]
+        return Dataset(
+            train=Split(z["train_x"], z["train_y"]),
+            test=Split(z["test_x"], z["test_y"]),
+            name=name, num_classes=int(meta[0]), synthetic=bool(meta[1]))
+
+
+# --------------------------------------------------------------------- #
+# raw-format parsers (numpy-only)
+
+def _read_idx_images(raw: bytes) -> np.ndarray:
+    magic, n, rows, cols = struct.unpack(">IIII", raw[:16])
+    if magic != 0x803:
+        raise ValueError(f"bad IDX image magic {magic:#x}")
+    return np.frombuffer(raw, np.uint8, offset=16).reshape(n, rows, cols, 1)
+
+
+def _read_idx_labels(raw: bytes) -> np.ndarray:
+    magic, n = struct.unpack(">II", raw[:8])
+    if magic != 0x801:
+        raise ValueError(f"bad IDX label magic {magic:#x}")
+    return np.frombuffer(raw, np.uint8, offset=8).astype(np.int64)
+
+
+def _maybe_gunzip(raw: bytes) -> bytes:
+    return gzip.decompress(raw) if raw[:2] == b"\x1f\x8b" else raw
+
+
+def load_mnist_idx(data_dir: str) -> Optional[Dataset]:
+    """Load MNIST from IDX files if present under data_dir (any of the
+    usual names, optionally gzipped); None if absent."""
+    names = {
+        "train_x": ["train-images-idx3-ubyte", "train-images.idx3-ubyte"],
+        "train_y": ["train-labels-idx1-ubyte", "train-labels.idx1-ubyte"],
+        "test_x": ["t10k-images-idx3-ubyte", "t10k-images.idx3-ubyte"],
+        "test_y": ["t10k-labels-idx1-ubyte", "t10k-labels.idx1-ubyte"],
+    }
+    found: Dict[str, bytes] = {}
+    for part, cands in names.items():
+        for cand in cands:
+            for suffix in ("", ".gz"):
+                p = os.path.join(os.path.expanduser(data_dir), cand + suffix)
+                if os.path.exists(p):
+                    with open(p, "rb") as f:
+                        found[part] = _maybe_gunzip(f.read())
+                    break
+            if part in found:
+                break
+        if part not in found:
+            return None
+
+    def norm(img: np.ndarray) -> np.ndarray:
+        x = img.astype(np.float32) / 255.0
+        return (x - MNIST_MEAN) / MNIST_STD
+
+    return Dataset(
+        train=Split(norm(_read_idx_images(found["train_x"])),
+                    _read_idx_labels(found["train_y"])),
+        test=Split(norm(_read_idx_images(found["test_x"])),
+                   _read_idx_labels(found["test_y"])),
+        name="mnist", num_classes=10)
+
+
+def load_cifar10_binary(data_dir: str) -> Optional[Dataset]:
+    """Load CIFAR-10 from the binary distribution (data_batch_*.bin /
+    cifar-10-binary.tar.gz) if present; None if absent. No pickle."""
+    root = os.path.expanduser(data_dir)
+    bin_dir = None
+    for cand in (root, os.path.join(root, "cifar-10-batches-bin")):
+        if os.path.exists(os.path.join(cand, "data_batch_1.bin")):
+            bin_dir = cand
+            break
+    raws: Dict[str, bytes] = {}
+    if bin_dir is not None:
+        for i in range(1, 6):
+            with open(os.path.join(bin_dir, f"data_batch_{i}.bin"), "rb") as f:
+                raws[f"b{i}"] = f.read()
+        with open(os.path.join(bin_dir, "test_batch.bin"), "rb") as f:
+            raws["test"] = f.read()
+    else:
+        tar_path = os.path.join(root, "cifar-10-binary.tar.gz")
+        if not os.path.exists(tar_path):
+            return None
+        import re
+        with tarfile.open(tar_path, "r:gz") as tar:
+            for m in tar.getmembers():
+                base = os.path.basename(m.name)
+                match = re.fullmatch(r"data_batch_(\d)\.bin", base)
+                if match:
+                    raws[f"b{match.group(1)}"] = tar.extractfile(m).read()
+                elif base == "test_batch.bin":
+                    raws["test"] = tar.extractfile(m).read()
+        if len(raws) != 6:
+            return None
+
+    def parse(raw: bytes) -> Tuple[np.ndarray, np.ndarray]:
+        rec = np.frombuffer(raw, np.uint8).reshape(-1, 3073)
+        y = rec[:, 0].astype(np.int64)
+        x = rec[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        x = x.astype(np.float32) / 255.0
+        return (x - CIFAR_MEAN) / CIFAR_STD, y
+
+    xs, ys = zip(*(parse(raws[f"b{i}"]) for i in range(1, 6)))
+    tx, ty = parse(raws["test"])
+    return Dataset(
+        train=Split(np.concatenate(xs), np.concatenate(ys)),
+        test=Split(tx, ty), name="cifar10", num_classes=10)
+
+
+# --------------------------------------------------------------------- #
+# synthetic fallback (zero-egress environments)
+
+_SHAPES = {"mnist": (28, 28, 1), "cifar10": (32, 32, 3)}
+
+
+def synthetic(name: str, n_train: int = 4096, n_test: int = 512,
+              num_classes: int = 10, seed: int = 0) -> Dataset:
+    """Class-conditional Gaussian images, deterministic, learnable."""
+    h, w, c = _SHAPES.get(name, (28, 28, 1))
+    rs = np.random.RandomState(seed)
+    centers = rs.randn(num_classes, h * w * c).astype(np.float32)
+
+    def make(n: int, rs: np.random.RandomState) -> Split:
+        y = rs.randint(0, num_classes, (n,)).astype(np.int64)
+        x = centers[y] + 0.5 * rs.randn(n, h * w * c).astype(np.float32)
+        return Split(x.reshape(n, h, w, c), y)
+
+    return Dataset(train=make(n_train, rs), test=make(n_test, rs),
+                   name=name, num_classes=num_classes, synthetic=True)
+
+
+# --------------------------------------------------------------------- #
+# the C6-shaped load path: cache probe -> hit/miss -> raw load or synthetic
+
+def load_dataset(name: str, data_dir: str,
+                 store: Optional[DatasetStore] = None,
+                 allow_synthetic: bool = True) -> Dataset:
+    """Cache-first dataset load, mirroring src/client_part.py:36-98:
+    probe the store; on hit, fetch the prepared blob; on miss, build from
+    raw files (or synthesize) and upload the blob for next time.
+
+    Real and synthetic data use distinct cache keys, so a synthetic blob
+    cached in a data-less environment never shadows real files that appear
+    later, and ``allow_synthetic=False`` can never be satisfied by a
+    synthetic cache entry."""
+    if store is None:
+        store = LocalStore(os.path.join(data_dir, "cache"))
+    real_key = f"datasets/{name}.npz"
+    synth_key = f"datasets/{name}-synthetic.npz"
+
+    if store.exists(real_key):
+        return _from_blob(name, store.fetch(real_key))
+
+    if name == "mnist":
+        ds = load_mnist_idx(data_dir)
+    elif name == "cifar10":
+        ds = load_cifar10_binary(data_dir)
+    elif name == "synthetic":
+        ds = None
+    else:
+        raise ValueError(f"Unknown dataset: {name!r}")
+    if ds is not None:
+        store.put(real_key, _to_blob(ds))
+        return ds
+
+    if not allow_synthetic:
+        raise FileNotFoundError(
+            f"no raw {name} files under {data_dir} and synthetic "
+            "fallback disabled")
+    if store.exists(synth_key):
+        return _from_blob(name, store.fetch(synth_key))
+    ds = synthetic("mnist" if name == "synthetic" else name)
+    store.put(synth_key, _to_blob(ds))
+    return ds
+
+
+# --------------------------------------------------------------------- #
+# batcher ≡ DataLoader(batch_size=64, shuffle=True) (src/client_part.py:98)
+
+def batches(split: Split, batch_size: int, seed: int = 0, *,
+            shuffle: bool = True,
+            drop_remainder: bool = False) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Seeded shuffling batcher. With drop_remainder=False the final
+    partial batch is emitted (the reference's 938th MNIST step)."""
+    n = len(split)
+    idx = np.arange(n)
+    if shuffle:
+        np.random.RandomState(seed).shuffle(idx)
+    stop = n - (n % batch_size) if drop_remainder else n
+    for lo in range(0, stop, batch_size):
+        sel = idx[lo:lo + batch_size]
+        yield split.x[sel], split.y[sel]
+
+
+def epoch_steps(n: int, batch_size: int, drop_remainder: bool = False) -> int:
+    return n // batch_size if drop_remainder else -(-n // batch_size)

@@ -1,0 +1,214 @@
+"""CLI entry points — the L3/L0 analog of the reference's process commands.
+
+Reference entry points (SURVEY.md §1): ``python client_part.py``
+(``k8s/split-learning.yaml:63``) and ``uvicorn server_part:app``
+(``k8s/split-learning.yaml:34``), wired by env vars. Here one CLI:
+
+  python -m split_learning_tpu.launch.run train \
+      --mode split --transport fused --dataset synthetic --steps 100
+  python -m split_learning_tpu.launch.run serve --mode split --port 8000
+  python -m split_learning_tpu.launch.run train --transport http \
+      --server-url http://host:8000
+
+Config resolution: CLI flags > env vars (LEARNING_MODE etc.) > defaults —
+one place, no hard-coded endpoints (the reference's URI-shadowing bug,
+``src/server_part.py:19``, is structurally impossible here).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import Optional
+
+import numpy as np
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--mode", choices=["split", "federated", "u_split"],
+                   default=None)
+    p.add_argument("--model", default=None, help="split_cnn | resnet18")
+    p.add_argument("--dataset", default=None,
+                   help="mnist | cifar10 | synthetic")
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--epochs", type=int, default=None)
+    p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--tracking", default=None,
+                   help="stdout | jsonl | mlflow | noop")
+    p.add_argument("--tracking-uri", default=None)
+
+
+def _config_from_args(args) -> "Config":
+    from split_learning_tpu.utils import Config
+    overrides = {}
+    for field in ("mode", "model", "dataset", "batch_size", "epochs", "lr",
+                  "seed", "data_dir", "tracking", "tracking_uri"):
+        val = getattr(args, field, None)
+        if val is not None:
+            overrides[field] = val
+    for field in ("transport", "num_clients", "num_stages", "microbatches",
+                  "server_url"):
+        val = getattr(args, field, None)
+        if val is not None:
+            overrides[field] = val
+    return Config.from_env(**overrides)
+
+
+def cmd_train(args) -> int:
+    import jax
+
+    from split_learning_tpu.data import batches, load_dataset
+    from split_learning_tpu.models import get_plan
+    from split_learning_tpu.tracking import make_logger
+    from split_learning_tpu.runtime import (
+        FederatedClientTrainer, ServerRuntime, SplitClientTrainer,
+        USplitClientTrainer)
+    from split_learning_tpu.transport import LocalTransport
+    from split_learning_tpu.utils import Config
+
+    cfg = _config_from_args(args)
+    plan = get_plan(model=cfg.model, mode=cfg.mode, dtype=cfg.dtype)
+    ds = load_dataset(cfg.dataset, cfg.data_dir,
+                      allow_synthetic=not args.require_real)
+    if ds.synthetic:
+        print(f"[data] using synthetic {ds.name} "
+              f"({len(ds.train)} train examples)", file=sys.stderr)
+    logger = make_logger(cfg)
+    rng = jax.random.PRNGKey(cfg.seed)
+    sample = ds.train.x[:cfg.batch_size]
+
+    max_steps = args.steps
+    _budget = {"n": max_steps if max_steps else None, "epoch": 0}
+
+    def data_iter():
+        # reshuffle per epoch ≡ DataLoader(shuffle=True); each call is one
+        # epoch, so derive the permutation seed from the epoch counter
+        epoch_seed = cfg.seed + _budget["epoch"]
+        _budget["epoch"] += 1
+
+        def gen():
+            for xy in batches(ds.train, cfg.batch_size, seed=epoch_seed,
+                              drop_remainder=True):
+                if _budget["n"] is not None:
+                    if _budget["n"] <= 0:
+                        return
+                    _budget["n"] -= 1
+                yield xy
+        return gen()
+
+    t0 = time.time()
+    n_steps = 0
+    final_loss = float("nan")
+
+    if args.transport in ("fused", "pipeline"):
+        from split_learning_tpu.parallel import make_mesh
+        if args.transport == "fused":
+            from split_learning_tpu.runtime.fused import FusedSplitTrainer
+            mesh = None
+            if cfg.num_clients > 1:
+                mesh = make_mesh(num_clients=cfg.num_clients, num_stages=1)
+            trainer = FusedSplitTrainer(plan, cfg, rng, sample, mesh=mesh)
+        else:
+            from split_learning_tpu.parallel.pipeline import PipelinedTrainer
+            mesh = make_mesh(num_clients=cfg.num_clients,
+                             num_stages=plan.num_stages)
+            trainer = PipelinedTrainer(plan, cfg, rng, sample, mesh)
+        step = 0
+        for epoch in range(cfg.epochs):  # step cap enforced by data_iter
+            for x, y in data_iter():
+                final_loss = trainer.train_step(x, y)
+                logger.log_metric("loss", final_loss, step=step)
+                step += 1
+        n_steps = step
+    else:
+        # MPMD path: a transport to a (possibly remote) server party
+        if args.transport == "http":
+            from split_learning_tpu.transport.http import HttpTransport
+            transport = HttpTransport(cfg.server_url)
+        else:
+            server = ServerRuntime(plan, cfg, jax.random.PRNGKey(cfg.seed),
+                                   sample)
+            transport = LocalTransport(server)
+        if cfg.mode == "split":
+            client = SplitClientTrainer(plan, cfg, rng, transport,
+                                        logger=logger)
+        elif cfg.mode == "u_split":
+            client = USplitClientTrainer(plan, cfg, rng, transport,
+                                         logger=logger)
+        else:
+            client = FederatedClientTrainer(plan, cfg, rng, transport,
+                                            logger=logger)
+        records = client.train(data_iter, epochs=cfg.epochs)
+        n_steps = len(records)
+        final_loss = records[-1].loss if records else float("nan")
+        print(f"[transport] {transport.stats.summary()}", file=sys.stderr)
+
+    dt = time.time() - t0
+    logger.close()
+    print(f"[done] mode={cfg.mode} transport={args.transport} "
+          f"steps={n_steps} final_loss={final_loss:.4f} "
+          f"({n_steps / dt:.2f} steps/s)")
+    return 0
+
+
+def cmd_serve(args) -> int:
+    import jax
+
+    from split_learning_tpu.models import get_plan
+    from split_learning_tpu.runtime import ServerRuntime
+    from split_learning_tpu.transport.http import SplitHTTPServer
+
+    from split_learning_tpu.data.datasets import _SHAPES
+
+    cfg = _config_from_args(args)
+    plan = get_plan(model=cfg.model, mode=cfg.mode, dtype=cfg.dtype)
+    shape = _SHAPES.get("mnist" if cfg.dataset == "synthetic" else cfg.dataset,
+                        (28, 28, 1))
+    sample = np.zeros((cfg.batch_size,) + shape, np.float32)
+    runtime = ServerRuntime(plan, cfg, jax.random.PRNGKey(cfg.seed), sample)
+    server = SplitHTTPServer(runtime, host=args.host, port=args.port).start()
+    print(f"[serve] mode={cfg.mode} listening on {server.url}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("[serve] shutting down")
+        server.stop()
+    return 0
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(prog="split_learning_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    pt = sub.add_parser("train", help="run a training client (or full sim)")
+    _add_common(pt)
+    pt.add_argument("--transport",
+                    choices=["local", "http", "fused", "pipeline"],
+                    default="fused")
+    pt.add_argument("--server-url", dest="server_url", default=None)
+    pt.add_argument("--steps", type=int, default=0,
+                    help="stop after N steps (0 = full epochs)")
+    pt.add_argument("--num-clients", dest="num_clients", type=int,
+                    default=None)
+    pt.add_argument("--microbatches", type=int, default=None)
+    pt.add_argument("--require-real", action="store_true",
+                    help="fail if real dataset files are absent instead of "
+                         "falling back to synthetic data")
+    pt.set_defaults(fn=cmd_train)
+
+    ps = sub.add_parser("serve", help="serve the server party over HTTP")
+    _add_common(ps)
+    ps.add_argument("--host", default="0.0.0.0")
+    ps.add_argument("--port", type=int, default=8000)
+    ps.set_defaults(fn=cmd_serve)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

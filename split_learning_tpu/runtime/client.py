@@ -26,6 +26,7 @@ here the policy is configurable — "raise" (default), "retry" (bounded), or
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
@@ -60,13 +61,17 @@ class SplitClientTrainer:
                  transport: Transport,
                  failure_policy: str = FailurePolicy.RAISE,
                  max_retries: int = 3,
-                 logger: Optional[Any] = None) -> None:
+                 logger: Optional[Any] = None,
+                 profiler: Optional[Any] = None) -> None:
         self.plan = plan
         self.cfg = cfg
         self.transport = transport
         self.failure_policy = failure_policy
         self.max_retries = max_retries
         self.logger = logger
+        self.profiler = profiler  # PhaseProfiler: compute-vs-transport split
+        self._phase = (profiler.phase if profiler is not None
+                       else (lambda _name: contextlib.nullcontext()))
         self.dropped_batches = 0
 
         client_idx = plan.stages_of("client")
@@ -98,14 +103,20 @@ class SplitClientTrainer:
                    step: int) -> Optional[float]:
         """One split step; returns the loss, or None if the batch was
         dropped under the 'skip' policy."""
+        prof = self.profiler
+        phase = self._phase
+
         self.ensure_init(x)
-        acts = self._fwd(self.state.params, jnp.asarray(x))
+        with phase("compute_fwd"):
+            acts = self._fwd(self.state.params, jnp.asarray(x))
+            acts_host = np.asarray(acts)
 
         attempt = 0
         while True:
             try:
-                g_acts, loss = self.transport.split_step(
-                    np.asarray(acts), np.asarray(y), step)
+                with phase("transport"):
+                    g_acts, loss = self.transport.split_step(
+                        acts_host, np.asarray(y), step)
                 break
             except TransportError:
                 attempt += 1
@@ -119,9 +130,12 @@ class SplitClientTrainer:
                     return None
                 raise
 
-        g_params = self._bwd(self.state.params, jnp.asarray(x),
-                             jnp.asarray(g_acts))
-        self.state = apply_grads(self._tx, self.state, g_params)
+        with phase("compute_bwd"):
+            g_params = self._bwd(self.state.params, jnp.asarray(x),
+                                 jnp.asarray(g_acts))
+            self.state = apply_grads(self._tx, self.state, g_params)
+            if prof is not None:  # sync only when timing accuracy matters
+                jax.block_until_ready(self.state.params)
         return loss
 
     def train(self, data_iter: Callable[[], Iterable[Tuple[np.ndarray, np.ndarray]]],

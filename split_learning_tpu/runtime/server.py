@@ -181,6 +181,19 @@ class ServerRuntime:
             self._last_step = step
         return mean_params
 
+    def resume_from(self, state: TrainState, step: int) -> None:
+        """Adopt a restored TrainState and re-arm the handshake so the
+        next client step must be ``step`` or later (checkpoint/resume
+        protocol — SURVEY.md §5)."""
+        with self._lock:
+            self.state = state
+            self._last_step = step - 1
+            self._u_residual.clear()
+            if self._agg is not None:
+                # drop any pre-restore FedAvg submissions: averaging stale
+                # params into the first post-restore round would corrupt it
+                self._agg = FedAvgAggregator(self._agg.num_clients)
+
     def health(self) -> Dict[str, Any]:
         model_type = ("FullModel" if self.mode == "federated"
                       else self.plan.stages[self.plan.stages_of('server')[0]].name)

@@ -1,0 +1,15 @@
+from split_learning_tpu.tracking.logger import (
+    JsonlLogger,
+    MetricLogger,
+    MlflowLogger,
+    MultiLogger,
+    NoopLogger,
+    StdoutLogger,
+    experiment_name,
+    make_logger,
+)
+
+__all__ = [
+    "MetricLogger", "NoopLogger", "StdoutLogger", "JsonlLogger",
+    "MlflowLogger", "MultiLogger", "make_logger", "experiment_name",
+]

@@ -1,0 +1,173 @@
+"""Experiment tracking — the C14 analog (SURVEY.md §2), MLflow-compatible.
+
+The reference logs server-side only, to experiment
+``f"{mode.capitalize()}_Learning_Sim"`` with metric key ``loss`` at a
+client-authoritative step (``src/server_part.py:18-23,55,86-87``), and
+hard-codes the tracking URI, silently shadowing the env var
+(``src/server_part.py:19`` — the bug SURVEY.md §3.3 says not to reproduce).
+
+Here: one MetricLogger protocol, four backends —
+- MlflowLogger: same experiment names and metric keys as the reference
+  (the parity check in the north star), URI from config only; gated on
+  mlflow being importable,
+- JsonlLogger: newline-delimited JSON records (the off-cluster default
+  artifact),
+- StdoutLogger: ≡ the reference's per-10-step progress prints
+  (``src/client_part.py:135-136``),
+- NoopLogger.
+
+``make_logger(cfg)`` dispatches; MultiLogger fans out to several.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from split_learning_tpu.utils.config import Config
+
+
+def experiment_name(mode: str) -> str:
+    """≡ f"{mode.capitalize()}_Learning_Sim" (src/server_part.py:20-21);
+    u_split logs to the split experiment (same protocol family)."""
+    base = "split" if mode == "u_split" else mode
+    return f"{base.capitalize()}_Learning_Sim"
+
+
+class MetricLogger:
+    def log_metric(self, key: str, value: float, step: int) -> None:
+        raise NotImplementedError
+
+    def log_params(self, params: Dict[str, Any]) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self) -> "MetricLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class NoopLogger(MetricLogger):
+    def log_metric(self, key: str, value: float, step: int) -> None:
+        pass
+
+
+class StdoutLogger(MetricLogger):
+    """Progress prints ≡ src/client_part.py:135-136 (every Nth step)."""
+
+    def __init__(self, every: int = 10, stream=None) -> None:
+        self.every = every
+        self.stream = stream or sys.stdout
+
+    def log_metric(self, key: str, value: float, step: int) -> None:
+        if step % self.every == 0:
+            print(f"[step {step}] {key}: {value:.4f}", file=self.stream)
+
+    def log_params(self, params: Dict[str, Any]) -> None:
+        print(f"[params] {params}", file=self.stream)
+
+
+class JsonlLogger(MetricLogger):
+    def __init__(self, path: str, experiment: str = "", run_name: str = "") -> None:
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._f = open(path, "a", buffering=1)
+        self.experiment = experiment
+        self.run_name = run_name
+
+    def log_metric(self, key: str, value: float, step: int) -> None:
+        self._f.write(json.dumps({
+            "ts": time.time(), "experiment": self.experiment,
+            "run": self.run_name, "key": key,
+            "value": float(value), "step": int(step)}) + "\n")
+
+    def log_params(self, params: Dict[str, Any]) -> None:
+        self._f.write(json.dumps({
+            "ts": time.time(), "experiment": self.experiment,
+            "run": self.run_name, "params": params}) + "\n")
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class MlflowLogger(MetricLogger):
+    """Same experiment/metric naming as the reference server; tracking URI
+    comes from config (never hard-coded — fixing src/server_part.py:19)."""
+
+    def __init__(self, mode: str, tracking_uri: Optional[str] = None,
+                 run_name: Optional[str] = None) -> None:
+        try:
+            import mlflow  # noqa: PLC0415
+        except ImportError as exc:
+            raise ImportError(
+                "MlflowLogger requires mlflow; use tracking='jsonl' or "
+                "'stdout' off-cluster") from exc
+        self._mlflow = mlflow
+        if tracking_uri:
+            mlflow.set_tracking_uri(tracking_uri)
+        mlflow.set_experiment(experiment_name(mode))
+        base = "split" if mode == "u_split" else mode
+        # run per training lifetime ≡ src/server_part.py:23, but closed
+        # properly by close()
+        self._run = mlflow.start_run(
+            run_name=run_name or f"{base.capitalize()}_Training")
+
+    def log_metric(self, key: str, value: float, step: int) -> None:
+        self._mlflow.log_metric(key, value, step=step)
+
+    def log_params(self, params: Dict[str, Any]) -> None:
+        self._mlflow.log_params(params)
+
+    def log_artifact(self, path: str) -> None:
+        # uses the artifact root the reference configures but never writes
+        # to (k8s/mlflow-stack.yaml:259, SURVEY.md §5 checkpoint gap)
+        self._mlflow.log_artifact(path)
+
+    def close(self) -> None:
+        self._mlflow.end_run()
+
+
+class MultiLogger(MetricLogger):
+    def __init__(self, loggers: List[MetricLogger]) -> None:
+        self.loggers = loggers
+
+    def log_metric(self, key: str, value: float, step: int) -> None:
+        for lg in self.loggers:
+            lg.log_metric(key, value, step)
+
+    def log_params(self, params: Dict[str, Any]) -> None:
+        for lg in self.loggers:
+            lg.log_params(params)
+
+    def close(self) -> None:
+        for lg in self.loggers:
+            lg.close()
+
+
+def make_logger(cfg: Config, run_name: Optional[str] = None) -> MetricLogger:
+    kind = cfg.tracking
+    if kind == "noop":
+        return NoopLogger()
+    if kind == "stdout":
+        return StdoutLogger()
+    if kind == "jsonl":
+        path = os.path.join(cfg.data_dir, "metrics",
+                            f"{experiment_name(cfg.mode)}.jsonl")
+        return JsonlLogger(path, experiment=experiment_name(cfg.mode),
+                           run_name=run_name or "run")
+    if kind == "mlflow":
+        try:
+            return MlflowLogger(cfg.mode, tracking_uri=cfg.tracking_uri,
+                                run_name=run_name)
+        except ImportError:
+            # graceful off-cluster degradation, loudly
+            print("[tracking] mlflow unavailable; falling back to stdout",
+                  file=sys.stderr)
+            return StdoutLogger()
+    raise ValueError(f"Unknown tracking backend: {kind!r}")

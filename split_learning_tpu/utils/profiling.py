@@ -1,0 +1,69 @@
+"""Tracing / profiling — the subsystem the reference lacks entirely
+(SURVEY.md §5: the only timing signal is a per-10-step print,
+``src/client_part.py:135-136``).
+
+Two layers:
+- :class:`PhaseProfiler`: cheap wall-clock accounting of named step phases
+  (compute vs transport — the split that decides the north-star metric),
+  with percentile summaries.
+- :func:`device_trace`: a context manager around ``jax.profiler`` emitting
+  an XLA trace viewable in TensorBoard/Perfetto, for on-chip analysis.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+
+class PhaseProfiler:
+    """Accumulates wall-clock per named phase across steps."""
+
+    def __init__(self) -> None:
+        self._samples: Dict[str, list] = defaultdict(list)
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._samples[name].append(time.perf_counter() - t0)
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        out = {}
+        for name, xs in self._samples.items():
+            arr = np.asarray(xs)
+            out[name] = {
+                "count": int(arr.size),
+                "total_s": float(arr.sum()),
+                "mean_ms": float(arr.mean() * 1e3),
+                "p50_ms": float(np.percentile(arr, 50) * 1e3),
+                "p99_ms": float(np.percentile(arr, 99) * 1e3),
+            }
+        return out
+
+    def fraction(self, name: str) -> float:
+        """Share of total accounted time spent in ``name`` — e.g.
+        fraction('transport') answers the north-star question directly."""
+        totals = {k: sum(v) for k, v in self._samples.items()}
+        denom = sum(totals.values())
+        return totals.get(name, 0.0) / denom if denom else float("nan")
+
+    def reset(self) -> None:
+        self._samples.clear()
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: Optional[str]) -> Iterator[None]:
+    """jax.profiler trace (no-op when log_dir is None)."""
+    if not log_dir:
+        yield
+        return
+    import jax
+    with jax.profiler.trace(log_dir):
+        yield

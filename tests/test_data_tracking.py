@@ -1,0 +1,163 @@
+"""Data pipeline (cache semantics, parsers, batcher) and tracking backends."""
+
+import gzip
+import json
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from split_learning_tpu.data import (
+    LocalStore, Split, batches, epoch_steps, load_dataset, synthetic)
+from split_learning_tpu.data.datasets import MNIST_MEAN, MNIST_STD
+from split_learning_tpu.tracking import (
+    JsonlLogger, MultiLogger, StdoutLogger, experiment_name, make_logger)
+from split_learning_tpu.utils import Config
+
+
+def _write_idx_mnist(d, n_train=64, n_test=16):
+    rs = np.random.RandomState(0)
+
+    def images(n):
+        return struct.pack(">IIII", 0x803, n, 28, 28) + \
+            rs.randint(0, 256, (n, 28, 28), dtype=np.uint8).tobytes()
+
+    def labels(n):
+        return struct.pack(">II", 0x801, n) + \
+            rs.randint(0, 10, (n,), dtype=np.uint8).tobytes()
+
+    os.makedirs(d, exist_ok=True)
+    for name, blob in [("train-images-idx3-ubyte", images(n_train)),
+                       ("train-labels-idx1-ubyte", labels(n_train)),
+                       ("t10k-images-idx3-ubyte.gz", gzip.compress(images(n_test))),
+                       ("t10k-labels-idx1-ubyte.gz", gzip.compress(labels(n_test)))]:
+        with open(os.path.join(d, name), "wb") as f:
+            f.write(blob)
+
+
+def test_mnist_idx_load_and_cache_roundtrip(tmp_path):
+    d = str(tmp_path)
+    _write_idx_mnist(d)
+    ds = load_dataset("mnist", d)
+    assert not ds.synthetic
+    assert ds.train.x.shape == (64, 28, 28, 1)
+    assert ds.train.x.dtype == np.float32
+    # normalization parity with the reference (src/client_part.py:61-64)
+    raw_zero = (0.0 - MNIST_MEAN) / MNIST_STD
+    assert abs(ds.train.x.min() - raw_zero) < 0.3
+
+    # second load hits the cache blob (delete raws to prove it)
+    for f in os.listdir(d):
+        if "ubyte" in f:
+            os.remove(os.path.join(d, f))
+    ds2 = load_dataset("mnist", d)
+    np.testing.assert_array_equal(ds.train.x, ds2.train.x)
+    np.testing.assert_array_equal(ds.train.y, ds2.train.y)
+
+
+def test_cifar10_binary_load(tmp_path):
+    d = str(tmp_path)
+    rs = np.random.RandomState(1)
+    rec = lambda n: np.concatenate(
+        [rs.randint(0, 10, (n, 1), dtype=np.uint8),
+         rs.randint(0, 256, (n, 3072), dtype=np.uint8)], axis=1).tobytes()
+    for i in range(1, 6):
+        with open(os.path.join(d, f"data_batch_{i}.bin"), "wb") as f:
+            f.write(rec(20))
+    with open(os.path.join(d, "test_batch.bin"), "wb") as f:
+        f.write(rec(10))
+    ds = load_dataset("cifar10", d)
+    assert ds.train.x.shape == (100, 32, 32, 3)
+    assert ds.test.x.shape == (10, 32, 32, 3)
+    assert ds.num_classes == 10
+
+
+def test_synthetic_cache_never_shadows_real_data(tmp_path):
+    """Regression: a synthetic blob cached in a data-less environment must
+    not satisfy allow_synthetic=False, and real files appearing later win."""
+    d = str(tmp_path)
+    ds = load_dataset("mnist", d)  # no raws yet -> synthetic, cached
+    assert ds.synthetic
+    with pytest.raises(FileNotFoundError):
+        load_dataset("mnist", d, allow_synthetic=False)
+    _write_idx_mnist(d)  # real files appear
+    ds2 = load_dataset("mnist", d, allow_synthetic=False)
+    assert not ds2.synthetic
+    # and the real blob is now the cached one
+    ds3 = load_dataset("mnist", d)
+    assert not ds3.synthetic
+
+
+def test_synthetic_fallback_and_determinism(tmp_path):
+    ds1 = load_dataset("mnist", str(tmp_path / "a"))
+    ds2 = load_dataset("mnist", str(tmp_path / "b"))
+    assert ds1.synthetic and ds2.synthetic
+    np.testing.assert_array_equal(ds1.train.x, ds2.train.x)
+
+    with pytest.raises(FileNotFoundError):
+        load_dataset("mnist", str(tmp_path / "c"), allow_synthetic=False)
+    with pytest.raises(ValueError):
+        load_dataset("imagenet", str(tmp_path))
+
+
+def test_batcher_matches_reference_loader_shape():
+    """938 steps/epoch on MNIST-60k at batch 64 (SURVEY.md §2 derived facts)."""
+    assert epoch_steps(60_000, 64) == 938
+    assert epoch_steps(60_000, 64, drop_remainder=True) == 937
+
+    split = Split(np.zeros((130, 4, 4, 1), np.float32),
+                  np.arange(130, dtype=np.int64))
+    bs = list(batches(split, 64, seed=0))
+    assert [len(b[1]) for b in bs] == [64, 64, 2]
+    # seeded order is reproducible and covers every example exactly once
+    bs2 = list(batches(split, 64, seed=0))
+    np.testing.assert_array_equal(
+        np.concatenate([b[1] for b in bs]),
+        np.concatenate([b[1] for b in bs2]))
+    assert set(np.concatenate([b[1] for b in bs]).tolist()) == set(range(130))
+
+
+def test_local_store_atomic_put(tmp_path):
+    store = LocalStore(str(tmp_path))
+    assert not store.exists("k/v.bin")
+    store.put("k/v.bin", b"abc")
+    assert store.exists("k/v.bin")
+    assert store.fetch("k/v.bin") == b"abc"
+
+
+def test_experiment_name_parity():
+    # ≡ f"{mode.capitalize()}_Learning_Sim" (src/server_part.py:20-21)
+    assert experiment_name("split") == "Split_Learning_Sim"
+    assert experiment_name("federated") == "Federated_Learning_Sim"
+    assert experiment_name("u_split") == "Split_Learning_Sim"
+
+
+def test_jsonl_logger_and_factory(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    with JsonlLogger(path, experiment="Split_Learning_Sim", run_name="r") as lg:
+        lg.log_metric("loss", 1.5, step=0)
+        lg.log_metric("loss", 0.5, step=1)
+        lg.log_params({"lr": 0.01})
+    records = [json.loads(l) for l in open(path)]
+    assert records[0]["key"] == "loss" and records[0]["value"] == 1.5
+    assert records[2]["params"] == {"lr": 0.01}
+
+    cfg = Config(tracking="jsonl", data_dir=str(tmp_path))
+    lg = make_logger(cfg)
+    lg.log_metric("loss", 1.0, step=0)
+    lg.close()
+    assert os.path.exists(
+        os.path.join(str(tmp_path), "metrics", "Split_Learning_Sim.jsonl"))
+
+    # mlflow is absent in this image: factory degrades loudly to stdout
+    lg = make_logger(Config(tracking="mlflow"))
+    assert isinstance(lg, StdoutLogger)
+    with pytest.raises(ValueError):
+        make_logger(Config(tracking="carrier-pigeon"))
+
+
+def test_multi_logger(capsys):
+    lg = MultiLogger([StdoutLogger(every=1)])
+    lg.log_metric("loss", 2.0, step=0)
+    assert "loss: 2.0000" in capsys.readouterr().out

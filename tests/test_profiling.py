@@ -1,0 +1,43 @@
+"""Phase profiler: the compute-vs-transport split the north star is about."""
+
+import time
+
+import jax
+import numpy as np
+
+from split_learning_tpu.models import get_plan
+from split_learning_tpu.runtime import ServerRuntime, SplitClientTrainer
+from split_learning_tpu.transport import LocalTransport
+from split_learning_tpu.utils import Config
+from split_learning_tpu.utils.profiling import PhaseProfiler
+
+
+def test_phase_profiler_accounting():
+    prof = PhaseProfiler()
+    with prof.phase("a"):
+        time.sleep(0.01)
+    with prof.phase("b"):
+        time.sleep(0.03)
+    s = prof.summary()
+    assert s["a"]["count"] == 1
+    assert s["b"]["mean_ms"] > s["a"]["mean_ms"]
+    assert 0.5 < prof.fraction("b") < 1.0
+    prof.reset()
+    assert prof.summary() == {}
+
+
+def test_split_trainer_reports_transport_fraction():
+    cfg = Config(mode="split", batch_size=8)
+    plan = get_plan(mode="split")
+    x = np.random.RandomState(0).randn(8, 28, 28, 1).astype(np.float32)
+    y = np.zeros((8,), np.int64)
+    server = ServerRuntime(plan, cfg, jax.random.PRNGKey(0), x)
+    prof = PhaseProfiler()
+    client = SplitClientTrainer(plan, cfg, jax.random.PRNGKey(0),
+                                LocalTransport(server), profiler=prof)
+    for i in range(3):
+        client.train_step(x, y, i)
+    s = prof.summary()
+    assert set(s) == {"compute_fwd", "transport", "compute_bwd"}
+    assert all(v["count"] == 3 for v in s.values())
+    assert 0.0 < prof.fraction("transport") < 1.0
