@@ -43,11 +43,17 @@ def _split_cnn(mode: str, dtype: Any) -> SplitPlan:
 
 @register_model("resnet18")
 def _resnet18(mode: str, dtype: Any) -> SplitPlan:
-    try:
-        from split_learning_tpu.models.resnet import resnet18_plan
-    except ImportError as exc:
-        raise ValueError("model family 'resnet18' is not available") from exc
+    from split_learning_tpu.models.resnet import resnet18_plan
     return resnet18_plan(mode=mode, dtype=dtype)
+
+
+@register_model("resnet18_4stage")
+def _resnet18_4stage(mode: str, dtype: Any) -> SplitPlan:
+    """The BASELINE.md config-4 shape: 4 pipeline stages."""
+    from split_learning_tpu.models.resnet import resnet18_plan
+    if mode != "split":
+        raise ValueError("resnet18_4stage is a pipeline plan; use mode='split'")
+    return resnet18_plan(mode=mode, dtype=dtype, stages=4)
 
 
 def get_plan(model: str = "split_cnn", mode: str = "split",

@@ -1,0 +1,166 @@
+"""ResNet-18 for CIFAR-10, split into pipeline stages (BASELINE.md config 4:
+"ResNet-18 / CIFAR-10 with 4-stage split, GPipe microbatching over a
+4-device 'pipe' mesh").
+
+The reference has no ResNet — this is the designated scale-up axis beyond
+its 2-conv MNIST CNN (``src/model_def.py:5-28``). Design choices, TPU-first:
+
+- CIFAR stem (3x3 conv, no max-pool) — standard for 32x32 inputs.
+- GroupNorm instead of BatchNorm: stateless (pure params, no mutable
+  batch_stats threading through the transport boundary), batch-size
+  independent (microbatching and per-client batches don't perturb
+  normalization — exactly the failure mode BatchNorm has in split/federated
+  settings), and equivalence between split and monolithic training stays
+  exact.
+- NHWC layout throughout; channel counts (64/128/256/512) are MXU-friendly
+  multiples of 128 lanes at the widths that matter.
+
+Stage cuts:
+- 2 stages (classic client/server split): stem+layer1 | layer2..head
+- 3 stages (U-shaped): stem+layer1 | layer2+layer3 | layer4+head (labels
+  and logits stay on the client)
+- 4 stages (pipeline): stem+layer1 | layer2 | layer3 | layer4+head
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from split_learning_tpu.core.stage import SplitPlan, from_flax
+
+
+class BasicBlock(nn.Module):
+    features: int
+    stride: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.features, (3, 3), strides=(self.stride, self.stride),
+                    padding="SAME", use_bias=False, dtype=self.dtype,
+                    name="conv1")(x)
+        y = nn.GroupNorm(num_groups=32, dtype=self.dtype, name="gn1")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype, name="conv2")(y)
+        y = nn.GroupNorm(num_groups=32, dtype=self.dtype, name="gn2")(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.features, (1, 1),
+                               strides=(self.stride, self.stride),
+                               use_bias=False, dtype=self.dtype,
+                               name="proj")(residual)
+            residual = nn.GroupNorm(num_groups=32, dtype=self.dtype,
+                                    name="gn_proj")(residual)
+        return nn.relu(y + residual)
+
+
+class Stem(nn.Module):
+    """CIFAR stem + layer1 (2 blocks of 64)."""
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(64, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype, name="conv_stem")(x)
+        x = nn.GroupNorm(num_groups=32, dtype=self.dtype, name="gn_stem")(x)
+        x = nn.relu(x)
+        x = BasicBlock(64, dtype=self.dtype, name="block1a")(x)
+        x = BasicBlock(64, dtype=self.dtype, name="block1b")(x)
+        return x
+
+
+class Layer(nn.Module):
+    """One ResNet layer: 2 blocks, first with stride 2."""
+
+    features: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = BasicBlock(self.features, stride=2, dtype=self.dtype,
+                       name="block_a")(x)
+        x = BasicBlock(self.features, dtype=self.dtype, name="block_b")(x)
+        return x
+
+
+class Head(nn.Module):
+    """layer4 + global average pool + classifier."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = Layer(512, dtype=self.dtype, name="layer4")(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=self.dtype, name="fc")(x)
+
+
+class MidLayers(nn.Module):
+    """layer2 + layer3 (for 2- and 3-stage cuts)."""
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = Layer(128, dtype=self.dtype, name="layer2")(x)
+        x = Layer(256, dtype=self.dtype, name="layer3")(x)
+        return x
+
+
+class MidToEnd(nn.Module):
+    """layer2..layer4 + head (server side of the 2-stage cut)."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = MidLayers(dtype=self.dtype, name="mid")(x)
+        x = Head(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x
+
+
+def resnet18_plan(mode: str = "split", dtype: Any = jnp.float32,
+                  stages: int = 0) -> SplitPlan:
+    """Build the ResNet-18 SplitPlan.
+
+    ``stages=0`` picks the natural depth for the mode: 2 for split,
+    3 for u_split, 4 for pipeline work (mode='split', stages=4)."""
+    if stages == 0:
+        stages = {"split": 2, "federated": 2, "u_split": 3}[mode]
+    if mode == "u_split":
+        if stages != 3:
+            raise ValueError("u_split resnet18 uses exactly 3 stages")
+        return SplitPlan(
+            stages=(
+                from_flax("stem_l1", Stem(dtype=dtype)),
+                from_flax("mid", MidLayers(dtype=dtype)),
+                from_flax("head", Head(dtype=dtype)),
+            ),
+            owners=("client", "server", "client"),
+        )
+    if stages == 2:
+        return SplitPlan(
+            stages=(
+                from_flax("stem_l1", Stem(dtype=dtype)),
+                from_flax("mid_head", MidToEnd(dtype=dtype)),
+            ),
+            owners=("client", "server"),
+        )
+    if stages == 4:
+        return SplitPlan(
+            stages=(
+                from_flax("stem_l1", Stem(dtype=dtype)),
+                from_flax("layer2", Layer(128, dtype=dtype)),
+                from_flax("layer3", Layer(256, dtype=dtype)),
+                from_flax("head", Head(dtype=dtype)),
+            ),
+            owners=("client", "server", "server", "server"),
+        )
+    raise ValueError(f"unsupported stage count {stages} for mode {mode!r}")

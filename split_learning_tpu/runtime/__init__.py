@@ -5,6 +5,8 @@ from split_learning_tpu.runtime.client import (
     StepRecord,
     USplitClientTrainer,
 )
+from split_learning_tpu.runtime.checkpoint import Checkpointer, joint_state
+from split_learning_tpu.runtime.multi_client import MultiClientSplitRunner
 from split_learning_tpu.runtime.server import (
     FedAvgAggregator,
     ProtocolError,
@@ -16,4 +18,5 @@ __all__ = [
     "SplitClientTrainer", "USplitClientTrainer", "FederatedClientTrainer",
     "FailurePolicy", "StepRecord", "ServerRuntime", "FedAvgAggregator",
     "ProtocolError", "TrainState", "make_state", "apply_grads", "sgd",
+    "Checkpointer", "joint_state", "MultiClientSplitRunner",
 ]

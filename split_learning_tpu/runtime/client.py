@@ -62,13 +62,15 @@ class SplitClientTrainer:
                  failure_policy: str = FailurePolicy.RAISE,
                  max_retries: int = 3,
                  logger: Optional[Any] = None,
-                 profiler: Optional[Any] = None) -> None:
+                 profiler: Optional[Any] = None,
+                 client_id: int = 0) -> None:
         self.plan = plan
         self.cfg = cfg
         self.transport = transport
         self.failure_policy = failure_policy
         self.max_retries = max_retries
         self.logger = logger
+        self.client_id = client_id
         self.profiler = profiler  # PhaseProfiler: compute-vs-transport split
         self._phase = (profiler.phase if profiler is not None
                        else (lambda _name: contextlib.nullcontext()))
@@ -116,7 +118,7 @@ class SplitClientTrainer:
             try:
                 with phase("transport"):
                     g_acts, loss = self.transport.split_step(
-                        acts_host, np.asarray(y), step)
+                        acts_host, np.asarray(y), step, self.client_id)
                 break
             except TransportError:
                 attempt += 1
@@ -159,7 +161,8 @@ class USplitClientTrainer:
     logits never leave the client (BASELINE.md config 5)."""
 
     def __init__(self, plan: SplitPlan, cfg: Config, rng: jax.Array,
-                 transport: Transport, logger: Optional[Any] = None) -> None:
+                 transport: Transport, logger: Optional[Any] = None,
+                 client_id: int = 0) -> None:
         if plan.owners != ("client", "server", "client"):
             raise ValueError("USplitClientTrainer expects owners "
                              "(client, server, client)")
@@ -167,6 +170,7 @@ class USplitClientTrainer:
         self.cfg = cfg
         self.transport = transport
         self.logger = logger
+        self.client_id = client_id
         self._tx = sgd(cfg.lr, cfg.momentum)
         self.state_a: Optional[TrainState] = None
         self.state_c: Optional[TrainState] = None
@@ -200,13 +204,15 @@ class USplitClientTrainer:
         self.ensure_init(x)
         acts = self._fwd_a(self.state_a.params, jnp.asarray(x))
         # hop 1: activations -> trunk features
-        feats = self.transport.u_forward(np.asarray(acts), step)
+        feats = self.transport.u_forward(np.asarray(acts), step,
+                                         self.client_id)
         # local head: loss + grads (labels stay here)
         loss, g_c, g_feats = self._head_step(
             self.state_c.params, jnp.asarray(feats), jnp.asarray(y))
         self.state_c = apply_grads(self._tx, self.state_c, g_c)
         # hop 2: feature grads -> activation grads (server updates trunk)
-        g_acts = self.transport.u_backward(np.asarray(g_feats), step)
+        g_acts = self.transport.u_backward(np.asarray(g_feats), step,
+                                           self.client_id)
         g_a = self._bwd_a(self.state_a.params, jnp.asarray(x),
                           jnp.asarray(g_acts))
         self.state_a = apply_grads(self._tx, self.state_a, g_a)

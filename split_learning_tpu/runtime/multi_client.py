@@ -1,0 +1,89 @@
+"""Multi-client split learning — BASELINE.md config 3 at the MPMD level.
+
+The reference pins client replicas to 1 (``k8s/split-learning.yaml:49``)
+and its server would data-race with more (module-global model mutated in
+handlers, SURVEY.md §5). Here N clients — each owning its own bottom-stage
+weights and data shard — interleave steps against one shared server half.
+The server applies each client's step sequentially under its lock with a
+per-client handshake (the "SplitFed v2"-style relay schedule), and the
+client bottoms can optionally be FedAvg'd each round.
+
+For the fused/ICI form of the same capability (shared bottom weights,
+per-step psum over the ``data`` mesh axis) see
+:class:`~split_learning_tpu.runtime.fused.FusedSplitTrainer`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from split_learning_tpu.core.stage import SplitPlan
+from split_learning_tpu.runtime.client import SplitClientTrainer
+from split_learning_tpu.runtime.state import TrainState
+from split_learning_tpu.transport.base import Transport
+from split_learning_tpu.utils.config import Config
+
+
+class MultiClientSplitRunner:
+    """Drives N split clients round-robin against one server party."""
+
+    def __init__(self, plan: SplitPlan, cfg: Config, rng: jax.Array,
+                 transport_factory: Callable[[int], Transport],
+                 num_clients: Optional[int] = None,
+                 sync_bottoms_every: int = 0,
+                 logger: Optional[Any] = None) -> None:
+        """transport_factory(client_id) -> a Transport for that client.
+        sync_bottoms_every: if > 0, FedAvg the client bottom stages every
+        that many rounds (0 = fully personal bottoms)."""
+        n = num_clients if num_clients is not None else cfg.num_clients
+        if n < 1:
+            raise ValueError("need at least one client")
+        self.cfg = cfg
+        self.sync_bottoms_every = sync_bottoms_every
+        self.logger = logger
+        self.clients: List[SplitClientTrainer] = [
+            SplitClientTrainer(
+                plan, cfg, jax.random.fold_in(rng, i) if n > 1 else rng,
+                transport_factory(i), client_id=i)
+            for i in range(n)
+        ]
+        self._steps = [0] * n
+        self._rounds = 0
+
+    def train_round(self, batches_per_client: Sequence[Tuple[np.ndarray, np.ndarray]]
+                    ) -> List[float]:
+        """One interleaved round: each client takes one step in turn."""
+        if len(batches_per_client) != len(self.clients):
+            raise ValueError(
+                f"expected {len(self.clients)} batches, "
+                f"got {len(batches_per_client)}")
+        losses = []
+        for i, (client, (x, y)) in enumerate(
+                zip(self.clients, batches_per_client)):
+            step = self._steps[i]
+            loss = client.train_step(x, y, step)
+            self._steps[i] += 1
+            if loss is not None and self.logger is not None:
+                self.logger.log_metric(f"loss_client{i}", loss, step=step)
+            losses.append(loss)
+        self._rounds += 1
+        if (self.sync_bottoms_every
+                and self._rounds % self.sync_bottoms_every == 0):
+            self.sync_bottoms()
+        return losses
+
+    def sync_bottoms(self) -> None:
+        """FedAvg the initialized client bottom stages (optimizer state
+        stays local; uninitialized clients are left untouched)."""
+        from split_learning_tpu.runtime.state import fedavg_mean
+        ready = [c for c in self.clients if c.state is not None]
+        if len(ready) < 2:
+            return
+        mean_params = fedavg_mean([c.state.params for c in ready])
+        for c in ready:
+            c.state = TrainState(params=mean_params,
+                                 opt_state=c.state.opt_state,
+                                 step=c.state.step)

@@ -56,7 +56,12 @@ class ServerRuntime:
         self.mode = cfg.mode
         self.strict_steps = strict_steps
         self._lock = threading.RLock()
-        self._last_step = -1
+        # per-client step handshake (multi-client split: SURVEY.md config 3);
+        # _step_floor is a global minimum installed by resume_from so that
+        # EVERY client — known or not — must resume at or after the
+        # checkpointed step
+        self._last_step: Dict[int, int] = {}
+        self._step_floor = -1
 
         all_params = plan.init(rng, jnp.asarray(sample_input))
         self._tx = sgd(cfg.lr, cfg.momentum)
@@ -114,23 +119,25 @@ class ServerRuntime:
             self._u_bwd = jax.jit(bwd_fn, donate_argnums=(0,))
 
     # ------------------------------------------------------------------ #
-    def _check_step(self, step: int) -> None:
-        if self.strict_steps and step <= self._last_step:
+    def _check_step(self, step: int, client_id: int = 0) -> None:
+        last = max(self._last_step.get(client_id, -1), self._step_floor)
+        if self.strict_steps and step <= last:
             raise ProtocolError(
-                f"non-monotonic step {step} (last seen {self._last_step}); "
-                "client restarted or replayed — refusing to desync")
+                f"non-monotonic step {step} from client {client_id} "
+                f"(last seen {last}); client restarted or replayed — "
+                "refusing to desync")
 
     def split_step(self, activations: np.ndarray, labels: np.ndarray,
-                   step: int) -> Tuple[np.ndarray, float]:
+                   step: int, client_id: int = 0) -> Tuple[np.ndarray, float]:
         if self.mode != "split":
             # mode guard ≡ HTTP 400 (ref src/server_part.py:31-36)
             raise ProtocolError(
                 f"split_step called in mode {self.mode!r}", status=400)
         with self._lock:
-            self._check_step(step)
+            self._check_step(step, client_id)
             self.state, g_acts, loss = self._split_step(
                 self.state, jnp.asarray(activations), jnp.asarray(labels))
-            self._last_step = step
+            self._last_step[client_id] = step
             return np.asarray(g_acts), float(loss)
 
     # bound on residuals awaiting their hop-2 u_backward: if a client dies
@@ -138,31 +145,35 @@ class ServerRuntime:
     # batches in device memory forever.
     MAX_PENDING_RESIDUALS = 8
 
-    def u_forward(self, activations: np.ndarray, step: int) -> np.ndarray:
+    def u_forward(self, activations: np.ndarray, step: int,
+                  client_id: int = 0) -> np.ndarray:
         if self.mode != "u_split":
             raise ProtocolError(
                 f"u_forward called in mode {self.mode!r}", status=400)
         with self._lock:
-            self._check_step(step)
+            self._check_step(step, client_id)
             acts = jnp.asarray(activations)
             feats = self._u_fwd(self.state.params, acts)
-            self._u_residual[step] = acts
+            self._u_residual[(client_id, step)] = acts
             while len(self._u_residual) > self.MAX_PENDING_RESIDUALS:
-                evicted = min(self._u_residual)
-                del self._u_residual[evicted]
+                # FIFO eviction (dict preserves insertion order): the
+                # longest-waiting residual is the most likely orphan
+                del self._u_residual[next(iter(self._u_residual))]
             return np.asarray(feats)
 
-    def u_backward(self, feat_grads: np.ndarray, step: int) -> np.ndarray:
+    def u_backward(self, feat_grads: np.ndarray, step: int,
+                   client_id: int = 0) -> np.ndarray:
         if self.mode != "u_split":
             raise ProtocolError(
                 f"u_backward called in mode {self.mode!r}", status=400)
         with self._lock:
-            acts = self._u_residual.pop(step, None)
+            acts = self._u_residual.pop((client_id, step), None)
             if acts is None:
-                raise ProtocolError(f"u_backward for unknown step {step}")
+                raise ProtocolError(
+                    f"u_backward for unknown step {step} (client {client_id})")
             self.state, g_acts = self._u_bwd(
                 self.state, acts, jnp.asarray(feat_grads))
-            self._last_step = step
+            self._last_step[client_id] = step
             return np.asarray(g_acts)
 
     def aggregate(self, params: Any, epoch: int, loss: float,
@@ -178,7 +189,6 @@ class ServerRuntime:
                 params=mean_params,
                 opt_state=self.state.opt_state,
                 step=self.state.step + 1)
-            self._last_step = step
         return mean_params
 
     def resume_from(self, state: TrainState, step: int) -> None:
@@ -187,7 +197,8 @@ class ServerRuntime:
         protocol — SURVEY.md §5)."""
         with self._lock:
             self.state = state
-            self._last_step = step - 1
+            self._last_step = {}
+            self._step_floor = step - 1  # applies to every client_id
             self._u_residual.clear()
             if self._agg is not None:
                 # drop any pre-restore FedAvg submissions: averaging stale
@@ -224,10 +235,8 @@ class FedAvgAggregator:
             round_id = self._round
             self._pending.append(entry)
             if len(self._pending) >= self.num_clients:
-                stacked = [jax.tree_util.tree_map(jnp.asarray, p)
-                           for _, p in self._pending]
-                self._result = jax.tree_util.tree_map(
-                    lambda *xs: jnp.mean(jnp.stack(xs), axis=0), *stacked)
+                from split_learning_tpu.runtime.state import fedavg_mean
+                self._result = fedavg_mean([p for _, p in self._pending])
                 self._pending = []
                 self._round += 1
                 self._cond.notify_all()

@@ -42,3 +42,16 @@ def apply_grads(tx: optax.GradientTransformation, state: TrainState,
     updates, opt_state = tx.update(grads, state.opt_state, state.params)
     params = optax.apply_updates(state.params, updates)
     return TrainState(params=params, opt_state=opt_state, step=state.step + 1)
+
+
+def fedavg_mean(params_list) -> Params:
+    """Unweighted FedAvg: leafwise mean over client param pytrees — the
+    real aggregation the reference left as a TODO (src/server_part.py:81-82).
+    Shared by the server aggregator and client bottom-stage sync."""
+    import jax
+    import jax.numpy as jnp
+    if len(params_list) == 1:
+        return params_list[0]
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.mean(jnp.stack([jnp.asarray(x) for x in xs]), axis=0),
+        *params_list)

@@ -90,7 +90,7 @@ class Transport(abc.ABC):
     # -- classic 2-party split: one round trip per step ------------------
     @abc.abstractmethod
     def split_step(self, activations: np.ndarray, labels: np.ndarray,
-                   step: int) -> Tuple[np.ndarray, float]:
+                   step: int, client_id: int = 0) -> Tuple[np.ndarray, float]:
         """Send cut-layer activations + labels; receive (grad, loss).
 
         Contract of ``POST /forward_pass`` (``src/server_part.py:25-58``),
@@ -99,11 +99,13 @@ class Transport(abc.ABC):
 
     # -- U-shaped split: two round trips per step ------------------------
     @abc.abstractmethod
-    def u_forward(self, activations: np.ndarray, step: int) -> np.ndarray:
+    def u_forward(self, activations: np.ndarray, step: int,
+                  client_id: int = 0) -> np.ndarray:
         """Hop 1: client acts -> server trunk features (labels stay home)."""
 
     @abc.abstractmethod
-    def u_backward(self, feat_grads: np.ndarray, step: int) -> np.ndarray:
+    def u_backward(self, feat_grads: np.ndarray, step: int,
+                   client_id: int = 0) -> np.ndarray:
         """Hop 2: d(loss)/d(features) -> d(loss)/d(activations)."""
 
     # -- federated mode: one round trip per epoch ------------------------
@@ -155,17 +157,17 @@ class FaultyTransport(Transport):
         self.injector = injector
         self.stats = inner.stats
 
-    def split_step(self, activations, labels, step):
+    def split_step(self, activations, labels, step, client_id=0):
         self.injector.maybe_fail("split_step", step)
-        return self.inner.split_step(activations, labels, step)
+        return self.inner.split_step(activations, labels, step, client_id)
 
-    def u_forward(self, activations, step):
+    def u_forward(self, activations, step, client_id=0):
         self.injector.maybe_fail("u_forward", step)
-        return self.inner.u_forward(activations, step)
+        return self.inner.u_forward(activations, step, client_id)
 
-    def u_backward(self, feat_grads, step):
+    def u_backward(self, feat_grads, step, client_id=0):
         self.injector.maybe_fail("u_backward", step)
-        return self.inner.u_backward(feat_grads, step)
+        return self.inner.u_backward(feat_grads, step, client_id)
 
     def aggregate(self, params, epoch, loss, step):
         self.injector.maybe_fail("aggregate", step)

@@ -66,18 +66,20 @@ class SplitHTTPServer:
                 raw = self.rfile.read(length)
                 try:
                     req = codec.decode(raw)
+                    cid = int(req.get("client_id", 0))
                     if self.path == "/forward_pass":
                         grads, loss = outer.runtime.split_step(
-                            req["activations"], req["labels"], int(req["step"]))
+                            req["activations"], req["labels"],
+                            int(req["step"]), cid)
                         body = codec.encode(
                             {"grads": grads, "loss": loss, "step": req["step"]})
                     elif self.path == "/u_forward":
                         feats = outer.runtime.u_forward(
-                            req["activations"], int(req["step"]))
+                            req["activations"], int(req["step"]), cid)
                         body = codec.encode({"features": feats})
                     elif self.path == "/u_backward":
                         g = outer.runtime.u_backward(
-                            req["feat_grads"], int(req["step"]))
+                            req["feat_grads"], int(req["step"]), cid)
                         body = codec.encode({"grads": g})
                     elif self.path == "/aggregate_weights":
                         agg = outer.runtime.aggregate(
@@ -143,25 +145,29 @@ class HttpTransport(Transport):
         return codec.decode(resp.content)
 
     def split_step(self, activations: np.ndarray, labels: np.ndarray,
-                   step: int) -> Tuple[np.ndarray, float]:
+                   step: int, client_id: int = 0) -> Tuple[np.ndarray, float]:
         with timed(self.stats):
             out = self._post("/forward_pass", {
                 "activations": np.asarray(activations),
                 "labels": np.asarray(labels),
-                "step": step,
+                "step": step, "client_id": client_id,
             })
             return out["grads"], float(out["loss"])
 
-    def u_forward(self, activations: np.ndarray, step: int) -> np.ndarray:
+    def u_forward(self, activations: np.ndarray, step: int,
+                  client_id: int = 0) -> np.ndarray:
         with timed(self.stats):
             return self._post("/u_forward", {
                 "activations": np.asarray(activations), "step": step,
+                "client_id": client_id,
             })["features"]
 
-    def u_backward(self, feat_grads: np.ndarray, step: int) -> np.ndarray:
+    def u_backward(self, feat_grads: np.ndarray, step: int,
+                   client_id: int = 0) -> np.ndarray:
         with timed(self.stats):
             return self._post("/u_backward", {
                 "feat_grads": np.asarray(feat_grads), "step": step,
+                "client_id": client_id,
             })["grads"]
 
     def aggregate(self, params: Any, epoch: int, loss: float, step: int) -> Any:

@@ -42,25 +42,28 @@ class LocalTransport(Transport):
             raise TransportError(str(exc)) from exc
 
     def split_step(self, activations: np.ndarray, labels: np.ndarray,
-                   step: int) -> Tuple[np.ndarray, float]:
+                   step: int, client_id: int = 0) -> Tuple[np.ndarray, float]:
         with timed(self.stats):
             acts = self._roundtrip(np.asarray(activations))
             labs = self._roundtrip(np.asarray(labels))
-            grads, loss = self._call(self.server.split_step, acts, labs, step)
+            grads, loss = self._call(self.server.split_step, acts, labs,
+                                     step, client_id)
             return self._roundtrip(grads), float(loss)
 
-    def u_forward(self, activations: np.ndarray, step: int) -> np.ndarray:
+    def u_forward(self, activations: np.ndarray, step: int,
+                  client_id: int = 0) -> np.ndarray:
         with timed(self.stats):
             feats = self._call(
                 self.server.u_forward,
-                self._roundtrip(np.asarray(activations)), step)
+                self._roundtrip(np.asarray(activations)), step, client_id)
             return self._roundtrip(feats)
 
-    def u_backward(self, feat_grads: np.ndarray, step: int) -> np.ndarray:
+    def u_backward(self, feat_grads: np.ndarray, step: int,
+                   client_id: int = 0) -> np.ndarray:
         with timed(self.stats):
             g = self._call(
                 self.server.u_backward,
-                self._roundtrip(np.asarray(feat_grads)), step)
+                self._roundtrip(np.asarray(feat_grads)), step, client_id)
             return self._roundtrip(g)
 
     def aggregate(self, params: Any, epoch: int, loss: float, step: int) -> Any:
