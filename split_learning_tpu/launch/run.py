@@ -39,13 +39,16 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--tracking", default=None,
                    help="stdout | jsonl | mlflow | noop")
     p.add_argument("--tracking-uri", default=None)
+    p.add_argument("--kernels", choices=["xla", "pallas"], default=None,
+                   help="hot-path op implementation (pallas = "
+                        "split_learning_tpu.ops kernels)")
 
 
 def _config_from_args(args) -> "Config":
     from split_learning_tpu.utils import Config
     overrides = {}
     for field in ("mode", "model", "dataset", "batch_size", "epochs", "lr",
-                  "seed", "data_dir", "tracking", "tracking_uri"):
+                  "seed", "data_dir", "tracking", "tracking_uri", "kernels"):
         val = getattr(args, field, None)
         if val is not None:
             overrides[field] = val
@@ -127,7 +130,8 @@ def cmd_train(args) -> int:
         # MPMD path: a transport to a (possibly remote) server party
         if args.transport == "http":
             from split_learning_tpu.transport.http import HttpTransport
-            transport = HttpTransport(cfg.server_url)
+            transport = HttpTransport(cfg.server_url,
+                                      compress=args.compress or "none")
         else:
             server = ServerRuntime(plan, cfg, jax.random.PRNGKey(cfg.seed),
                                    sample)
@@ -198,6 +202,9 @@ def main(argv: Optional[list] = None) -> int:
     pt.add_argument("--require-real", action="store_true",
                     help="fail if real dataset files are absent instead of "
                          "falling back to synthetic data")
+    pt.add_argument("--compress", choices=["none", "int8"], default=None,
+                    help="wire compression of the cut-layer tensors "
+                         "(http transport only)")
     pt.set_defaults(fn=cmd_train)
 
     ps = sub.add_parser("serve", help="serve the server party over HTTP")

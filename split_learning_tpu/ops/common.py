@@ -1,0 +1,55 @@
+"""Shared helpers for the Pallas kernel layer."""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+# float32 native tile: 8 sublanes x 128 lanes
+SUBLANE = 8
+LANE = 128
+
+
+@functools.lru_cache(maxsize=None)
+def _default_backend_platform() -> str:
+    try:
+        return jax.default_backend()
+    except Exception:
+        return "cpu"
+
+
+def use_interpret() -> bool:
+    """Pallas TPU kernels compile only on real TPU; everywhere else
+    (the 8-virtual-CPU-device test mesh, SURVEY.md §4) run the Mosaic
+    interpreter so the same kernel code is exercised."""
+    if os.environ.get("SLT_PALLAS_INTERPRET", "") == "1":
+        return True
+    return _default_backend_platform() != "tpu"
+
+
+def pallas_available() -> bool:
+    """Kernels are importable everywhere jax is; gate only on env opt-out."""
+    return os.environ.get("SLT_DISABLE_PALLAS", "") != "1"
+
+
+def round_up(n: int, m: int) -> int:
+    return (n + m - 1) // m * m
+
+
+def pad_axis(x: jax.Array, axis: int, target: int) -> jax.Array:
+    """Zero-pad one axis up to ``target`` length."""
+    if x.shape[axis] == target:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, target - x.shape[axis])
+    return jnp.pad(x, pad)
+
+
+def as_rows_of_lanes(flat: jax.Array, rows: int) -> jax.Array:
+    """[n] -> [rows, LANE] zero-padded — the canonical 2-D layout for
+    elementwise kernels over arbitrarily-shaped leaves."""
+    padded = pad_axis(flat, 0, rows * LANE)
+    return padded.reshape(rows, LANE)

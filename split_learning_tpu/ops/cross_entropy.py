@@ -1,0 +1,148 @@
+"""Fused softmax cross-entropy — Pallas forward/backward kernel pair.
+
+The reference computes the split-mode loss server-side with
+``nn.CrossEntropyLoss`` (``src/server_part.py:16,49``); in the fused TPU
+step the loss sits between the server stage's matmul and the backward
+sweep. XLA already fuses well here, but a hand-written kernel keeps the
+whole [B, C] tile VMEM-resident across max/exp/sum/log and both the loss
+and the saved softmax for the backward, with masking for the lane padding
+(C=10 classes pad to one 128-lane tile).
+
+``fused_cross_entropy(logits, labels)`` is a drop-in for
+:func:`split_learning_tpu.core.losses.cross_entropy` (mean reduction,
+integer labels, torch CE semantics) with a custom VJP whose backward is
+the classic ``(softmax - onehot) / B`` — one elementwise kernel, no
+recomputation of the softmax.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from split_learning_tpu.ops.common import (
+    LANE,
+    SUBLANE,
+    pad_axis,
+    round_up,
+    use_interpret,
+)
+
+_NEG_INF = -1e30
+
+
+def reference_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Pure-jnp reference (identical to core.losses.cross_entropy)."""
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels).mean()
+
+
+# --------------------------------------------------------------------- #
+# kernels. Both operate on one padded [Bp, Cp] block in VMEM; B (valid
+# rows) and C (valid cols) are static closure constants.
+# --------------------------------------------------------------------- #
+def _fwd_kernel(n_valid_b: int, n_valid_c: int,
+                logits_ref, labels_ref, loss_ref, probs_ref):
+    x = logits_ref[:].astype(jnp.float32)          # [Bp, Cp]
+    col = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    row = jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+    col_ok = col < n_valid_c
+    row_ok = row < n_valid_b
+
+    x = jnp.where(col_ok, x, _NEG_INF)
+    m = jnp.max(x, axis=1, keepdims=True)
+    e = jnp.exp(x - m)                             # padded cols -> ~0
+    e = jnp.where(col_ok, e, 0.0)
+    s = jnp.sum(e, axis=1, keepdims=True)
+    probs = e / s
+    probs_ref[:] = probs
+
+    onehot = col == labels_ref[:]                  # labels [Bp, 1]
+    logp = (x - m) - jnp.log(s)
+    row_loss = -jnp.sum(jnp.where(onehot & col_ok, logp, 0.0), axis=1,
+                        keepdims=True)             # [Bp, 1]
+    row_loss = jnp.where(row_ok[:, :1], row_loss, 0.0)
+    loss_ref[0, 0] = jnp.sum(row_loss) / n_valid_b
+
+
+def _bwd_kernel(n_valid_b: int, n_valid_c: int,
+                probs_ref, labels_ref, g_ref, grad_ref):
+    p = probs_ref[:]                               # [Bp, Cp]
+    col = jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
+    row = jax.lax.broadcasted_iota(jnp.int32, p.shape, 0)
+    onehot = (col == labels_ref[:]).astype(p.dtype)
+    g = g_ref[0, 0] / n_valid_b
+    grad = (p - onehot) * g
+    valid = (col < n_valid_c) & (row < n_valid_b)
+    grad_ref[:] = jnp.where(valid, grad, 0.0)
+
+
+# --------------------------------------------------------------------- #
+@functools.lru_cache(maxsize=None)
+def _make_ce(b: int, c: int, dtype_name: str):
+    """Build a custom-VJP CE op for one static (B, C, dtype).
+
+    Shapes are static under jit, so the cache key is exact; only arrays
+    (saved softmax, padded labels) ride the VJP residuals.
+    """
+    bp, cp = round_up(b, SUBLANE), round_up(c, LANE)
+    in_dtype = jnp.dtype(dtype_name)
+
+    def fwd_call(logits, labels):
+        logits_p = pad_axis(pad_axis(logits, 0, bp), 1, cp)
+        labels_p = pad_axis(labels.astype(jnp.int32), 0, bp).reshape(bp, 1)
+        loss, probs = pl.pallas_call(
+            functools.partial(_fwd_kernel, b, c),
+            out_shape=(
+                jax.ShapeDtypeStruct((1, 1), jnp.float32),
+                jax.ShapeDtypeStruct((bp, cp), jnp.float32),
+            ),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+            ],
+            out_specs=(
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+            ),
+            interpret=use_interpret(),
+        )(logits_p, labels_p)
+        return loss[0, 0], (probs, labels_p)
+
+    @jax.custom_vjp
+    def ce(logits, labels):
+        loss, _ = fwd_call(logits, labels)
+        return loss
+
+    def vjp_fwd(logits, labels):
+        return fwd_call(logits, labels)
+
+    def vjp_bwd(res, g):
+        probs, labels_p = res
+        g_arr = jnp.asarray(g, jnp.float32).reshape(1, 1)
+        grad = pl.pallas_call(
+            functools.partial(_bwd_kernel, b, c),
+            out_shape=jax.ShapeDtypeStruct((bp, cp), jnp.float32),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1), memory_space=pltpu.SMEM),
+            ],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            interpret=use_interpret(),
+        )(probs, labels_p, g_arr)
+        return grad[:b, :c].astype(in_dtype), None
+
+    ce.defvjp(vjp_fwd, vjp_bwd)
+    return ce
+
+
+def fused_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean softmax CE with integer labels; Pallas fwd+bwd (custom VJP)."""
+    b, c = logits.shape
+    return _make_ce(b, c, str(logits.dtype))(logits, labels)

@@ -1,0 +1,100 @@
+"""Int8 symmetric-scale quantization of the cut-layer payload.
+
+The split step ships a 5.28 MiB fp32 tensor each way every step
+(SURVEY.md §2 derived facts — the north-star payload). Symmetric int8
+with one per-tensor scale shrinks that 4x for bandwidth-bound transports
+(HTTP/DCN); the quantize and dequantize passes are single elementwise
+Pallas kernels. Used by the HTTP transport's optional wire compression
+(``HttpTransport(compress="int8")``) — the lossless default stays fp32.
+
+    scale = max(|x|) / 127        (eps-clamped so x == 0 round-trips)
+    q     = round(x / scale)  in [-127, 127], int8
+    x'    = q * scale
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from split_learning_tpu.ops.common import LANE, round_up, use_interpret
+
+# int8 native tile is (32, 128)
+_INT8_SUBLANE = 32
+_EPS = 1e-12
+
+
+def _quant_kernel(n: int, x_ref, q_ref, scale_ref):
+    x = x_ref[:]
+    row = jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    valid = (row * LANE + col) < n
+    x = jnp.where(valid, x, 0.0)
+    amax = jnp.max(jnp.abs(x))
+    scale = jnp.maximum(amax / 127.0, _EPS)
+    scale_ref[0, 0] = scale
+    q = jnp.round(x / scale)
+    q_ref[:] = jnp.clip(q, -127, 127).astype(jnp.int8)
+
+
+def _dequant_kernel(q_ref, scale_ref, x_ref):
+    x_ref[:] = q_ref[:].astype(jnp.float32) * scale_ref[0, 0]
+
+
+def _to_tiles(x: jax.Array) -> Tuple[jax.Array, int]:
+    n = x.size
+    rows = round_up(max(round_up(n, LANE) // LANE, 1), _INT8_SUBLANE)
+    flat = jnp.pad(x.reshape(-1).astype(jnp.float32),
+                   (0, rows * LANE - n))
+    return flat.reshape(rows, LANE), n
+
+
+def quantize_int8(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """x (any shape, float) -> (q int8 [rows, 128], scale f32 scalar)."""
+    x2, n = _to_tiles(x)
+    q, scale = pl.pallas_call(
+        functools.partial(_quant_kernel, n),
+        out_shape=(
+            jax.ShapeDtypeStruct(x2.shape, jnp.int8),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ),
+        interpret=use_interpret(),
+    )(x2)
+    return q, scale[0, 0]
+
+
+def dequantize_int8(q: jax.Array, scale: jax.Array,
+                    shape: Tuple[int, ...],
+                    dtype=jnp.float32) -> jax.Array:
+    """(q [rows, 128], scale) -> original-shape float tensor."""
+    scale2 = jnp.asarray(scale, jnp.float32).reshape(1, 1)
+    x2 = pl.pallas_call(
+        _dequant_kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, jnp.float32),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=use_interpret(),
+    )(q, scale2)
+    n = 1
+    for s in shape:
+        n *= s
+    return x2.reshape(-1)[:n].reshape(shape).astype(dtype)
+
+
+def quantize_dequantize(x: jax.Array) -> jax.Array:
+    """Round-trip helper (the transport-visible distortion)."""
+    q, scale = quantize_int8(x)
+    return dequantize_int8(q, scale, x.shape, x.dtype)

@@ -1,0 +1,163 @@
+"""Fused SGD(+momentum) parameter update as a Pallas kernel.
+
+The reference calls ``optimizer.step()`` on both halves every split step
+(``src/client_part.py:133``, ``src/server_part.py:52``). The update is
+purely memory-bound: with momentum, optax materializes the trace update
+and the scaled step as separate HLOs; the kernel does one
+read-modify-write pass per leaf —
+
+    m' = mu * m + g          (momentum trace, optax.sgd semantics)
+    p' = p - lr * m'
+
+keeping each tile in VMEM for both outputs. Leaves are flattened to
+[rows, 128] lanes; big leaves are tiled over a 1-D grid so VMEM never
+holds more than one block per operand.
+
+``fused_sgd_step`` mirrors ``optax.sgd(lr, momentum)`` exactly (same
+trace initialization = zeros, same update order), so it is numerically
+interchangeable with the optax path used by the trainers — tested in
+tests/test_ops.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from split_learning_tpu.ops.common import LANE, round_up, use_interpret
+
+Params = Any
+
+# rows per grid block: 512 rows x 128 lanes x 4 B = 256 KiB per operand
+_BLOCK_ROWS = 512
+
+
+def reference_sgd_step(params: Params, grads: Params, trace: Optional[Params],
+                       lr: float, momentum: float = 0.0
+                       ) -> Tuple[Params, Optional[Params]]:
+    """Pure-jnp reference with optax.sgd semantics."""
+    if momentum:
+        new_trace = jax.tree_util.tree_map(
+            lambda m, g: momentum * m + g, trace, grads)
+        new_params = jax.tree_util.tree_map(
+            lambda p, m: p - lr * m, params, new_trace)
+        return new_params, new_trace
+    new_params = jax.tree_util.tree_map(
+        lambda p, g: p - lr * g, params, grads)
+    return new_params, None
+
+
+# --------------------------------------------------------------------- #
+def _sgd_kernel(lr: float, p_ref, g_ref, out_ref):
+    out_ref[:] = p_ref[:] - lr * g_ref[:]
+
+
+def _sgd_mom_kernel(lr: float, mu: float, p_ref, g_ref, m_ref,
+                    out_p_ref, out_m_ref):
+    m_new = mu * m_ref[:] + g_ref[:]
+    out_m_ref[:] = m_new
+    out_p_ref[:] = p_ref[:] - lr * m_new
+
+
+def _to_lanes(x: jax.Array) -> Tuple[jax.Array, int]:
+    """Flatten a leaf to [rows, LANE]; returns (2-D view, element count)."""
+    n = x.size
+    rows = max(round_up(n, LANE) // LANE, 1)
+    flat = jnp.pad(x.reshape(-1), (0, rows * LANE - n))
+    return flat.reshape(rows, LANE), n
+
+
+def _grid_specs(rows: int):
+    """1-D grid over row blocks (single block when the leaf is small)."""
+    if rows <= _BLOCK_ROWS:
+        return None, rows
+    grid_rows = round_up(rows, _BLOCK_ROWS)
+    return grid_rows // _BLOCK_ROWS, grid_rows
+
+
+def _update_leaf(p: jax.Array, g: jax.Array, m: Optional[jax.Array],
+                 lr: float, mu: float):
+    orig_shape, orig_dtype = p.shape, p.dtype
+    p2, n = _to_lanes(p.astype(jnp.float32))
+    g2, _ = _to_lanes(g.astype(jnp.float32))
+    n_blocks, padded_rows = _grid_specs(p2.shape[0])
+    if padded_rows != p2.shape[0]:
+        pad = ((0, padded_rows - p2.shape[0]), (0, 0))
+        p2, g2 = jnp.pad(p2, pad), jnp.pad(g2, pad)
+
+    if n_blocks is None:
+        vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+        in_specs = [vmem, vmem]
+        out_vmem = vmem
+        grid = ()
+    else:
+        block = pl.BlockSpec((_BLOCK_ROWS, LANE), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+        in_specs = [block, block]
+        out_vmem = block
+        grid = (n_blocks,)
+
+    if mu:
+        m2, _ = _to_lanes(m.astype(jnp.float32))
+        if padded_rows != m2.shape[0]:
+            m2 = jnp.pad(m2, ((0, padded_rows - m2.shape[0]), (0, 0)))
+        new_p2, new_m2 = pl.pallas_call(
+            functools.partial(_sgd_mom_kernel, lr, mu),
+            out_shape=(
+                jax.ShapeDtypeStruct(p2.shape, jnp.float32),
+                jax.ShapeDtypeStruct(p2.shape, jnp.float32),
+            ),
+            grid=grid,
+            in_specs=in_specs + [in_specs[0]],
+            out_specs=(out_vmem, out_vmem),
+            interpret=use_interpret(),
+        )(p2, g2, m2)
+        new_m = new_m2.reshape(-1)[:n].reshape(orig_shape)
+    else:
+        new_p2 = pl.pallas_call(
+            functools.partial(_sgd_kernel, lr),
+            out_shape=jax.ShapeDtypeStruct(p2.shape, jnp.float32),
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=out_vmem,
+            interpret=use_interpret(),
+        )(p2, g2)
+        new_m = None
+    new_p = new_p2.reshape(-1)[:n].reshape(orig_shape).astype(orig_dtype)
+    return new_p, new_m
+
+
+def fused_sgd_step(params: Params, grads: Params, trace: Optional[Params],
+                   lr: float, momentum: float = 0.0
+                   ) -> Tuple[Params, Optional[Params]]:
+    """Leaf-wise fused SGD update over an arbitrary pytree.
+
+    ``trace`` is the momentum pytree (zeros-initialized, like
+    optax.sgd's TraceState) or None when ``momentum == 0``.
+    """
+    leaves_p, treedef = jax.tree_util.tree_flatten(params)
+    leaves_g = treedef.flatten_up_to(grads)
+    if momentum:
+        leaves_m = treedef.flatten_up_to(trace)
+    else:
+        leaves_m = [None] * len(leaves_p)
+    new_p, new_m = [], []
+    for p, g, m in zip(leaves_p, leaves_g, leaves_m):
+        np_, nm_ = _update_leaf(p, g, m, lr, momentum)
+        new_p.append(np_)
+        new_m.append(nm_)
+    new_params = jax.tree_util.tree_unflatten(treedef, new_p)
+    new_trace = (jax.tree_util.tree_unflatten(treedef, new_m)
+                 if momentum else None)
+    return new_params, new_trace
+
+
+def init_trace(params: Params) -> Params:
+    """Zero momentum trace, matching optax.trace initialization."""
+    return jax.tree_util.tree_map(
+        lambda p: jnp.zeros_like(p, jnp.float32), params)

@@ -45,10 +45,20 @@ class FusedSplitTrainer:
         self.plan = plan
         self.cfg = cfg
         self.mesh = mesh
+        use_pallas = cfg.kernels == "pallas"
         self._tx = sgd(cfg.lr, cfg.momentum)
 
         params = tuple(plan.init(rng, jnp.asarray(sample_input)))
-        state = make_state(params, self._tx)
+        if use_pallas:
+            # the fused-kernel path owns its optimizer state: the momentum
+            # trace pytree (or () without momentum) instead of optax's
+            from split_learning_tpu.ops.sgd import init_trace
+            state = TrainState(
+                params=params,
+                opt_state=init_trace(params) if cfg.momentum else (),
+                step=jnp.zeros((), jnp.int32))
+        else:
+            state = make_state(params, self._tx)
         if mesh is not None:
             # params replicated across the mesh; batch sharded over 'data'
             state = jax.device_put(state, replicated(mesh))
@@ -59,10 +69,28 @@ class FusedSplitTrainer:
 
         microbatches = cfg.microbatches
         tx = self._tx
+        lr, momentum = cfg.lr, cfg.momentum
+
+        if use_pallas:
+            from split_learning_tpu.ops import fused_cross_entropy
+            from split_learning_tpu.ops.sgd import fused_sgd_step
+            loss_op = fused_cross_entropy
+        else:
+            loss_op = cross_entropy
 
         def loss_fn(params, x, y):
             logits = plan.apply(params, x)
-            return cross_entropy(logits, y)
+            return loss_op(logits, y)
+
+        def update(state: TrainState, grads) -> TrainState:
+            if not use_pallas:
+                return apply_grads(tx, state, grads)
+            trace = state.opt_state if momentum else None
+            new_params, new_trace = fused_sgd_step(
+                state.params, grads, trace, lr, momentum)
+            return TrainState(params=new_params,
+                              opt_state=new_trace if momentum else (),
+                              step=state.step + 1)
 
         def step_fn(state: TrainState, x, y):
             if microbatches == 1:
@@ -85,7 +113,7 @@ class FusedSplitTrainer:
                     micro, (zeros, jnp.zeros(())), (xs, ys))
                 grads = jax.tree_util.tree_map(lambda g: g / mb, g_sum)
                 loss = l_sum / mb
-            new_state = apply_grads(tx, state, grads)
+            new_state = update(state, grads)
             return new_state, loss
 
         if mesh is not None:

@@ -140,9 +140,11 @@ class ServerRuntime:
             self._last_step[client_id] = step
             return np.asarray(g_acts), float(loss)
 
-    # bound on residuals awaiting their hop-2 u_backward: if a client dies
-    # between hops, old entries are evicted instead of pinning cut-layer
-    # batches in device memory forever.
+    # per-client bound on residuals awaiting their hop-2 u_backward: if a
+    # client dies between hops, its old entries are evicted instead of
+    # pinning cut-layer batches in device memory forever. The cap is per
+    # client_id so one client's backlog can never evict another's live
+    # residual.
     MAX_PENDING_RESIDUALS = 8
 
     def u_forward(self, activations: np.ndarray, step: int,
@@ -155,10 +157,11 @@ class ServerRuntime:
             acts = jnp.asarray(activations)
             feats = self._u_fwd(self.state.params, acts)
             self._u_residual[(client_id, step)] = acts
-            while len(self._u_residual) > self.MAX_PENDING_RESIDUALS:
-                # FIFO eviction (dict preserves insertion order): the
-                # longest-waiting residual is the most likely orphan
-                del self._u_residual[next(iter(self._u_residual))]
+            mine = [k for k in self._u_residual if k[0] == client_id]
+            # FIFO eviction (dict preserves insertion order): this
+            # client's longest-waiting residual is the most likely orphan
+            for key in mine[:max(len(mine) - self.MAX_PENDING_RESIDUALS, 0)]:
+                del self._u_residual[key]
             return np.asarray(feats)
 
     def u_backward(self, feat_grads: np.ndarray, step: int,

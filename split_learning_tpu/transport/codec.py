@@ -84,3 +84,47 @@ def decode(data: bytes) -> Any:
     """bytes -> pytree with numpy arrays at the leaves."""
     return msgpack.unpackb(data, ext_hook=_ext_hook, raw=False,
                            strict_map_key=False)
+
+
+# --------------------------------------------------------------------- #
+# Optional int8 wire compression of the cut-layer payload: 4x fewer
+# bytes for the 5.28 MiB hop (SURVEY.md §2 derived facts). Same math as
+# the Pallas kernels in ops/quantize.py (parity-tested); this numpy path
+# runs at the host wire boundary, the kernels inside jit.
+# --------------------------------------------------------------------- #
+_Q8_KEY = "__q8__"
+_Q8_EPS = 1e-12
+
+
+def q8_compress(arr: np.ndarray) -> dict:
+    """float array -> {__q8__, q(int8), scale, shape, dtype}."""
+    a = np.ascontiguousarray(arr, dtype=np.float32)
+    scale = max(float(np.max(np.abs(a))) / 127.0, _Q8_EPS) if a.size else _Q8_EPS
+    q = np.clip(np.round(a / scale), -127, 127).astype(np.int8)
+    return {_Q8_KEY: True, "q": q, "scale": scale,
+            "shape": list(a.shape), "dtype": str(np.asarray(arr).dtype)}
+
+
+def is_q8(obj: Any) -> bool:
+    return isinstance(obj, dict) and obj.get(_Q8_KEY) is True
+
+
+def q8_decompress(d: dict) -> np.ndarray:
+    q = np.asarray(d["q"], np.int8).astype(np.float32)
+    x = (q * d["scale"]).reshape(d["shape"])
+    name = d["dtype"]
+    if name == "bfloat16":  # stock numpy can't resolve the name
+        import ml_dtypes
+        return x.astype(np.dtype(ml_dtypes.bfloat16))
+    return x.astype(np.dtype(name))
+
+
+def decompress_tree(obj: Any) -> Any:
+    """Recursively expand any q8-compressed tensors in a decoded tree."""
+    if is_q8(obj):
+        return q8_decompress(obj)
+    if isinstance(obj, dict):
+        return {k: decompress_tree(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [decompress_tree(v) for v in obj]
+    return obj

@@ -65,22 +65,26 @@ class SplitHTTPServer:
                 length = int(self.headers.get("Content-Length", 0))
                 raw = self.rfile.read(length)
                 try:
-                    req = codec.decode(raw)
+                    req = codec.decompress_tree(codec.decode(raw))
                     cid = int(req.get("client_id", 0))
+                    # reply with the same wire compression the client used
+                    q8 = req.get("compress") == "int8"
+                    pack = codec.q8_compress if q8 else (lambda a: a)
                     if self.path == "/forward_pass":
                         grads, loss = outer.runtime.split_step(
                             req["activations"], req["labels"],
                             int(req["step"]), cid)
                         body = codec.encode(
-                            {"grads": grads, "loss": loss, "step": req["step"]})
+                            {"grads": pack(grads), "loss": loss,
+                             "step": req["step"]})
                     elif self.path == "/u_forward":
                         feats = outer.runtime.u_forward(
                             req["activations"], int(req["step"]), cid)
-                        body = codec.encode({"features": feats})
+                        body = codec.encode({"features": pack(feats)})
                     elif self.path == "/u_backward":
                         g = outer.runtime.u_backward(
                             req["feat_grads"], int(req["step"]), cid)
-                        body = codec.encode({"grads": g})
+                        body = codec.encode({"grads": pack(g)})
                     elif self.path == "/aggregate_weights":
                         agg = outer.runtime.aggregate(
                             req["model_state"], int(req["epoch"]),
@@ -121,14 +125,28 @@ class HttpTransport(Transport):
     (``src/client_part.py:125,186``), with permanent/transient error
     classification instead of silent batch drops."""
 
-    def __init__(self, base_url: str, timeout: float = 60.0) -> None:
+    def __init__(self, base_url: str, timeout: float = 60.0,
+                 compress: str = "none") -> None:
+        """``compress="int8"`` quantizes the cut-layer tensors on the wire
+        (4x fewer bytes; lossy — see ops/quantize.py). Weights
+        (/aggregate_weights) always travel lossless."""
         super().__init__()
+        if compress not in ("none", "int8"):
+            raise ValueError(f"unknown compression {compress!r}")
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self.compress = compress
         self._session = requests.Session()
+
+    def _pack(self, arr: np.ndarray) -> Any:
+        if self.compress == "int8":
+            return codec.q8_compress(np.asarray(arr))
+        return np.asarray(arr)
 
     def _post(self, path: str, payload: Dict[str, Any]) -> Dict[str, Any]:
         from split_learning_tpu.runtime.server import ProtocolError
+        if self.compress != "none":
+            payload = dict(payload, compress=self.compress)
         body = codec.encode(payload)
         try:
             resp = self._session.post(
@@ -142,13 +160,13 @@ class HttpTransport(Transport):
         if resp.status_code != 200:
             raise TransportError(
                 f"POST {path} -> {resp.status_code}: {resp.content[:200]!r}")
-        return codec.decode(resp.content)
+        return codec.decompress_tree(codec.decode(resp.content))
 
     def split_step(self, activations: np.ndarray, labels: np.ndarray,
                    step: int, client_id: int = 0) -> Tuple[np.ndarray, float]:
         with timed(self.stats):
             out = self._post("/forward_pass", {
-                "activations": np.asarray(activations),
+                "activations": self._pack(activations),
                 "labels": np.asarray(labels),
                 "step": step, "client_id": client_id,
             })
@@ -158,7 +176,7 @@ class HttpTransport(Transport):
                   client_id: int = 0) -> np.ndarray:
         with timed(self.stats):
             return self._post("/u_forward", {
-                "activations": np.asarray(activations), "step": step,
+                "activations": self._pack(activations), "step": step,
                 "client_id": client_id,
             })["features"]
 
@@ -166,7 +184,7 @@ class HttpTransport(Transport):
                    client_id: int = 0) -> np.ndarray:
         with timed(self.stats):
             return self._post("/u_backward", {
-                "feat_grads": np.asarray(feat_grads), "step": step,
+                "feat_grads": self._pack(feat_grads), "step": step,
                 "client_id": client_id,
             })["grads"]
 

@@ -41,6 +41,7 @@ _ENV_MAP = {
     "data_dir": "SLT_DATA_DIR",
     "checkpoint_dir": "SLT_CHECKPOINT_DIR",
     "tracking": "SLT_TRACKING",
+    "kernels": "SLT_KERNELS",
 }
 
 
@@ -69,6 +70,10 @@ class Config:
     num_clients: int = 1      # data-parallel client replicas (mesh "data" axis)
     num_stages: int = 2       # pipeline stages (mesh "pipe" axis)
     microbatches: int = 1     # GPipe microbatches per step
+
+    # hot-path op implementation: "xla" (let the compiler fuse) or
+    # "pallas" (hand-written kernels, split_learning_tpu.ops)
+    kernels: str = "xla"
 
     # storage / tracking
     data_dir: str = os.path.expanduser("~/.cache/split_learning_tpu")
@@ -118,3 +123,7 @@ class Config:
             raise ValueError("microbatches must be positive")
         if self.batch_size % self.microbatches != 0:
             raise ValueError("batch_size must be divisible by microbatches")
+        if self.kernels not in ("xla", "pallas"):
+            raise ValueError(
+                f"Unknown kernels backend: {self.kernels!r} "
+                "(expected 'xla' or 'pallas')")

@@ -1,0 +1,205 @@
+"""Pallas kernel layer (split_learning_tpu.ops) — numerics vs references.
+
+Kernels run in Mosaic interpreter mode on the CPU test mesh
+(SURVEY.md §4 item 4); the same code compiles on real TPU.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from split_learning_tpu.ops import (
+    fused_cross_entropy,
+    quantize_dequantize,
+    quantize_int8,
+    dequantize_int8,
+    reference_cross_entropy,
+)
+from split_learning_tpu.ops.sgd import fused_sgd_step, init_trace, reference_sgd_step
+from split_learning_tpu.transport import codec
+
+
+# --------------------------------------------------------------------- #
+# fused cross-entropy
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("b,c", [(64, 10), (7, 10), (8, 128), (33, 200)])
+def test_ce_forward_matches_reference(rng, b, c):
+    kx, ky = jax.random.split(rng)
+    logits = jax.random.normal(kx, (b, c), jnp.float32) * 3.0
+    labels = jax.random.randint(ky, (b,), 0, c)
+    got = fused_cross_entropy(logits, labels)
+    want = reference_cross_entropy(logits, labels)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("b,c", [(64, 10), (7, 13)])
+def test_ce_gradient_matches_reference(rng, b, c):
+    kx, ky = jax.random.split(rng)
+    logits = jax.random.normal(kx, (b, c), jnp.float32) * 2.0
+    labels = jax.random.randint(ky, (b,), 0, c)
+    g_got = jax.grad(fused_cross_entropy)(logits, labels)
+    g_want = jax.grad(reference_cross_entropy)(logits, labels)
+    np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_ce_inside_jit_value_and_grad(rng):
+    """The kernel must trace under jit (the fused-trainer context)."""
+    kx, ky = jax.random.split(rng)
+    logits = jax.random.normal(kx, (16, 10), jnp.float32)
+    labels = jax.random.randint(ky, (16,), 0, 10)
+
+    @jax.jit
+    def f(lg, lb):
+        return jax.value_and_grad(fused_cross_entropy)(lg, lb)
+
+    loss, grad = f(logits, labels)
+    want = reference_cross_entropy(logits, labels)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(want), rtol=1e-5)
+    assert grad.shape == logits.shape
+
+
+# --------------------------------------------------------------------- #
+# fused SGD
+# --------------------------------------------------------------------- #
+def _tree(rng):
+    k1, k2, k3 = jax.random.split(rng, 3)
+    return {
+        "conv": {"kernel": jax.random.normal(k1, (3, 3, 1, 32)),
+                 "bias": jax.random.normal(k2, (32,))},
+        "dense": jax.random.normal(k3, (129, 257)),  # non-lane-aligned
+    }
+
+
+def test_sgd_no_momentum_matches_reference(rng):
+    kp, kg = jax.random.split(rng)
+    params, grads = _tree(kp), _tree(kg)
+    got, trace = fused_sgd_step(params, grads, None, lr=0.01)
+    want, _ = reference_sgd_step(params, grads, None, lr=0.01)
+    assert trace is None
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                rtol=1e-5, atol=1e-7),
+        got, want)
+
+
+def test_sgd_momentum_matches_optax_over_steps(rng):
+    """Multi-step: the fused trace must evolve exactly like optax.sgd."""
+    import optax
+    kp, kg = jax.random.split(rng)
+    params = _tree(kp)
+    tx = optax.sgd(0.01, momentum=0.9)
+    opt_state = tx.init(params)
+    fused_params, trace = params, init_trace(params)
+
+    for i in range(3):
+        grads = _tree(jax.random.fold_in(kg, i))
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        fused_params, trace = fused_sgd_step(
+            fused_params, grads, trace, lr=0.01, momentum=0.9)
+
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                rtol=1e-5, atol=1e-7),
+        fused_params, params)
+
+
+def test_sgd_large_leaf_gridded(rng):
+    """A leaf bigger than one block exercises the 1-D grid path."""
+    p = jax.random.normal(rng, (1200, 300))  # 360k elems > 512*128
+    g = jnp.ones_like(p)
+    got, _ = fused_sgd_step(p, g, None, lr=0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(p) - 0.5,
+                               rtol=1e-6)
+
+
+# --------------------------------------------------------------------- #
+# int8 quantization
+# --------------------------------------------------------------------- #
+def test_quantize_roundtrip_error_bound(rng):
+    x = jax.random.normal(rng, (64, 26, 26, 32), jnp.float32)
+    x_rt = quantize_dequantize(x)
+    # max error of symmetric int8 is scale/2 = max|x| / 254
+    bound = float(jnp.max(jnp.abs(x))) / 254.0 + 1e-6
+    assert float(jnp.max(jnp.abs(x_rt - x))) <= bound
+
+
+def test_quantize_zero_tensor(rng):
+    x = jnp.zeros((8, 128), jnp.float32)
+    x_rt = quantize_dequantize(x)
+    np.testing.assert_array_equal(np.asarray(x_rt), 0.0)
+
+
+def test_quantize_kernel_matches_wire_codec(rng):
+    """The Pallas kernel and the numpy wire codec share one math."""
+    x = jax.random.normal(rng, (16, 26, 26, 32), jnp.float32)
+    q_kernel, scale_kernel = quantize_int8(x)
+    wire = codec.q8_compress(np.asarray(x))
+    np.testing.assert_allclose(float(scale_kernel), wire["scale"], rtol=1e-6)
+    got = dequantize_int8(q_kernel, scale_kernel, x.shape)
+    want = codec.q8_decompress(wire)
+    np.testing.assert_allclose(np.asarray(got), want, atol=float(scale_kernel))
+
+
+def test_q8_wire_shrinks_payload(rng):
+    x = np.asarray(jax.random.normal(rng, (64, 26, 26, 32), jnp.float32))
+    raw = codec.encode(x)
+    compressed = codec.encode(codec.q8_compress(x))
+    assert len(compressed) < len(raw) / 3.5  # ~4x minus header overhead
+    back = codec.decompress_tree(codec.decode(compressed))
+    assert back.shape == x.shape and back.dtype == x.dtype
+
+
+# --------------------------------------------------------------------- #
+# fused trainer on the pallas path
+# --------------------------------------------------------------------- #
+def test_fused_trainer_pallas_matches_xla(rng, mnist_batch):
+    from split_learning_tpu.models import get_plan
+    from split_learning_tpu.runtime.fused import FusedSplitTrainer
+    from split_learning_tpu.utils import Config
+
+    x, y = mnist_batch
+    plan = get_plan(mode="split")
+    t_xla = FusedSplitTrainer(plan, Config(mode="split"), rng, np.asarray(x))
+    t_pal = FusedSplitTrainer(plan, Config(mode="split", kernels="pallas"),
+                              rng, np.asarray(x))
+    for _ in range(2):
+        l_xla = t_xla.train_step(np.asarray(x), np.asarray(y))
+        l_pal = t_pal.train_step(np.asarray(x), np.asarray(y))
+    np.testing.assert_allclose(l_pal, l_xla, rtol=1e-4, atol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6),
+        t_pal.params, t_xla.params)
+
+
+def test_http_transport_int8_compression(rng, mnist_batch):
+    """End-to-end split step over HTTP with int8 wire compression."""
+    from split_learning_tpu.models import get_plan
+    from split_learning_tpu.runtime import ServerRuntime, SplitClientTrainer
+    from split_learning_tpu.transport.http import HttpTransport, SplitHTTPServer
+    from split_learning_tpu.utils import Config
+
+    x, y = mnist_batch
+    x, y = np.asarray(x[:16]), np.asarray(y[:16])
+    cfg = Config(mode="split", batch_size=16)
+    plan = get_plan(mode="split")
+    runtime = ServerRuntime(plan, cfg, rng, x)
+    server = SplitHTTPServer(runtime).start()
+    try:
+        plain = HttpTransport(server.url)
+        lossy = HttpTransport(server.url, compress="int8")
+        c = SplitClientTrainer(plan, cfg, rng, lossy)
+        loss = c.train_step(x, y, 0)
+        assert np.isfinite(loss)
+        # cut tensor is [16, 26, 26, 32]; int8 wire ~1 byte/elem vs 4 fp32
+        acts_elems = 16 * 26 * 26 * 32
+        assert lossy.stats.bytes_sent < acts_elems * 1.1
+        assert lossy.stats.bytes_received < acts_elems * 1.1
+        plain.close()
+        lossy.close()
+    finally:
+        server.stop()

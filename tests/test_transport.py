@@ -173,3 +173,19 @@ def test_u_residual_eviction():
     # oldest entries were evicted; their backward now fails loudly
     with pytest.raises(ProtocolError):
         t.u_backward(np.zeros((2, 12 * 12 * 64), np.float32), step=0)
+
+
+def test_u_residual_eviction_is_per_client():
+    """One client's backlog must never evict another client's live
+    residual (many clients can sit between hop 1 and hop 2 at once)."""
+    server = make_server(mode="u_split")
+    acts = np.zeros((2, 26, 26, 32), np.float32)
+    g = np.zeros((2, 12 * 12 * 64), np.float32)
+    n = server.MAX_PENDING_RESIDUALS + 3  # more clients than the cap
+    transports = [LocalTransport(server) for _ in range(n)]
+    for cid, t in enumerate(transports):
+        t.u_forward(acts, step=0, client_id=cid)
+    # every client completes its hop 2 — nothing was evicted across clients
+    for cid, t in enumerate(transports):
+        out = t.u_backward(g, step=0, client_id=cid)
+        assert out.shape == acts.shape
