@@ -1,0 +1,9 @@
+"""Native (C++) runtime helpers, ctypes-bound, with NumPy fallbacks.
+
+The performance-critical host-side wire ops — int8 quantize/dequantize of
+the cut-layer tensor and frame checksumming — compiled from
+``slt_codec.cc`` on first use. See codec.py for the build strategy.
+"""
+
+from split_learning_tpu.native.codec import (  # noqa: F401
+    available, build_error, crc32, q8_dequantize, q8_quantize)

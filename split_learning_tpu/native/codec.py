@@ -1,0 +1,153 @@
+"""ctypes bindings for the native wire codec (slt_codec.cc).
+
+Build strategy: compile the single translation unit with ``g++ -O3 -shared
+-fPIC`` into a cache directory on first use (source-hash keyed, so edits
+rebuild), load with ctypes. No pybind11, no build system — the baked-in
+toolchain is the only dependency. If the toolchain or the build is
+unavailable, everything falls back to the NumPy implementations in
+``transport/codec.py`` (same math, parity-tested in tests/test_native.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "slt_codec.cc")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+_build_error: Optional[str] = None
+
+
+def _cache_dir() -> str:
+    root = os.environ.get("SLT_NATIVE_CACHE") or os.path.join(
+        tempfile.gettempdir(), f"slt_native-{os.getuid()}")
+    os.makedirs(root, exist_ok=True)
+    return root
+
+
+def _build() -> Optional[str]:
+    """Compile (or reuse) the shared library; returns its path or None."""
+    global _build_error
+    try:
+        with open(_SRC, "rb") as f:
+            src = f.read()
+    except OSError as exc:
+        _build_error = f"source missing: {exc}"
+        return None
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    out = os.path.join(_cache_dir(), f"slt_codec-{tag}.so")
+    if os.path.exists(out):
+        return out
+    tmp = out + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", tmp]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as exc:
+        _build_error = f"g++ unavailable: {exc}"
+        return None
+    if proc.returncode != 0:
+        _build_error = f"g++ failed: {proc.stderr[-500:]}"
+        return None
+    os.replace(tmp, out)  # atomic: concurrent builders converge
+    return out
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried, _build_error
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("SLT_NO_NATIVE"):
+            _build_error = "disabled via SLT_NO_NATIVE"
+            return None
+        path = _build()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError as exc:
+            _build_error = f"dlopen failed: {exc}"
+            return None
+        lib.slt_absmax_f32.restype = ctypes.c_float
+        lib.slt_absmax_f32.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int]
+        lib.slt_q8_quantize_f32.restype = ctypes.c_double
+        lib.slt_q8_quantize_f32.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int8), ctypes.c_int]
+        lib.slt_q8_dequantize_f32.restype = None
+        lib.slt_q8_dequantize_f32.argtypes = [
+            ctypes.POINTER(ctypes.c_int8), ctypes.c_int64, ctypes.c_float,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int]
+        lib.slt_crc32.restype = ctypes.c_uint32
+        lib.slt_crc32.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64, ctypes.c_uint32]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """True when the native library built and loaded."""
+    return _load() is not None
+
+
+def build_error() -> Optional[str]:
+    _load()
+    return _build_error
+
+
+def q8_quantize(arr: np.ndarray, n_threads: int = 0
+                ) -> Optional[Tuple[np.ndarray, float]]:
+    """float32 array -> (int8 array of same shape, scale); None if the
+    native path is unavailable or the input isn't float32."""
+    lib = _load()
+    if lib is None or arr.dtype != np.float32:
+        return None
+    a = np.ascontiguousarray(arr)
+    q = np.empty(a.shape, np.int8)
+    scale = lib.slt_q8_quantize_f32(
+        a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_int64(a.size),
+        q.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        ctypes.c_int(n_threads))
+    return q, float(scale)
+
+
+def q8_dequantize(q: np.ndarray, scale: float, n_threads: int = 0
+                  ) -> Optional[np.ndarray]:
+    """int8 array + scale -> float32 array of the same shape."""
+    lib = _load()
+    if lib is None:
+        return None
+    qc = np.ascontiguousarray(q, np.int8)
+    out = np.empty(qc.shape, np.float32)
+    lib.slt_q8_dequantize_f32(
+        qc.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        ctypes.c_int64(qc.size), ctypes.c_float(scale),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_int(n_threads))
+    return out
+
+
+def crc32(data: bytes, seed: int = 0) -> Optional[int]:
+    """zlib-compatible CRC-32; None if the native path is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+    return int(lib.slt_crc32(buf, ctypes.c_int64(len(data)),
+                             ctypes.c_uint32(seed)))

@@ -97,10 +97,19 @@ _Q8_EPS = 1e-12
 
 
 def q8_compress(arr: np.ndarray) -> dict:
-    """float array -> {__q8__, q(int8), scale, shape, dtype}."""
+    """float array -> {__q8__, q(int8), scale, shape, dtype}.
+
+    Uses the multithreaded C++ kernel (native/slt_codec.cc) when it built;
+    the NumPy path below is the bit-identical fallback (round-half-even,
+    same scale clamp — parity-tested in tests/test_native.py)."""
     a = np.ascontiguousarray(arr, dtype=np.float32)
-    scale = max(float(np.max(np.abs(a))) / 127.0, _Q8_EPS) if a.size else _Q8_EPS
-    q = np.clip(np.round(a / scale), -127, 127).astype(np.int8)
+    from split_learning_tpu import native
+    nat = native.q8_quantize(a)
+    if nat is not None:
+        q, scale = nat
+    else:
+        scale = max(float(np.max(np.abs(a))) / 127.0, _Q8_EPS) if a.size else _Q8_EPS
+        q = np.clip(np.round(a / scale), -127, 127).astype(np.int8)
     return {_Q8_KEY: True, "q": q, "scale": scale,
             "shape": list(a.shape), "dtype": str(np.asarray(arr).dtype)}
 
@@ -110,13 +119,27 @@ def is_q8(obj: Any) -> bool:
 
 
 def q8_decompress(d: dict) -> np.ndarray:
-    q = np.asarray(d["q"], np.int8).astype(np.float32)
-    x = (q * d["scale"]).reshape(d["shape"])
+    from split_learning_tpu import native
+    q8 = np.asarray(d["q"], np.int8)
+    nat = native.q8_dequantize(q8, float(d["scale"]))
+    if nat is not None:
+        x = nat.reshape(d["shape"])
+    else:
+        x = (q8.astype(np.float32) * d["scale"]).reshape(d["shape"])
     name = d["dtype"]
     if name == "bfloat16":  # stock numpy can't resolve the name
         import ml_dtypes
         return x.astype(np.dtype(ml_dtypes.bfloat16))
     return x.astype(np.dtype(name))
+
+
+def checksum(data: bytes) -> int:
+    """Frame checksum: IEEE CRC-32 via zlib — copy-free (buffer protocol)
+    and GIL-releasing, so it stays off the hot path's critical section.
+    native.crc32 computes the identical value (parity-tested) but would
+    copy the frame into a ctypes buffer first; zlib wins here."""
+    import zlib
+    return zlib.crc32(data) & 0xFFFFFFFF
 
 
 def decompress_tree(obj: Any) -> Any:

@@ -31,6 +31,8 @@ import requests
 from split_learning_tpu.transport import codec
 from split_learning_tpu.transport.base import Transport, TransportError, timed
 
+CRC_HEADER = "X-SLT-CRC32"
+
 
 class SplitHTTPServer:
     """Serves a ServerRuntime over HTTP (stdlib; no FastAPI dependency)."""
@@ -51,6 +53,8 @@ class SplitHTTPServer:
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                # frame integrity the reference's raw pickle bodies lack
+                self.send_header(CRC_HEADER, str(codec.checksum(body)))
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -64,6 +68,16 @@ class SplitHTTPServer:
                 from split_learning_tpu.runtime.server import ProtocolError
                 length = int(self.headers.get("Content-Length", 0))
                 raw = self.rfile.read(length)
+                sent_crc = self.headers.get(CRC_HEADER)
+                if sent_crc is not None:
+                    try:
+                        crc_ok = int(sent_crc) == codec.checksum(raw)
+                    except ValueError:  # malformed header is a bad frame too
+                        crc_ok = False
+                    if not crc_ok:
+                        self._reply(400, codec.encode(
+                            {"error": "frame checksum mismatch"}))
+                        return
                 try:
                     req = codec.decompress_tree(codec.decode(raw))
                     cid = int(req.get("client_id", 0))
@@ -151,10 +165,20 @@ class HttpTransport(Transport):
         try:
             resp = self._session.post(
                 f"{self.base_url}{path}", data=body, timeout=self.timeout,
-                headers={"Content-Type": "application/octet-stream"})
+                headers={"Content-Type": "application/octet-stream",
+                         CRC_HEADER: str(codec.checksum(body))})
         except requests.RequestException as exc:
             raise TransportError(f"POST {path} failed: {exc}") from exc
         self.stats.add_bytes(sent=len(body), received=len(resp.content))
+        resp_crc = resp.headers.get(CRC_HEADER)
+        if resp_crc is not None:
+            try:
+                crc_ok = int(resp_crc) == codec.checksum(resp.content)
+            except ValueError:
+                crc_ok = False
+            if not crc_ok:
+                raise TransportError(
+                    f"POST {path}: response checksum mismatch")
         if resp.status_code in (400, 409):
             raise ProtocolError(codec.decode(resp.content).get("error", ""))
         if resp.status_code != 200:

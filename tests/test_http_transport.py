@@ -31,7 +31,8 @@ def http_pair():
 def test_http_split_step_and_training(http_pair):
     cfg, plan, runtime, server, transport = http_pair
     h = transport.health()
-    assert h == {"status": "healthy", "mode": "split", "model_type": "part_b"}
+    assert h == {"status": "healthy", "mode": "split",
+                 "model_type": "part_b", "step": -1}
 
     client = SplitClientTrainer(plan, cfg, jax.random.PRNGKey(2), transport)
     rs = np.random.RandomState(1)

@@ -1,0 +1,80 @@
+"""Native C++ codec: builds with the baked-in toolchain and matches the
+NumPy reference implementation bit-for-bit (same absmax scale, same
+round-half-even quantization, zlib-identical CRC-32)."""
+
+import zlib
+
+import numpy as np
+import pytest
+
+from split_learning_tpu import native
+from split_learning_tpu.transport import codec
+
+
+def _numpy_q8(a: np.ndarray):
+    scale = max(float(np.max(np.abs(a))) / 127.0, 1e-12) if a.size else 1e-12
+    q = np.clip(np.round(a / scale), -127, 127).astype(np.int8)
+    return q, scale
+
+
+@pytest.fixture(scope="module")
+def built():
+    if not native.available():
+        pytest.skip(f"native codec unavailable: {native.build_error()}")
+    return True
+
+
+def test_builds(built):
+    assert native.available()
+
+
+def test_quantize_matches_numpy(built):
+    rs = np.random.RandomState(0)
+    for shape in [(64, 32, 26, 26), (1,), (17, 3), (0,)]:
+        a = (rs.randn(*shape) * 5).astype(np.float32)
+        nat = native.q8_quantize(a)
+        assert nat is not None
+        q_nat, s_nat = nat
+        q_np, s_np = _numpy_q8(a)
+        assert s_nat == pytest.approx(s_np, rel=0, abs=0)
+        np.testing.assert_array_equal(q_nat, q_np)
+
+
+def test_dequantize_matches_numpy(built):
+    rs = np.random.RandomState(1)
+    q = rs.randint(-127, 128, (1000,)).astype(np.int8)
+    scale = 0.037
+    out = native.q8_dequantize(q, scale)
+    np.testing.assert_array_equal(out, q.astype(np.float32) * np.float32(scale))
+
+
+def test_crc32_matches_zlib(built):
+    for data in [b"", b"hello", bytes(range(256)) * 100]:
+        assert native.crc32(data) == zlib.crc32(data) & 0xFFFFFFFF
+
+
+def test_q8_roundtrip_through_wire_codec(built):
+    """q8_compress (native path) -> encode -> decode -> decompress."""
+    rs = np.random.RandomState(2)
+    a = rs.randn(64, 32, 26, 26).astype(np.float32)
+    blob = codec.encode({"acts": codec.q8_compress(a)})
+    out = codec.decompress_tree(codec.decode(blob))["acts"]
+    assert out.shape == a.shape and out.dtype == a.dtype
+    # quantization error bounded by the step size
+    step = float(np.max(np.abs(a))) / 127.0
+    assert float(np.max(np.abs(out - a))) <= step * 0.5 + 1e-6
+
+
+def test_checksum_fallback_identical():
+    """codec.checksum is CRC-32 whether or not the native lib built."""
+    data = b"x" * 10000
+    assert codec.checksum(data) == zlib.crc32(data) & 0xFFFFFFFF
+
+
+def test_multithreaded_consistency(built):
+    rs = np.random.RandomState(3)
+    a = rs.randn(2_000_000).astype(np.float32)
+    q1, s1 = native.q8_quantize(a, n_threads=1)
+    q8, s8 = native.q8_quantize(a, n_threads=8)
+    assert s1 == s8
+    np.testing.assert_array_equal(q1, q8)
