@@ -9,18 +9,26 @@ Reference entry points (SURVEY.md §1): ``python client_part.py``
   python -m split_learning_tpu.launch.run serve --mode split --port 8000
   python -m split_learning_tpu.launch.run train --transport http \
       --server-url http://host:8000
+  python -m split_learning_tpu.launch.run eval --checkpoint-dir /tmp/ckpt
 
 Config resolution: CLI flags > env vars (LEARNING_MODE etc.) > defaults —
 one place, no hard-coded endpoints (the reference's URI-shadowing bug,
 ``src/server_part.py:19``, is structurally impossible here).
+
+Checkpoint/resume (the reference persists nothing — SURVEY.md §5): with
+``--checkpoint-dir`` the joint cross-party state is saved per epoch (and
+every ``--checkpoint-every`` steps on the fused/pipeline paths);
+``--resume`` restores the latest and re-arms the server's step handshake.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import os
 import sys
 import time
-from typing import Optional
+from typing import Any, Dict, Optional
 
 import numpy as np
 
@@ -42,13 +50,15 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--kernels", choices=["xla", "pallas"], default=None,
                    help="hot-path op implementation (pallas = "
                         "split_learning_tpu.ops kernels)")
+    p.add_argument("--checkpoint-dir", dest="checkpoint_dir", default=None)
 
 
 def _config_from_args(args) -> "Config":
     from split_learning_tpu.utils import Config
     overrides = {}
     for field in ("mode", "model", "dataset", "batch_size", "epochs", "lr",
-                  "seed", "data_dir", "tracking", "tracking_uri", "kernels"):
+                  "seed", "data_dir", "tracking", "tracking_uri", "kernels",
+                  "checkpoint_dir"):
         val = getattr(args, field, None)
         if val is not None:
             overrides[field] = val
@@ -60,6 +70,44 @@ def _config_from_args(args) -> "Config":
     return Config.from_env(**overrides)
 
 
+# --------------------------------------------------------------------- #
+# checkpoint layout bookkeeping: meta.json next to the orbax step dirs
+# records how the saved tree maps onto parties, so `eval` can reassemble
+# the full composition without reconstructing trainers.
+
+def _write_ckpt_meta(directory: str, layout: str, cfg) -> None:
+    path = os.path.join(os.path.abspath(os.path.expanduser(directory)),
+                        "meta.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"layout": layout, "mode": cfg.mode, "model": cfg.model,
+                   "dataset": cfg.dataset}, f)
+
+
+def _read_ckpt_meta(directory: str) -> Dict[str, Any]:
+    path = os.path.join(os.path.abspath(os.path.expanduser(directory)),
+                        "meta.json")
+    with open(path) as f:
+        return json.load(f)
+
+
+def _assemble_full_params(layout: str, raw: Dict[str, Any]):
+    """Per-stage param sequence for plan.apply from a raw checkpoint tree."""
+    if layout in ("fused", "pipeline"):
+        return raw["trainer"]["params"]
+    if layout == "split_local":
+        return [raw["client"]["params"], raw["server"]["params"]]
+    if layout == "u_split_local":
+        return [raw["client_a"]["params"], raw["server"]["params"],
+                raw["client_c"]["params"]]
+    if layout == "federated":
+        return raw["client"]["params"]
+    raise ValueError(
+        f"cannot evaluate a {layout!r} checkpoint: the client half alone "
+        "does not form the full composition (train with --transport local "
+        "or fused to checkpoint the joint state)")
+
+
 def cmd_train(args) -> int:
     import jax
 
@@ -69,6 +117,7 @@ def cmd_train(args) -> int:
     from split_learning_tpu.runtime import (
         FederatedClientTrainer, ServerRuntime, SplitClientTrainer,
         USplitClientTrainer)
+    from split_learning_tpu.runtime.checkpoint import Checkpointer
     from split_learning_tpu.transport import LocalTransport
     from split_learning_tpu.utils import Config
 
@@ -82,6 +131,8 @@ def cmd_train(args) -> int:
     logger = make_logger(cfg)
     rng = jax.random.PRNGKey(cfg.seed)
     sample = ds.train.x[:cfg.batch_size]
+
+    ckptr = Checkpointer(cfg.checkpoint_dir) if cfg.checkpoint_dir else None
 
     max_steps = args.steps
     _budget = {"n": max_steps if max_steps else None, "epoch": 0}
@@ -105,9 +156,11 @@ def cmd_train(args) -> int:
     t0 = time.time()
     n_steps = 0
     final_loss = float("nan")
+    full_params = None  # for --eval
 
     if args.transport in ("fused", "pipeline"):
         from split_learning_tpu.parallel import make_mesh
+        from split_learning_tpu.parallel.mesh import replicated
         if args.transport == "fused":
             from split_learning_tpu.runtime.fused import FusedSplitTrainer
             mesh = None
@@ -119,15 +172,40 @@ def cmd_train(args) -> int:
             mesh = make_mesh(num_clients=cfg.num_clients,
                              num_stages=plan.num_stages)
             trainer = PipelinedTrainer(plan, cfg, rng, sample, mesh)
-        step = 0
+
+        start_step = 0
+        if ckptr is not None:
+            _write_ckpt_meta(cfg.checkpoint_dir, "fused", cfg)
+            latest = ckptr.latest_step()
+            if args.resume and latest is not None:
+                tree = ckptr.restore({"trainer": trainer.state})
+                state = tree["trainer"]
+                if mesh is not None:
+                    state = jax.device_put(state, replicated(mesh))
+                trainer.state = state
+                start_step = latest
+                print(f"[ckpt] resumed at step {start_step} from "
+                      f"{cfg.checkpoint_dir}", file=sys.stderr)
+
+        def save(step: int) -> None:
+            if ckptr is not None and ckptr.latest_step() != step:
+                ckptr.save(step, {"trainer": trainer.state})
+
+        step = start_step
         for epoch in range(cfg.epochs):  # step cap enforced by data_iter
             for x, y in data_iter():
                 final_loss = trainer.train_step(x, y)
                 logger.log_metric("loss", final_loss, step=step)
                 step += 1
-        n_steps = step
+                if (args.checkpoint_every
+                        and (step - start_step) % args.checkpoint_every == 0):
+                    save(step)
+            save(step)
+        n_steps = step - start_step
+        full_params = trainer.state.params
     else:
         # MPMD path: a transport to a (possibly remote) server party
+        server: Optional[ServerRuntime] = None
         if args.transport == "http":
             from split_learning_tpu.transport.http import HttpTransport
             transport = HttpTransport(cfg.server_url,
@@ -139,18 +217,98 @@ def cmd_train(args) -> int:
         if cfg.mode == "split":
             client = SplitClientTrainer(plan, cfg, rng, transport,
                                         logger=logger)
+            layout = "split_local" if server is not None else "client_only"
         elif cfg.mode == "u_split":
             client = USplitClientTrainer(plan, cfg, rng, transport,
                                          logger=logger)
+            layout = "u_split_local" if server is not None else "client_only"
         else:
             client = FederatedClientTrainer(plan, cfg, rng, transport,
                                             logger=logger)
-        records = client.train(data_iter, epochs=cfg.epochs)
+            layout = "federated"
+        client.ensure_init(sample)
+
+        def party_tree() -> Dict[str, Any]:
+            tree: Dict[str, Any] = {}
+            if cfg.mode == "u_split":
+                tree["client_a"] = client.state_a
+                tree["client_c"] = client.state_c
+            else:
+                tree["client"] = client.state
+            if server is not None:
+                tree["server"] = server.state
+            return tree
+
+        start_step = 0
+        if ckptr is not None:
+            _write_ckpt_meta(cfg.checkpoint_dir, layout, cfg)
+            latest = ckptr.latest_step()
+            if args.resume and latest is not None:
+                tree = ckptr.restore(party_tree())
+                if cfg.mode == "u_split":
+                    client.state_a = tree["client_a"]
+                    client.state_c = tree["client_c"]
+                else:
+                    client.state = tree["client"]
+                if server is not None:
+                    # re-arms the step handshake: every client must resume
+                    # at or after the restored step (runtime/server.py)
+                    server.resume_from(tree["server"], latest)
+                start_step = latest
+                print(f"[ckpt] resumed at step {start_step} from "
+                      f"{cfg.checkpoint_dir}", file=sys.stderr)
+                if layout == "client_only":
+                    # remote server half: verify it is not behind this
+                    # checkpoint (a fresh server + resumed client would
+                    # silently desync the composition — the reference
+                    # hazard, SURVEY.md §3.4). Servers report their
+                    # acknowledged step in /health; serve --checkpoint-dir
+                    # --resume restores it.
+                    srv_step = transport.health().get("step", -1)
+                    if srv_step < start_step - 1:
+                        print(f"[ckpt] server is at step {srv_step} but the "
+                              f"client checkpoint is at {start_step}: the "
+                              "server half was not resumed. Restart it with "
+                              "serve --checkpoint-dir ... --resume, or drop "
+                              "--resume here to start both halves fresh.",
+                              file=sys.stderr)
+                        return 3
+
+        def on_epoch_end(epoch: int, next_step: int) -> None:
+            if ckptr is not None and ckptr.latest_step() != next_step:
+                ckptr.save(next_step, party_tree())
+
+        records = client.train(data_iter, epochs=cfg.epochs,
+                               start_step=start_step,
+                               on_epoch_end=on_epoch_end)
         n_steps = len(records)
         final_loss = records[-1].loss if records else float("nan")
         print(f"[transport] {transport.stats.summary()}", file=sys.stderr)
 
+        if cfg.mode == "federated":
+            full_params = client.state.params
+        elif server is not None:
+            if cfg.mode == "u_split":
+                full_params = [client.state_a.params, server.state.params,
+                               client.state_c.params]
+            else:
+                full_params = [client.state.params, server.state.params]
+
     dt = time.time() - t0
+
+    if args.eval:
+        if full_params is None:
+            print("[eval] full composition unavailable over a remote "
+                  "transport; skipping", file=sys.stderr)
+        else:
+            from split_learning_tpu.runtime.evaluate import evaluate
+            res = evaluate(plan, full_params, ds.test,
+                           batch_size=cfg.batch_size)
+            logger.log_metric("test_accuracy", res["accuracy"], step=n_steps)
+            logger.log_metric("test_loss", res["loss"], step=n_steps)
+            print(f"[eval] accuracy={res['accuracy']:.4f} "
+                  f"loss={res['loss']:.4f} n={res['examples']}")
+
     logger.close()
     print(f"[done] mode={cfg.mode} transport={args.transport} "
           f"steps={n_steps} final_loss={final_loss:.4f} "
@@ -163,6 +321,7 @@ def cmd_serve(args) -> int:
 
     from split_learning_tpu.models import get_plan
     from split_learning_tpu.runtime import ServerRuntime
+    from split_learning_tpu.runtime.checkpoint import Checkpointer
     from split_learning_tpu.transport.http import SplitHTTPServer
 
     from split_learning_tpu.data.datasets import _SHAPES
@@ -173,6 +332,28 @@ def cmd_serve(args) -> int:
                         (28, 28, 1))
     sample = np.zeros((cfg.batch_size,) + shape, np.float32)
     runtime = ServerRuntime(plan, cfg, jax.random.PRNGKey(cfg.seed), sample)
+
+    # the server party owns its half's persistence (the client cannot
+    # checkpoint it across HTTP): periodic saves + resume with the step
+    # handshake re-armed, so a restarted pair picks up in sync
+    if cfg.checkpoint_dir:
+        ckptr = Checkpointer(cfg.checkpoint_dir)
+        _write_ckpt_meta(cfg.checkpoint_dir, "server_only", cfg)
+        latest = ckptr.latest_step()
+        if args.resume and latest is not None:
+            tree = ckptr.restore({"server": runtime.state})
+            runtime.resume_from(tree["server"], latest)
+            print(f"[ckpt] server resumed at step {latest} from "
+                  f"{cfg.checkpoint_dir}", file=sys.stderr)
+
+        every = max(args.checkpoint_every, 1)
+
+        def on_step(step: int) -> None:
+            if (step + 1) % every == 0 and ckptr.latest_step() != step + 1:
+                ckptr.save(step + 1, {"server": runtime.state})
+
+        runtime.on_step = on_step
+
     server = SplitHTTPServer(runtime, host=args.host, port=args.port).start()
     print(f"[serve] mode={cfg.mode} listening on {server.url}")
     try:
@@ -181,6 +362,36 @@ def cmd_serve(args) -> int:
     except KeyboardInterrupt:
         print("[serve] shutting down")
         server.stop()
+    return 0
+
+
+def cmd_eval(args) -> int:
+    from split_learning_tpu.data import load_dataset
+    from split_learning_tpu.models import get_plan
+    from split_learning_tpu.runtime.checkpoint import Checkpointer
+    from split_learning_tpu.runtime.evaluate import evaluate
+
+    cfg = _config_from_args(args)
+    ckdir = cfg.checkpoint_dir
+    if not ckdir:
+        print("eval requires --checkpoint-dir", file=sys.stderr)
+        return 2
+    meta = _read_ckpt_meta(ckdir)
+    mode = args.mode or meta.get("mode", cfg.mode)
+    model = args.model or meta.get("model", cfg.model)
+    dataset = args.dataset or meta.get("dataset", cfg.dataset)
+
+    plan = get_plan(model=model, mode=mode, dtype=cfg.dtype)
+    ckptr = Checkpointer(ckdir)
+    step = args.step if args.step is not None else ckptr.latest_step()
+    raw = ckptr.restore_raw(step)
+    params = _assemble_full_params(meta["layout"], raw)
+    ds = load_dataset(dataset, cfg.data_dir)
+    res = evaluate(plan, params, ds.test, batch_size=cfg.batch_size)
+    print(json.dumps({"checkpoint_step": step, "dataset": dataset,
+                      "accuracy": round(res["accuracy"], 4),
+                      "loss": round(res["loss"], 4),
+                      "examples": res["examples"]}))
     return 0
 
 
@@ -205,13 +416,31 @@ def main(argv: Optional[list] = None) -> int:
     pt.add_argument("--compress", choices=["none", "int8"], default=None,
                     help="wire compression of the cut-layer tensors "
                          "(http transport only)")
+    pt.add_argument("--resume", action="store_true",
+                    help="restore the latest checkpoint before training")
+    pt.add_argument("--checkpoint-every", type=int, default=0,
+                    help="also checkpoint every N steps "
+                         "(fused/pipeline transports)")
+    pt.add_argument("--eval", action="store_true",
+                    help="report test-split accuracy after training")
     pt.set_defaults(fn=cmd_train)
 
     ps = sub.add_parser("serve", help="serve the server party over HTTP")
     _add_common(ps)
     ps.add_argument("--host", default="0.0.0.0")
     ps.add_argument("--port", type=int, default=8000)
+    ps.add_argument("--resume", action="store_true",
+                    help="restore the latest server checkpoint on startup")
+    ps.add_argument("--checkpoint-every", type=int, default=100,
+                    help="checkpoint the server half every N acknowledged "
+                         "steps (with --checkpoint-dir)")
     ps.set_defaults(fn=cmd_serve)
+
+    pe = sub.add_parser("eval", help="evaluate a checkpoint on the test split")
+    _add_common(pe)
+    pe.add_argument("--step", type=int, default=None,
+                    help="checkpoint step (default: latest)")
+    pe.set_defaults(fn=cmd_eval)
 
     args = ap.parse_args(argv)
     return args.fn(args)

@@ -48,6 +48,17 @@ class Checkpointer:
                     f"no checkpoints under {self.directory}")
         return self._mgr.restore(step, args=ocp.args.StandardRestore(template))
 
+    def restore_raw(self, step: Optional[int] = None) -> Any:
+        """Restore without a template: TrainStates come back as plain dicts
+        ({'params': [...], 'opt_state': ..., 'step': ...}) — enough for
+        evaluation, where only the params matter."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoints under {self.directory}")
+        return self._mgr.restore(step)
+
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 

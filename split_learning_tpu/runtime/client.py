@@ -141,10 +141,16 @@ class SplitClientTrainer:
         return loss
 
     def train(self, data_iter: Callable[[], Iterable[Tuple[np.ndarray, np.ndarray]]],
-              epochs: Optional[int] = None) -> List[StepRecord]:
-        """Full training run ≡ train_split_learning (3 epochs default)."""
+              epochs: Optional[int] = None, start_step: int = 0,
+              on_epoch_end: Optional[Callable[[int, int], None]] = None
+              ) -> List[StepRecord]:
+        """Full training run ≡ train_split_learning (3 epochs default).
+
+        ``start_step`` seeds the client-authoritative step counter (resume);
+        ``on_epoch_end(epoch, next_step)`` fires after each epoch
+        (checkpoint hook)."""
         records: List[StepRecord] = []
-        step = 0
+        step = start_step
         for epoch in range(epochs if epochs is not None else self.cfg.epochs):
             for x, y in data_iter():
                 loss = self.train_step(x, y, step)
@@ -153,6 +159,8 @@ class SplitClientTrainer:
                     if self.logger is not None:
                         self.logger.log_metric("loss", loss, step=step)
                 step += 1
+            if on_epoch_end is not None:
+                on_epoch_end(epoch, step)
         return records
 
 
@@ -218,9 +226,12 @@ class USplitClientTrainer:
         self.state_a = apply_grads(self._tx, self.state_a, g_a)
         return float(loss)
 
-    def train(self, data_iter, epochs: Optional[int] = None) -> List[StepRecord]:
+    def train(self, data_iter, epochs: Optional[int] = None,
+              start_step: int = 0,
+              on_epoch_end: Optional[Callable[[int, int], None]] = None
+              ) -> List[StepRecord]:
         records: List[StepRecord] = []
-        step = 0
+        step = start_step
         for epoch in range(epochs if epochs is not None else self.cfg.epochs):
             for x, y in data_iter():
                 loss = self.train_step(x, y, step)
@@ -228,6 +239,8 @@ class USplitClientTrainer:
                 if self.logger is not None:
                     self.logger.log_metric("loss", loss, step=step)
                 step += 1
+            if on_epoch_end is not None:
+                on_epoch_end(epoch, step)
         return records
 
 
@@ -259,9 +272,12 @@ class FederatedClientTrainer:
             params = tuple(self.plan.init(self._rng, jnp.asarray(sample_x)))
             self.state = make_state(params, self._tx)
 
-    def train(self, data_iter, epochs: Optional[int] = None) -> List[StepRecord]:
+    def train(self, data_iter, epochs: Optional[int] = None,
+              start_step: int = 0,
+              on_epoch_end: Optional[Callable[[int, int], None]] = None
+              ) -> List[StepRecord]:
         records: List[StepRecord] = []
-        step = 0
+        step = start_step
         for epoch in range(epochs if epochs is not None else self.cfg.epochs):
             epoch_losses = []
             for x, y in data_iter():
@@ -281,4 +297,6 @@ class FederatedClientTrainer:
             if self.logger is not None:
                 self.logger.log_metric("loss", avg_loss, step=step)
                 self.logger.log_metric("epoch", epoch, step=step)
+            if on_epoch_end is not None:
+                on_epoch_end(epoch, step)
         return records

@@ -1,0 +1,53 @@
+"""Test-split evaluation — accuracy + mean loss of the full composition.
+
+The reference downloads and caches the MNIST *test* split
+(``src/client_part.py:66-78``) but never evaluates on it: the only
+acceptance signal is the eyeballed MLflow loss curve (SURVEY.md §4).
+Here evaluation is a first-class op over any SplitPlan's full composition,
+usable on params from the fused trainer, an assembled MPMD pair, or a
+restored checkpoint.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from split_learning_tpu.core.losses import cross_entropy
+from split_learning_tpu.core.stage import SplitPlan
+from split_learning_tpu.data.datasets import Split, batches
+
+
+def evaluate(plan: SplitPlan, params: Sequence[Any], split: Split,
+             batch_size: int = 512) -> Dict[str, float]:
+    """Accuracy and mean CE loss of ``plan.apply(params, .)`` on a split.
+
+    ``params`` is the per-stage parameter sequence (tuple or list — a raw
+    orbax restore yields lists, which ``plan.apply`` accepts as-is).
+    """
+    params = jax.tree_util.tree_map(jnp.asarray, list(params))
+
+    @jax.jit
+    def fwd(params, x, y):
+        logits = plan.apply(params, x)
+        loss = cross_entropy(logits, y)
+        correct = jnp.sum(jnp.argmax(logits, axis=-1) == y)
+        return loss, correct
+
+    total = 0
+    correct_sum = 0
+    loss_sum = 0.0
+    # fixed order, keep the partial tail batch: every example counts once
+    for x, y in batches(split, batch_size, shuffle=False):
+        loss, correct = fwd(params, jnp.asarray(x), jnp.asarray(y))
+        n = len(y)
+        total += n
+        correct_sum += int(correct)
+        loss_sum += float(loss) * n
+    if total == 0:
+        return {"accuracy": float("nan"), "loss": float("nan"), "examples": 0}
+    return {"accuracy": correct_sum / total, "loss": loss_sum / total,
+            "examples": total}

@@ -55,6 +55,10 @@ class ServerRuntime:
         self.cfg = cfg
         self.mode = cfg.mode
         self.strict_steps = strict_steps
+        # optional hook fired (under the lock) after every completed op
+        # with the acknowledged client step — the serve CLI hangs periodic
+        # checkpointing off it
+        self.on_step: Optional[Any] = None
         self._lock = threading.RLock()
         # per-client step handshake (multi-client split: SURVEY.md config 3);
         # _step_floor is a global minimum installed by resume_from so that
@@ -138,14 +142,18 @@ class ServerRuntime:
             self.state, g_acts, loss = self._split_step(
                 self.state, jnp.asarray(activations), jnp.asarray(labels))
             self._last_step[client_id] = step
+            if self.on_step is not None:
+                self.on_step(step)
             return np.asarray(g_acts), float(loss)
 
-    # per-client bound on residuals awaiting their hop-2 u_backward: if a
-    # client dies between hops, its old entries are evicted instead of
-    # pinning cut-layer batches in device memory forever. The cap is per
-    # client_id so one client's backlog can never evict another's live
-    # residual.
+    # bounds on residuals awaiting their hop-2 u_backward. Per-client FIFO
+    # cap: one client's backlog can never evict another's live residual.
+    # Global cap: residuals of clients that died between hops (and whose
+    # client_id never returns) are still reclaimed by other clients'
+    # traffic, so total pinned cut-layer memory is bounded regardless of
+    # client churn.
     MAX_PENDING_RESIDUALS = 8
+    MAX_TOTAL_RESIDUALS = 64
 
     def u_forward(self, activations: np.ndarray, step: int,
                   client_id: int = 0) -> np.ndarray:
@@ -162,6 +170,11 @@ class ServerRuntime:
             # client's longest-waiting residual is the most likely orphan
             for key in mine[:max(len(mine) - self.MAX_PENDING_RESIDUALS, 0)]:
                 del self._u_residual[key]
+            # global FIFO backstop: reclaims orphans of dead client_ids
+            overflow = len(self._u_residual) - self.MAX_TOTAL_RESIDUALS
+            if overflow > 0:
+                for key in list(self._u_residual)[:overflow]:
+                    del self._u_residual[key]
             return np.asarray(feats)
 
     def u_backward(self, feat_grads: np.ndarray, step: int,
@@ -177,6 +190,8 @@ class ServerRuntime:
             self.state, g_acts = self._u_bwd(
                 self.state, acts, jnp.asarray(feat_grads))
             self._last_step[client_id] = step
+            if self.on_step is not None:
+                self.on_step(step)
             return np.asarray(g_acts)
 
     def aggregate(self, params: Any, epoch: int, loss: float,
@@ -192,6 +207,9 @@ class ServerRuntime:
                 params=mean_params,
                 opt_state=self.state.opt_state,
                 step=self.state.step + 1)
+            self._last_step[0] = max(self._last_step.get(0, -1), step)
+            if self.on_step is not None:
+                self.on_step(step)
         return mean_params
 
     def resume_from(self, state: TrainState, step: int) -> None:
@@ -209,9 +227,17 @@ class ServerRuntime:
                 self._agg = FedAvgAggregator(self._agg.num_clients)
 
     def health(self) -> Dict[str, Any]:
+        """≡ GET /health (src/server_part.py:95-102), plus ``step``: the
+        highest client step this server has acknowledged (or re-armed to
+        via resume_from) — lets a resuming client detect a server that is
+        behind its checkpoint instead of silently desyncing."""
         model_type = ("FullModel" if self.mode == "federated"
                       else self.plan.stages[self.plan.stages_of('server')[0]].name)
-        return {"status": "healthy", "mode": self.mode, "model_type": model_type}
+        with self._lock:
+            step = max(self._last_step.values(), default=-1)
+            step = max(step, self._step_floor)
+        return {"status": "healthy", "mode": self.mode,
+                "model_type": model_type, "step": step}
 
 
 class FedAvgAggregator:
