@@ -62,6 +62,22 @@ def stage_backward(stage: "Stage", params: Params, x: Array,
     return g_params
 
 
+def remat_plan(plan: "SplitPlan") -> "SplitPlan":
+    """A plan whose stages rematerialize under reverse-mode AD.
+
+    Wraps every stage's ``apply`` in :func:`jax.checkpoint`, so the pipeline
+    backward recomputes stage forwards instead of storing activations — the
+    FLOPs-for-HBM trade that lets deep plans (ResNet-18 4-stage, many
+    microbatches) fit. The MPMD party trainers already rematerialize by
+    construction (:func:`stage_backward`); this extends the same policy to
+    the fused/pipelined single-program paths (``Config.remat``).
+    """
+    stages = tuple(
+        dataclasses.replace(s, apply=jax.checkpoint(s.apply))
+        for s in plan.stages)
+    return dataclasses.replace(plan, stages=stages)
+
+
 def from_flax(name: str, module: Any) -> Stage:
     """Wrap a flax.linen Module as a Stage."""
     return Stage(

@@ -50,6 +50,12 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--kernels", choices=["xla", "pallas"], default=None,
                    help="hot-path op implementation (pallas = "
                         "split_learning_tpu.ops kernels)")
+    p.add_argument("--dtype", choices=["float32", "bfloat16"], default=None,
+                   help="compute dtype (params stay float32 — mixed "
+                        "precision)")
+    p.add_argument("--remat", action="store_const", const=True, default=None,
+                   help="rematerialize stage forwards in the backward pass "
+                        "(jax.checkpoint — trades FLOPs for HBM)")
     p.add_argument("--checkpoint-dir", dest="checkpoint_dir", default=None)
 
 
@@ -58,7 +64,7 @@ def _config_from_args(args) -> "Config":
     overrides = {}
     for field in ("mode", "model", "dataset", "batch_size", "epochs", "lr",
                   "seed", "data_dir", "tracking", "tracking_uri", "kernels",
-                  "checkpoint_dir"):
+                  "checkpoint_dir", "dtype", "remat"):
         val = getattr(args, field, None)
         if val is not None:
             overrides[field] = val

@@ -30,7 +30,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from split_learning_tpu.core.losses import cross_entropy
-from split_learning_tpu.core.stage import SplitPlan
+from split_learning_tpu.core.stage import SplitPlan, remat_plan
 from split_learning_tpu.parallel.mesh import DATA_AXIS, batch_sharding, replicated
 from split_learning_tpu.runtime.state import TrainState, apply_grads, make_state, sgd
 from split_learning_tpu.utils.config import Config
@@ -42,7 +42,8 @@ class FusedSplitTrainer:
     def __init__(self, plan: SplitPlan, cfg: Config, rng: jax.Array,
                  sample_input: np.ndarray,
                  mesh: Optional[Mesh] = None) -> None:
-        self.plan = plan
+        self.plan = plan if not cfg.remat else remat_plan(plan)
+        plan = self.plan  # grads recompute stage forwards under remat
         self.cfg = cfg
         self.mesh = mesh
         use_pallas = cfg.kernels == "pallas"

@@ -38,6 +38,7 @@ _ENV_MAP = {
     "num_clients": "SLT_NUM_CLIENTS",
     "num_stages": "SLT_NUM_STAGES",
     "microbatches": "SLT_MICROBATCHES",
+    "remat": "SLT_REMAT",
     "data_dir": "SLT_DATA_DIR",
     "checkpoint_dir": "SLT_CHECKPOINT_DIR",
     "tracking": "SLT_TRACKING",
@@ -70,6 +71,7 @@ class Config:
     num_clients: int = 1      # data-parallel client replicas (mesh "data" axis)
     num_stages: int = 2       # pipeline stages (mesh "pipe" axis)
     microbatches: int = 1     # GPipe microbatches per step
+    remat: bool = False       # jax.checkpoint stage forwards (FLOPs for HBM)
 
     # hot-path op implementation: "xla" (let the compiler fuse) or
     # "pallas" (hand-written kernels, split_learning_tpu.ops)
@@ -105,6 +107,8 @@ class Config:
                     kw[field_name] = int(raw)
                 elif ftype in ("float", float):
                     kw[field_name] = float(raw)
+                elif ftype in ("bool", bool):
+                    kw[field_name] = raw.strip().lower() in ("1", "true", "yes")
                 else:
                     kw[field_name] = raw
         kw.update(overrides)
