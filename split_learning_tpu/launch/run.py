@@ -115,6 +115,14 @@ def _assemble_full_params(layout: str, raw: Dict[str, Any]):
 
 
 def cmd_train(args) -> int:
+    # must run before any JAX backend initializes (DCN multi-host, no-op
+    # for single-process runs)
+    from split_learning_tpu.parallel.distributed import init_multi_host
+    multi_host = init_multi_host(
+        coordinator_address=getattr(args, "coordinator", None),
+        num_processes=getattr(args, "num_processes", None),
+        process_id=getattr(args, "process_id", None))
+
     import jax
 
     from split_learning_tpu.data import batches, load_dataset
@@ -134,6 +142,11 @@ def cmd_train(args) -> int:
     if ds.synthetic:
         print(f"[data] using synthetic {ds.name} "
               f"({len(ds.train)} train examples)", file=sys.stderr)
+    if multi_host and jax.process_index() != 0:
+        # one metrics stream per job: non-coordinator hosts run the same
+        # SPMD program but stay silent (≡ only the server logs to MLflow
+        # in the reference, src/server_part.py:55)
+        cfg = cfg.replace(tracking="noop")
     logger = make_logger(cfg)
     rng = jax.random.PRNGKey(cfg.seed)
     sample = ds.train.x[:cfg.batch_size]
@@ -165,18 +178,18 @@ def cmd_train(args) -> int:
     full_params = None  # for --eval
 
     if args.transport in ("fused", "pipeline"):
-        from split_learning_tpu.parallel import make_mesh
+        from split_learning_tpu.parallel import global_mesh
         from split_learning_tpu.parallel.mesh import replicated
         if args.transport == "fused":
             from split_learning_tpu.runtime.fused import FusedSplitTrainer
             mesh = None
-            if cfg.num_clients > 1:
-                mesh = make_mesh(num_clients=cfg.num_clients, num_stages=1)
+            if cfg.num_clients > 1 or multi_host:
+                mesh = global_mesh(num_clients=cfg.num_clients, num_stages=1)
             trainer = FusedSplitTrainer(plan, cfg, rng, sample, mesh=mesh)
         else:
             from split_learning_tpu.parallel.pipeline import PipelinedTrainer
-            mesh = make_mesh(num_clients=cfg.num_clients,
-                             num_stages=plan.num_stages)
+            mesh = global_mesh(num_clients=cfg.num_clients,
+                               num_stages=plan.num_stages)
             trainer = PipelinedTrainer(plan, cfg, rng, sample, mesh)
 
         start_step = 0
@@ -415,6 +428,13 @@ def main(argv: Optional[list] = None) -> int:
                     help="stop after N steps (0 = full epochs)")
     pt.add_argument("--num-clients", dest="num_clients", type=int,
                     default=None)
+    pt.add_argument("--coordinator", default=None,
+                    help="host:port of process 0 for multi-host DCN runs "
+                         "(or SLT_COORDINATOR; on k8s, a headless Service)")
+    pt.add_argument("--num-processes", dest="num_processes", type=int,
+                    default=None, help="total hosts in the multi-host job")
+    pt.add_argument("--process-id", dest="process_id", type=int, default=None,
+                    help="this host's index (k8s: the pod ordinal)")
     pt.add_argument("--microbatches", type=int, default=None)
     pt.add_argument("--require-real", action="store_true",
                     help="fail if real dataset files are absent instead of "

@@ -5,5 +5,13 @@ from split_learning_tpu.parallel.mesh import (
     make_mesh,
     replicated,
 )
+from split_learning_tpu.parallel.distributed import (
+    global_mesh,
+    init_multi_host,
+    is_coordinator,
+)
 
-__all__ = ["make_mesh", "batch_sharding", "replicated", "DATA_AXIS", "PIPE_AXIS"]
+__all__ = [
+    "make_mesh", "batch_sharding", "replicated", "DATA_AXIS", "PIPE_AXIS",
+    "global_mesh", "init_multi_host", "is_coordinator",
+]

@@ -1,0 +1,142 @@
+"""Multi-host runtime — the DCN scaling story (SURVEY.md §2 "Distributed
+communication backend": "``jax.distributed`` over DCN for multi-host").
+
+The reference scales across machines with Kubernetes pods + ClusterIP DNS
+(``k8s/split-learning.yaml``), shipping tensors as pickle-over-HTTP. Here a
+multi-host deployment is one SPMD program: every host runs the same jitted
+step over a *global* mesh, and XLA routes collectives over ICI within a host
+and DCN between hosts.
+
+Topology policy (the part that decides performance): the ``pipe`` axis —
+whose per-microbatch ``ppermute`` hops move the 5.28 MiB cut tensors — is
+always laid out *within* a host's ICI domain; only the ``data`` axis spans
+hosts, so the sole DCN-crossing collective is the once-per-step gradient
+``psum``, which is latency-tolerant and overlappable. That is the standard
+DP-over-DCN / MP-over-ICI recipe.
+
+Coordinator discovery is env-driven to fit k8s: a headless Service name
+works as ``SLT_COORDINATOR`` exactly like the reference's
+``split-server.mlflow.svc.cluster.local`` addressing
+(``src/client_part.py:100-101``), with the pod ordinal as the process id.
+
+Data feeding contract: every host constructs the *identical* global batch
+(the launch CLI guarantees this — same dataset cache, same epoch seed), so
+``jax.device_put`` against the global batch sharding is well-defined on
+each process; each host materializes only its addressable shard.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from split_learning_tpu.parallel.mesh import DATA_AXIS, PIPE_AXIS, make_mesh
+
+_ENV_COORDINATOR = "SLT_COORDINATOR"      # host:port of process 0
+_ENV_NUM_PROCESSES = "SLT_NUM_PROCESSES"
+_ENV_PROCESS_ID = "SLT_PROCESS_ID"
+
+_initialized = False
+
+
+def init_multi_host(coordinator_address: Optional[str] = None,
+                    num_processes: Optional[int] = None,
+                    process_id: Optional[int] = None) -> bool:
+    """Join the multi-host SPMD runtime via ``jax.distributed``.
+
+    Arguments default from ``SLT_COORDINATOR`` / ``SLT_NUM_PROCESSES`` /
+    ``SLT_PROCESS_ID``. A single-process configuration (no coordinator, or
+    num_processes <= 1) is a no-op returning False — the same binary runs
+    unchanged on one host, mirroring how the reference's processes run
+    identically under k3d or a real cluster.
+
+    Must be called before any JAX backend initializes. Idempotent.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    coordinator_address = coordinator_address or os.environ.get(
+        _ENV_COORDINATOR) or None
+    if num_processes is None:
+        raw = os.environ.get(_ENV_NUM_PROCESSES)
+        num_processes = int(raw) if raw else None
+    if process_id is None:
+        raw = os.environ.get(_ENV_PROCESS_ID)
+        process_id = int(raw) if raw else None
+
+    if not coordinator_address or not num_processes or num_processes <= 1:
+        return False
+    if process_id is None:
+        raise ValueError(
+            f"multi-host init needs a process id ({_ENV_PROCESS_ID}; on k8s "
+            "use the StatefulSet pod ordinal)")
+    import jax
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+    _initialized = True
+    return True
+
+
+def _grid_rows(devices: Sequence, num_stages: int,
+               process_of: Callable = lambda d: d.process_index
+               ) -> List[List]:
+    """Rows of a (data x pipe) grid in which every row's ``num_stages``
+    devices belong to one process — pipe hops never cross DCN.
+
+    Pure layout logic, separated from Mesh construction so it is testable
+    without multi-host hardware.
+    """
+    by_proc: dict = {}
+    for d in devices:
+        by_proc.setdefault(process_of(d), []).append(d)
+    rows: List[List] = []
+    for proc in sorted(by_proc):
+        local = by_proc[proc]
+        if len(local) % num_stages != 0:
+            raise ValueError(
+                f"process {proc} has {len(local)} devices, not divisible by "
+                f"num_stages={num_stages}: a pipeline stage chain would have "
+                "to cross DCN")
+        for i in range(0, len(local), num_stages):
+            rows.append(local[i:i + num_stages])
+    return rows
+
+
+def global_mesh(num_clients: int = 1, num_stages: int = 1,
+                devices: Optional[Sequence] = None):
+    """A (data x pipe) mesh over every device of every host.
+
+    Single-process: identical to :func:`make_mesh`. Multi-host: the pipe
+    axis is packed within each host's devices (ICI), hosts stack along the
+    data axis (DCN) — see the module docstring for why.
+    """
+    import jax
+    from jax.sharding import Mesh
+    if devices is None:
+        devices = jax.devices()
+    n_procs = len({d.process_index for d in devices})
+    if n_procs <= 1:
+        return make_mesh(num_clients=num_clients, num_stages=num_stages,
+                         devices=devices)
+    rows = _grid_rows(devices, num_stages)
+    if len(rows) < num_clients:
+        raise ValueError(
+            f"mesh needs {num_clients} data rows of {num_stages} stages, "
+            f"but {len(devices)} devices across {n_procs} hosts yield only "
+            f"{len(rows)}")
+    grid = np.asarray(rows[:num_clients], dtype=object)
+    return Mesh(grid, (DATA_AXIS, PIPE_AXIS))
+
+
+def process_count() -> int:
+    import jax
+    return jax.process_count()
+
+
+def is_coordinator() -> bool:
+    import jax
+    return jax.process_index() == 0
