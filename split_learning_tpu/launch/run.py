@@ -303,6 +303,11 @@ def cmd_train(args) -> int:
         n_steps = len(records)
         final_loss = records[-1].loss if records else float("nan")
         print(f"[transport] {transport.stats.summary()}", file=sys.stderr)
+        if transport.stats.round_trips:
+            # the north-star latency series (SURVEY.md §5 metrics)
+            logger.log_metric("transport_p50_ms",
+                              transport.stats.percentile(50) * 1e3,
+                              step=n_steps)
 
         if cfg.mode == "federated":
             full_params = client.state.params
@@ -314,6 +319,8 @@ def cmd_train(args) -> int:
                 full_params = [client.state.params, server.state.params]
 
     dt = time.time() - t0
+    if n_steps and dt > 0:
+        logger.log_metric("steps_per_sec", n_steps / dt, step=n_steps)
 
     if args.eval:
         if full_params is None:

@@ -117,18 +117,38 @@ class FusedSplitTrainer:
             new_state = update(state, grads)
             return new_state, loss
 
+        def epoch_fn(state: TrainState, xs, ys):
+            """T steps in one XLA program: lax.scan over the step axis.
+
+            Amortizes per-step host dispatch (~100us, comparable to the
+            whole on-chip step for the MNIST CNN) across T steps — the
+            jit-once/scan-many idiom the reference's per-batch HTTP round
+            trip structurally rules out."""
+            return jax.lax.scan(
+                lambda s, xy: step_fn(s, xy[0], xy[1]), state, (xs, ys))
+
         if mesh is not None:
             state_sh = jax.tree_util.tree_map(
                 lambda _: replicated(mesh), state)
             data_sh = batch_sharding(mesh)
+            seq_sh = NamedSharding(mesh, P(None, DATA_AXIS))
             self._step = jax.jit(
                 step_fn,
                 in_shardings=(state_sh, data_sh, data_sh),
                 out_shardings=(state_sh, replicated(mesh)),
                 donate_argnums=(0,),
             )
+            self._epoch = jax.jit(
+                epoch_fn,
+                in_shardings=(state_sh, seq_sh, seq_sh),
+                out_shardings=(state_sh, replicated(mesh)),
+                donate_argnums=(0,),
+            )
+            self._seq_sharding = seq_sh
         else:
             self._step = jax.jit(step_fn, donate_argnums=(0,))
+            self._epoch = jax.jit(epoch_fn, donate_argnums=(0,))
+            self._seq_sharding = None
 
     def train_step(self, x: np.ndarray, y: np.ndarray) -> float:
         """One fused step on the global batch (sharded over clients)."""
@@ -139,6 +159,17 @@ class FusedSplitTrainer:
             y = jax.device_put(y, self._x_sharding)
         self.state, loss = self._step(self.state, x, y)
         return float(loss)
+
+    def train_epoch(self, xs, ys) -> jax.Array:
+        """Run ``xs.shape[0]`` steps in one device dispatch; returns the
+        per-step loss series (device array, not blocked on)."""
+        xs = jnp.asarray(xs)
+        ys = jnp.asarray(ys)
+        if self._seq_sharding is not None:
+            xs = jax.device_put(xs, self._seq_sharding)
+            ys = jax.device_put(ys, self._seq_sharding)
+        self.state, losses = self._epoch(self.state, xs, ys)
+        return losses
 
     def train_step_async(self, x, y) -> jax.Array:
         """Like train_step but does not block on the loss transfer —
