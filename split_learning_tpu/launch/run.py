@@ -321,6 +321,10 @@ def cmd_train(args) -> int:
     dt = time.time() - t0
     if n_steps and dt > 0:
         logger.log_metric("steps_per_sec", n_steps / dt, step=n_steps)
+    if ckptr is not None:
+        # finally use the artifact root the reference configures but never
+        # writes to (SURVEY.md §5 checkpoint gap); no-op off-mlflow
+        logger.log_artifact(ckptr.directory)
 
     if args.eval:
         if full_params is None:

@@ -44,6 +44,10 @@ class MetricLogger:
     def log_params(self, params: Dict[str, Any]) -> None:
         pass
 
+    def log_artifact(self, path: str) -> None:
+        """Persist a file/directory with the run (checkpoints). No-op on
+        backends without an artifact store."""
+
     def close(self) -> None:
         pass
 
@@ -127,7 +131,10 @@ class MlflowLogger(MetricLogger):
     def log_artifact(self, path: str) -> None:
         # uses the artifact root the reference configures but never writes
         # to (k8s/mlflow-stack.yaml:259, SURVEY.md §5 checkpoint gap)
-        self._mlflow.log_artifact(path)
+        if os.path.isdir(path):
+            self._mlflow.log_artifacts(path, artifact_path=os.path.basename(path))
+        else:
+            self._mlflow.log_artifact(path)
 
     def close(self) -> None:
         self._mlflow.end_run()
@@ -144,6 +151,10 @@ class MultiLogger(MetricLogger):
     def log_params(self, params: Dict[str, Any]) -> None:
         for lg in self.loggers:
             lg.log_params(params)
+
+    def log_artifact(self, path: str) -> None:
+        for lg in self.loggers:
+            lg.log_artifact(path)
 
     def close(self) -> None:
         for lg in self.loggers:

@@ -161,3 +161,14 @@ def test_multi_logger(capsys):
     lg = MultiLogger([StdoutLogger(every=1)])
     lg.log_metric("loss", 2.0, step=0)
     assert "loss: 2.0000" in capsys.readouterr().out
+
+
+def test_log_artifact_is_noop_without_artifact_store(tmp_path):
+    """Every backend accepts log_artifact; only mlflow persists it, so the
+    CLI can call it unconditionally after checkpoint saves."""
+    p = tmp_path / "ckpt"
+    p.mkdir()
+    for lg in (StdoutLogger(), MultiLogger([StdoutLogger()]),
+               JsonlLogger(str(tmp_path / "m.jsonl"))):
+        lg.log_artifact(str(p))  # must not raise
+        lg.close()
