@@ -69,7 +69,7 @@ def _config_from_args(args) -> "Config":
         if val is not None:
             overrides[field] = val
     for field in ("transport", "num_clients", "num_stages", "microbatches",
-                  "server_url"):
+                  "server_url", "model_parallel"):
         val = getattr(args, field, None)
         if val is not None:
             overrides[field] = val
@@ -183,8 +183,9 @@ def cmd_train(args) -> int:
         if args.transport == "fused":
             from split_learning_tpu.runtime.fused import FusedSplitTrainer
             mesh = None
-            if cfg.num_clients > 1 or multi_host:
-                mesh = global_mesh(num_clients=cfg.num_clients, num_stages=1)
+            if cfg.num_clients > 1 or cfg.model_parallel > 1 or multi_host:
+                mesh = global_mesh(num_clients=cfg.num_clients, num_stages=1,
+                                   model_parallel=cfg.model_parallel)
             trainer = FusedSplitTrainer(plan, cfg, rng, sample, mesh=mesh)
         else:
             from split_learning_tpu.parallel.pipeline import PipelinedTrainer
@@ -439,6 +440,10 @@ def main(argv: Optional[list] = None) -> int:
                     help="stop after N steps (0 = full epochs)")
     pt.add_argument("--num-clients", dest="num_clients", type=int,
                     default=None)
+    pt.add_argument("--model-parallel", dest="model_parallel", type=int,
+                    default=None,
+                    help="tensor-parallel shards (mesh 'model' axis; "
+                         "fused transport)")
     pt.add_argument("--coordinator", default=None,
                     help="host:port of process 0 for multi-host DCN runs "
                          "(or SLT_COORDINATOR; on k8s, a headless Service)")

@@ -107,12 +107,15 @@ def _grid_rows(devices: Sequence, num_stages: int,
 
 
 def global_mesh(num_clients: int = 1, num_stages: int = 1,
+                model_parallel: int = 1,
                 devices: Optional[Sequence] = None):
-    """A (data x pipe) mesh over every device of every host.
+    """A (data x pipe[, model]) mesh over every device of every host.
 
     Single-process: identical to :func:`make_mesh`. Multi-host: the pipe
     axis is packed within each host's devices (ICI), hosts stack along the
-    data axis (DCN) — see the module docstring for why.
+    data axis (DCN) — see the module docstring for why. Tensor parallelism
+    is an ICI-bandwidth technique (per-layer activation collectives), so it
+    is confined to single-host meshes.
     """
     import jax
     from jax.sharding import Mesh
@@ -121,7 +124,12 @@ def global_mesh(num_clients: int = 1, num_stages: int = 1,
     n_procs = len({d.process_index for d in devices})
     if n_procs <= 1:
         return make_mesh(num_clients=num_clients, num_stages=num_stages,
-                         devices=devices)
+                         model_parallel=model_parallel, devices=devices)
+    if model_parallel > 1:
+        raise ValueError(
+            "tensor parallelism (model axis) shards per-layer activation "
+            "collectives and must stay on ICI; it is not supported across "
+            "hosts — use data/pipe axes over DCN instead")
     rows = _grid_rows(devices, num_stages)
     if len(rows) < num_clients:
         raise ValueError(

@@ -7,12 +7,16 @@ Axes:
   Deployment replica count, pinned to 1 at ``k8s/split-learning.yaml:49``;
   here a real axis with psum gradient aggregation — BASELINE.md config 3),
 - ``pipe``: pipeline stages (the client/server cut generalized to N stages
-  — BASELINE.md configs 2, 4, 5).
+  — BASELINE.md configs 2, 4, 5),
+- ``model``: intra-layer tensor parallelism (SURVEY.md §2 parallelism
+  table: "out of scope unless cheap via pjit sharding specs" — it is:
+  weight matrices shard their output-feature dim, XLA's sharding
+  propagation inserts the collectives).
 """
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Any, Optional, Sequence
 
 import jax
 import numpy as np
@@ -20,20 +24,51 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 PIPE_AXIS = "pipe"
+MODEL_AXIS = "model"
 
 
 def make_mesh(num_clients: int = 1, num_stages: int = 1,
+              model_parallel: int = 1,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """A (data × pipe) mesh over the first num_clients*num_stages devices."""
+    """A (data × pipe[, model]) mesh over the first
+    num_clients*num_stages*model_parallel devices. The model axis is only
+    materialized when model_parallel > 1, so existing (data × pipe)
+    callers are unchanged."""
     if devices is None:
         devices = jax.devices()
-    need = num_clients * num_stages
+    need = num_clients * num_stages * model_parallel
     if len(devices) < need:
         raise ValueError(
             f"mesh needs {need} devices ({num_clients} clients x "
-            f"{num_stages} stages), only {len(devices)} available")
+            f"{num_stages} stages x {model_parallel} model shards), "
+            f"only {len(devices)} available")
+    if model_parallel > 1:
+        grid = np.asarray(devices[:need]).reshape(
+            num_clients, num_stages, model_parallel)
+        return Mesh(grid, (DATA_AXIS, PIPE_AXIS, MODEL_AXIS))
     grid = np.asarray(devices[:need]).reshape(num_clients, num_stages)
     return Mesh(grid, (DATA_AXIS, PIPE_AXIS))
+
+
+def tp_param_sharding(mesh: Mesh, params: Any) -> Any:
+    """Tensor-parallel shardings for a param pytree: every weight leaf
+    shards its last (output-feature) dim over the ``model`` axis when that
+    dim divides evenly; everything else (biases, scales, odd shapes) is
+    replicated. This is the whole TP implementation — XLA's sharding
+    propagation partitions the matmuls/convs and inserts the collectives.
+    """
+    if MODEL_AXIS not in mesh.axis_names:
+        return jax.tree_util.tree_map(lambda _: replicated(mesh), params)
+    n_model = mesh.shape[MODEL_AXIS]
+
+    def leaf_sharding(leaf):
+        if (getattr(leaf, "ndim", 0) >= 2
+                and leaf.shape[-1] % n_model == 0):
+            spec = (None,) * (leaf.ndim - 1) + (MODEL_AXIS,)
+            return NamedSharding(mesh, P(*spec))
+        return replicated(mesh)
+
+    return jax.tree_util.tree_map(leaf_sharding, params)
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

@@ -31,7 +31,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from split_learning_tpu.core.losses import cross_entropy
 from split_learning_tpu.core.stage import SplitPlan, remat_plan
-from split_learning_tpu.parallel.mesh import DATA_AXIS, batch_sharding, replicated
+from split_learning_tpu.parallel.mesh import (
+    DATA_AXIS, batch_sharding, replicated, tp_param_sharding)
 from split_learning_tpu.runtime.state import TrainState, apply_grads, make_state, sgd
 from split_learning_tpu.utils.config import Config
 
@@ -61,8 +62,12 @@ class FusedSplitTrainer:
         else:
             state = make_state(params, self._tx)
         if mesh is not None:
-            # params replicated across the mesh; batch sharded over 'data'
-            state = jax.device_put(state, replicated(mesh))
+            # batch sharded over 'data'; params replicated — except under
+            # tensor parallelism, where weight matrices shard their output
+            # features over 'model' (optimizer traces mirror their params,
+            # so the same per-leaf rule shards them identically)
+            self._state_sh = tp_param_sharding(mesh, state)
+            state = jax.device_put(state, self._state_sh)
             self._x_sharding = batch_sharding(mesh)
         else:
             self._x_sharding = None
@@ -128,8 +133,7 @@ class FusedSplitTrainer:
                 lambda s, xy: step_fn(s, xy[0], xy[1]), state, (xs, ys))
 
         if mesh is not None:
-            state_sh = jax.tree_util.tree_map(
-                lambda _: replicated(mesh), state)
+            state_sh = self._state_sh
             data_sh = batch_sharding(mesh)
             seq_sh = NamedSharding(mesh, P(None, DATA_AXIS))
             self._step = jax.jit(

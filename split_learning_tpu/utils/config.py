@@ -39,6 +39,7 @@ _ENV_MAP = {
     "num_stages": "SLT_NUM_STAGES",
     "microbatches": "SLT_MICROBATCHES",
     "remat": "SLT_REMAT",
+    "model_parallel": "SLT_MODEL_PARALLEL",
     "data_dir": "SLT_DATA_DIR",
     "checkpoint_dir": "SLT_CHECKPOINT_DIR",
     "tracking": "SLT_TRACKING",
@@ -70,6 +71,7 @@ class Config:
     # parallelism
     num_clients: int = 1      # data-parallel client replicas (mesh "data" axis)
     num_stages: int = 2       # pipeline stages (mesh "pipe" axis)
+    model_parallel: int = 1   # tensor-parallel shards (mesh "model" axis)
     microbatches: int = 1     # GPipe microbatches per step
     remat: bool = False       # jax.checkpoint stage forwards (FLOPs for HBM)
 

@@ -1,0 +1,111 @@
+"""Tensor parallelism over the ``model`` mesh axis (SURVEY.md §2: TP is in
+scope exactly because pjit sharding specs make it cheap — weight matrices
+shard their output-feature dim, XLA inserts the collectives).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from split_learning_tpu.models import get_plan
+from split_learning_tpu.parallel import make_mesh
+from split_learning_tpu.parallel.mesh import MODEL_AXIS, tp_param_sharding
+from split_learning_tpu.runtime.fused import FusedSplitTrainer
+from split_learning_tpu.utils import Config
+
+SEED = 5
+BATCH = 32
+
+
+def batches(n):
+    rs = np.random.RandomState(7)
+    return [(rs.randn(BATCH, 28, 28, 1).astype(np.float32),
+             rs.randint(0, 10, (BATCH,)).astype(np.int64))
+            for _ in range(n)]
+
+
+def test_tp_mesh_has_model_axis(devices):
+    mesh = make_mesh(num_clients=1, num_stages=1, model_parallel=2,
+                     devices=devices[:2])
+    assert MODEL_AXIS in mesh.axis_names
+    assert mesh.shape[MODEL_AXIS] == 2
+    # default 2-axis shape is unchanged for existing callers
+    assert MODEL_AXIS not in make_mesh(num_clients=2, num_stages=2,
+                                       devices=devices[:4]).axis_names
+
+
+def test_tp_matches_single_device(devices):
+    """2-way TP training == single-device training (the partitioned
+    matmuls + XLA collectives compute the same math)."""
+    plan = get_plan(mode="split")
+    data = batches(6)
+
+    mesh = make_mesh(num_clients=1, num_stages=1, model_parallel=2,
+                     devices=devices[:2])
+    cfg = Config(mode="split", batch_size=BATCH, model_parallel=2)
+    tp = FusedSplitTrainer(plan, cfg, jax.random.PRNGKey(SEED), data[0][0],
+                           mesh=mesh)
+    tp_losses = [tp.train_step(x, y) for x, y in data]
+
+    single = FusedSplitTrainer(plan, Config(mode="split", batch_size=BATCH),
+                               jax.random.PRNGKey(SEED), data[0][0])
+    ref_losses = [single.train_step(x, y) for x, y in data]
+    np.testing.assert_allclose(tp_losses, ref_losses, rtol=1e-4, atol=1e-5)
+
+
+def test_tp_actually_shards_weight_leaves(devices):
+    """The fc kernel (9216x10 won't split 10 2-ways -> replicated) vs the
+    conv kernels (last dim 32/64 divide 2 -> sharded): the per-leaf rule
+    must shard what it can and replicate the rest."""
+    plan = get_plan(mode="split")
+    mesh = make_mesh(num_clients=1, num_stages=1, model_parallel=2,
+                     devices=devices[:2])
+    x = jnp.zeros((8, 28, 28, 1), jnp.float32)
+    params = tuple(plan.init(jax.random.PRNGKey(0), x))
+    sh = tp_param_sharding(mesh, params)
+
+    flat_p, _ = jax.tree_util.tree_flatten(params)
+    flat_s, _ = jax.tree_util.tree_flatten(
+        sh, is_leaf=lambda n: hasattr(n, "spec"))
+    sharded = sum(
+        1 for p, s in zip(flat_p, flat_s)
+        if p.ndim >= 2 and p.shape[-1] % 2 == 0 and s.spec != ()
+    )
+    assert sharded >= 2, "expected the conv kernels to shard over 'model'"
+    for p, s in zip(flat_p, flat_s):
+        if s.spec and s.spec[-1] == MODEL_AXIS:
+            assert p.shape[-1] % 2 == 0
+
+
+def test_tp_composes_with_dp(devices):
+    """(2 data x 1 pipe x 2 model) — DP and TP on one mesh."""
+    plan = get_plan(mode="split")
+    data = batches(4)
+    mesh = make_mesh(num_clients=2, num_stages=1, model_parallel=2,
+                     devices=devices[:4])
+    cfg = Config(mode="split", batch_size=BATCH, num_clients=2,
+                 model_parallel=2)
+    tp = FusedSplitTrainer(plan, cfg, jax.random.PRNGKey(SEED), data[0][0],
+                           mesh=mesh)
+    losses = [tp.train_step(x, y) for x, y in data]
+    single = FusedSplitTrainer(plan, Config(mode="split", batch_size=BATCH),
+                               jax.random.PRNGKey(SEED), data[0][0])
+    ref = [single.train_step(x, y) for x, y in data]
+    np.testing.assert_allclose(losses, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_tp_rejected_across_hosts():
+    from split_learning_tpu.parallel.distributed import global_mesh
+
+    import dataclasses
+
+    @dataclasses.dataclass(frozen=True)
+    class FakeDev:
+        id: int
+        process_index: int
+
+    devs = [FakeDev(i, i // 2) for i in range(4)]
+    with pytest.raises(ValueError, match="ICI"):
+        global_mesh(num_clients=2, num_stages=1, model_parallel=2,
+                    devices=devs)
