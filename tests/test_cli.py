@@ -52,3 +52,25 @@ def test_train_cli_pipeline(tmp_path, capsys):
                "--data-dir", str(tmp_path), "--tracking", "noop"])
     assert rc == 0
     assert "[done]" in capsys.readouterr().out
+
+
+def _stdout_losses(capsys):
+    return {line.split("]")[0]: line.split(":")[1].strip()
+            for line in capsys.readouterr().out.splitlines()
+            if line.startswith("[step ") and " loss:" in line}
+
+
+def test_train_cli_scan_steps_matches_stepwise(tmp_path, capsys):
+    """--scan-steps chunks dispatch but must reproduce the stepwise loss
+    series (incl. the stepwise tail for the final partial chunk)."""
+    common = ["train", "--transport", "fused", "--dataset", "synthetic",
+              "--steps", "11", "--batch-size", "16", "--epochs", "1",
+              "--seed", "0", "--data-dir", str(tmp_path),
+              "--tracking", "stdout"]
+    assert main(common) == 0
+    stepwise = _stdout_losses(capsys)
+    assert main(common + ["--scan-steps", "4"]) == 0
+    scanned = _stdout_losses(capsys)
+    assert stepwise.keys() == scanned.keys() and len(stepwise) >= 2
+    for k in stepwise:
+        assert abs(float(stepwise[k]) - float(scanned[k])) < 2e-3, k
