@@ -24,6 +24,7 @@ every ``--checkpoint-every`` steps on the fused/pipeline paths);
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import sys
@@ -172,6 +173,11 @@ def cmd_train(args) -> int:
                 yield xy
         return gen()
 
+    from split_learning_tpu.utils.profiling import PhaseProfiler, device_trace
+    profile_dir = getattr(args, "profile_dir", None)
+    phase_prof = PhaseProfiler() if profile_dir else None
+    trace_ctx = device_trace(profile_dir)
+
     t0 = time.time()
     n_steps = 0
     final_loss = float("nan")
@@ -215,41 +221,44 @@ def cmd_train(args) -> int:
         can_scan = args.transport == "fused" and scan > 1
 
         step = start_step
-        for epoch in range(cfg.epochs):  # step cap enforced by data_iter
-            if can_scan:
-                # chunk T batches into one lax.scan dispatch; the returned
-                # loss series keeps per-step logging exact. The tail
-                # (< scan batches) runs stepwise so train_epoch only ever
-                # compiles for one T.
-                buf_x, buf_y = [], []
-                for x, y in data_iter():
-                    buf_x.append(x)
-                    buf_y.append(y)
-                    if len(buf_x) == scan:
-                        losses = np.asarray(trainer.train_epoch(
-                            np.stack(buf_x), np.stack(buf_y)))
-                        buf_x, buf_y = [], []
-                        for loss_i in losses:
-                            final_loss = float(loss_i)
-                            logger.log_metric("loss", final_loss, step=step)
-                            step += 1
-                        if (args.checkpoint_every
-                                and (step - start_step)
-                                // args.checkpoint_every
-                                != (step - start_step - len(losses))
-                                // args.checkpoint_every):
-                            save(step)
-                tail = zip(buf_x, buf_y)
-            else:
-                tail = data_iter()
-            for x, y in tail:
-                final_loss = trainer.train_step(x, y)
-                logger.log_metric("loss", final_loss, step=step)
-                step += 1
-                if (args.checkpoint_every
-                        and (step - start_step) % args.checkpoint_every == 0):
-                    save(step)
-            save(step)
+        with trace_ctx:
+            for epoch in range(cfg.epochs):  # step cap enforced by data_iter
+                if can_scan:
+                    # chunk T batches into one lax.scan dispatch; the
+                    # returned loss series keeps per-step logging exact.
+                    # The tail (< scan batches) runs stepwise so
+                    # train_epoch only ever compiles for one T.
+                    buf_x, buf_y = [], []
+                    for x, y in data_iter():
+                        buf_x.append(x)
+                        buf_y.append(y)
+                        if len(buf_x) == scan:
+                            losses = np.asarray(trainer.train_epoch(
+                                np.stack(buf_x), np.stack(buf_y)))
+                            buf_x, buf_y = [], []
+                            for loss_i in losses:
+                                final_loss = float(loss_i)
+                                logger.log_metric("loss", final_loss,
+                                                  step=step)
+                                step += 1
+                            if (args.checkpoint_every
+                                    and (step - start_step)
+                                    // args.checkpoint_every
+                                    != (step - start_step - len(losses))
+                                    // args.checkpoint_every):
+                                save(step)
+                    tail = zip(buf_x, buf_y)
+                else:
+                    tail = data_iter()
+                for x, y in tail:
+                    final_loss = trainer.train_step(x, y)
+                    logger.log_metric("loss", final_loss, step=step)
+                    step += 1
+                    if (args.checkpoint_every
+                            and (step - start_step) % args.checkpoint_every
+                            == 0):
+                        save(step)
+                save(step)
         n_steps = step - start_step
         full_params = trainer.state.params
     else:
@@ -265,7 +274,7 @@ def cmd_train(args) -> int:
             transport = LocalTransport(server)
         if cfg.mode == "split":
             client = SplitClientTrainer(plan, cfg, rng, transport,
-                                        logger=logger)
+                                        logger=logger, profiler=phase_prof)
             layout = "split_local" if server is not None else "client_only"
         elif cfg.mode == "u_split":
             client = USplitClientTrainer(plan, cfg, rng, transport,
@@ -327,9 +336,10 @@ def cmd_train(args) -> int:
             if ckptr is not None and ckptr.latest_step() != next_step:
                 ckptr.save(next_step, party_tree())
 
-        records = client.train(data_iter, epochs=cfg.epochs,
-                               start_step=start_step,
-                               on_epoch_end=on_epoch_end)
+        with trace_ctx:
+            records = client.train(data_iter, epochs=cfg.epochs,
+                                   start_step=start_step,
+                                   on_epoch_end=on_epoch_end)
         n_steps = len(records)
         final_loss = records[-1].loss if records else float("nan")
         print(f"[transport] {transport.stats.summary()}", file=sys.stderr)
@@ -347,6 +357,16 @@ def cmd_train(args) -> int:
                                client.state_c.params]
             else:
                 full_params = [client.state.params, server.state.params]
+
+    if phase_prof is not None and phase_prof.summary():
+        print(f"[profile] {json.dumps(phase_prof.summary())}", file=sys.stderr)
+        frac = phase_prof.fraction("transport")
+        if frac == frac:  # not NaN: MPMD split path with phase accounting
+            print(f"[profile] transport fraction: {frac:.3f}",
+                  file=sys.stderr)
+    if profile_dir:
+        print(f"[profile] XLA trace written to {profile_dir} "
+              "(view in TensorBoard/Perfetto)", file=sys.stderr)
 
     dt = time.time() - t0
     if n_steps and dt > 0:
@@ -467,6 +487,9 @@ def main(argv: Optional[list] = None) -> int:
     pt.add_argument("--server-url", dest="server_url", default=None)
     pt.add_argument("--steps", type=int, default=0,
                     help="stop after N steps (0 = full epochs)")
+    pt.add_argument("--profile-dir", dest="profile_dir", default=None,
+                    help="write a jax.profiler XLA trace here and report "
+                         "per-phase (compute vs transport) wall-clock")
     pt.add_argument("--scan-steps", dest="scan_steps", type=int, default=0,
                     help="fused transport: batch N steps per device "
                          "dispatch via lax.scan (per-step losses still "

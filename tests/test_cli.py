@@ -54,6 +54,22 @@ def test_train_cli_pipeline(tmp_path, capsys):
     assert "[done]" in capsys.readouterr().out
 
 
+def test_train_cli_profile_dir(tmp_path, capsys):
+    """--profile-dir writes an XLA trace and reports the compute-vs-
+    transport phase split (the north-star accounting, SURVEY.md §5)."""
+    import os
+    trace = tmp_path / "trace"
+    rc = main(["train", "--mode", "split", "--transport", "local",
+               "--dataset", "synthetic", "--steps", "3",
+               "--batch-size", "16", "--epochs", "1",
+               "--data-dir", str(tmp_path), "--tracking", "noop",
+               "--profile-dir", str(trace)])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "transport fraction" in err
+    assert os.path.isdir(trace) and os.listdir(trace)
+
+
 def _stdout_losses(capsys):
     return {line.split("]")[0]: line.split(":")[1].strip()
             for line in capsys.readouterr().out.splitlines()
