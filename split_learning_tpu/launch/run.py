@@ -268,6 +268,13 @@ def cmd_train(args) -> int:
             from split_learning_tpu.transport.http import HttpTransport
             transport = HttpTransport(cfg.server_url,
                                       compress=args.compress or "none")
+            # readiness barrier: the reference's client starts blind and
+            # silently drops every pre-server batch (SURVEY.md §3.4)
+            info = transport.wait_ready(timeout=args.wait_server)
+            if info.get("mode") not in (cfg.mode, None):
+                print(f"[transport] server is in mode {info.get('mode')!r} "
+                      f"but this client wants {cfg.mode!r}", file=sys.stderr)
+                return 4
         else:
             server = ServerRuntime(plan, cfg, jax.random.PRNGKey(cfg.seed),
                                    sample)
@@ -485,6 +492,10 @@ def main(argv: Optional[list] = None) -> int:
                     choices=["local", "http", "fused", "pipeline"],
                     default="fused")
     pt.add_argument("--server-url", dest="server_url", default=None)
+    pt.add_argument("--wait-server", dest="wait_server", type=float,
+                    default=60.0,
+                    help="seconds to wait for the server /health barrier "
+                         "(http transport)")
     pt.add_argument("--steps", type=int, default=0,
                     help="stop after N steps (0 = full epochs)")
     pt.add_argument("--profile-dir", dest="profile_dir", default=None,

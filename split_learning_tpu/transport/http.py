@@ -230,5 +230,21 @@ class HttpTransport(Transport):
                 f"GET /health -> {resp.status_code}: {resp.content[:200]!r}")
         return codec.decode(resp.content)
 
+    def wait_ready(self, timeout: float = 60.0,
+                   interval: float = 0.5) -> Dict[str, Any]:
+        """Block until the server answers /health — the explicit readiness
+        barrier the reference lacks (it silently drops every batch sent
+        before the server is up, ``src/client_part.py:127-129``;
+        SURVEY.md §3.4 "the client does not wait for the server")."""
+        import time as _time
+        deadline = _time.monotonic() + timeout
+        while True:
+            try:
+                return self.health()
+            except TransportError:
+                if _time.monotonic() >= deadline:
+                    raise
+                _time.sleep(interval)
+
     def close(self) -> None:
         self._session.close()

@@ -82,3 +82,48 @@ def test_http_error_status_mapping(http_pair):
     dead = HttpTransport("http://127.0.0.1:9")
     with pytest.raises(TransportError):
         dead.health()
+
+
+def test_wait_ready_barrier_blocks_until_server_up():
+    """The readiness barrier the reference lacks (SURVEY.md §3.4): a client
+    started before its server must wait at /health, not drop batches."""
+    import socket
+    import threading
+    import time
+
+    # reserve a port, start the server only after a delay
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+
+    cfg = Config(mode="split", batch_size=BATCH)
+    plan = get_plan(mode="split")
+    sample = np.zeros((BATCH, 28, 28, 1), np.float32)
+    runtime = ServerRuntime(plan, cfg, jax.random.PRNGKey(2), sample)
+    started = {}
+
+    def late_start():
+        time.sleep(0.8)
+        started["server"] = SplitHTTPServer(runtime, port=port).start()
+
+    t = threading.Thread(target=late_start)
+    t.start()
+    transport = HttpTransport(f"http://127.0.0.1:{port}")
+    try:
+        t0 = time.monotonic()
+        info = transport.wait_ready(timeout=10.0, interval=0.1)
+        waited = time.monotonic() - t0
+        assert info["status"] == "healthy" and info["mode"] == "split"
+        assert waited >= 0.5, "barrier returned before the server was up"
+    finally:
+        t.join()
+        transport.close()
+        started["server"].stop()
+
+
+def test_wait_ready_times_out_cleanly():
+    dead = HttpTransport("http://127.0.0.1:9")
+    with pytest.raises(TransportError):
+        dead.wait_ready(timeout=0.5, interval=0.1)
+    dead.close()
