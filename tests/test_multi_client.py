@@ -66,3 +66,43 @@ def test_bottom_sync_fedavg():
     a, b = (jax.tree_util.tree_leaves(c.state.params) for c in runner.clients)
     for la, lb in zip(a, b):
         np.testing.assert_allclose(np.asarray(la), np.asarray(lb))
+
+
+def test_concurrent_clients_are_race_free():
+    """N clients stepping from threads against one shared server half:
+    the runtime lock serializes state transitions (the reference's
+    module-global-model version of this is a data race by construction,
+    SURVEY.md §5 "Race detection"); per-client handshakes all advance."""
+    import threading
+
+    n_clients, n_steps = 4, 6
+    cfg = Config(mode="split", batch_size=BATCH, num_clients=n_clients)
+    plan = get_plan(mode="split")
+    sample = np.zeros((BATCH, 28, 28, 1), np.float32)
+    server = ServerRuntime(plan, cfg, jax.random.PRNGKey(0), sample)
+
+    from split_learning_tpu.runtime import SplitClientTrainer
+    clients = [
+        SplitClientTrainer(plan, cfg, jax.random.fold_in(
+            jax.random.PRNGKey(0), i), LocalTransport(server), client_id=i)
+        for i in range(n_clients)
+    ]
+    errors = []
+
+    def run(i):
+        try:
+            data = batches(1, seed=100 + i)[0]
+            for s in range(n_steps):
+                loss = clients[i].train_step(*data, step=s)
+                assert np.isfinite(loss)
+        except Exception as exc:  # propagate to the main thread
+            errors.append((i, exc))
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert server._last_step == {i: n_steps - 1 for i in range(n_clients)}
