@@ -24,7 +24,6 @@ every ``--checkpoint-every`` steps on the fused/pipeline paths);
 from __future__ import annotations
 
 import argparse
-import contextlib
 import json
 import os
 import sys
@@ -207,7 +206,10 @@ def cmd_train(args) -> int:
                 tree = ckptr.restore({"trainer": trainer.state})
                 state = tree["trainer"]
                 if mesh is not None:
-                    state = jax.device_put(state, replicated(mesh))
+                    # the trainer's own sharding tree, NOT plain replication:
+                    # under tensor parallelism the jitted step expects
+                    # 'model'-sharded weight leaves
+                    state = jax.device_put(state, trainer.state_sharding)
                 trainer.state = state
                 start_step = latest
                 print(f"[ckpt] resumed at step {start_step} from "

@@ -131,12 +131,16 @@ def global_mesh(num_clients: int = 1, num_stages: int = 1,
             "collectives and must stay on ICI; it is not supported across "
             "hosts — use data/pipe axes over DCN instead")
     rows = _grid_rows(devices, num_stages)
-    if len(rows) < num_clients:
+    if num_clients != len(rows):
+        # never silently drop a host's devices: a truncated mesh would leave
+        # non-coordinator hosts executing a program in which they own zero
+        # addressable shards. The data-parallel degree of a multi-host job
+        # is determined by the hardware; make the operator say it.
         raise ValueError(
-            f"mesh needs {num_clients} data rows of {num_stages} stages, "
-            f"but {len(devices)} devices across {n_procs} hosts yield only "
-            f"{len(rows)}")
-    grid = np.asarray(rows[:num_clients], dtype=object)
+            f"{len(devices)} devices across {n_procs} hosts at "
+            f"{num_stages} stages form {len(rows)} data rows; "
+            f"--num-clients must be {len(rows)} (got {num_clients})")
+    grid = np.asarray(rows, dtype=object)
     return Mesh(grid, (DATA_AXIS, PIPE_AXIS))
 
 

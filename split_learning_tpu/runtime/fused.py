@@ -65,11 +65,14 @@ class FusedSplitTrainer:
             # batch sharded over 'data'; params replicated — except under
             # tensor parallelism, where weight matrices shard their output
             # features over 'model' (optimizer traces mirror their params,
-            # so the same per-leaf rule shards them identically)
-            self._state_sh = tp_param_sharding(mesh, state)
-            state = jax.device_put(state, self._state_sh)
+            # so the same per-leaf rule shards them identically).
+            # state_sharding is public: restored checkpoints must be
+            # device_put with it before stepping (launch/run.py resume).
+            self.state_sharding = tp_param_sharding(mesh, state)
+            state = jax.device_put(state, self.state_sharding)
             self._x_sharding = batch_sharding(mesh)
         else:
+            self.state_sharding = None
             self._x_sharding = None
         self.state = state
 
@@ -133,7 +136,7 @@ class FusedSplitTrainer:
                 lambda s, xy: step_fn(s, xy[0], xy[1]), state, (xs, ys))
 
         if mesh is not None:
-            state_sh = self._state_sh
+            state_sh = self.state_sharding
             data_sh = batch_sharding(mesh)
             seq_sh = NamedSharding(mesh, P(None, DATA_AXIS))
             self._step = jax.jit(

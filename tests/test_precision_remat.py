@@ -109,14 +109,14 @@ def test_bf16_logits_are_bf16():
     assert logits.dtype == jnp.bfloat16
 
 
-def test_config_remat_env_and_cli_plumbing():
+def test_config_remat_env_and_cli_plumbing(tmp_path):
     cfg = Config.from_env(env={"SLT_REMAT": "true"})
     assert cfg.remat is True
     cfg = Config.from_env(env={"SLT_REMAT": "0"})
     assert cfg.remat is False
     from split_learning_tpu.launch.run import main
-    # --remat/--dtype parse and reach the Config (steps=1 keeps it quick)
+    # --remat/--dtype parse and reach the Config (steps=2 keeps it quick)
     rc = main(["train", "--transport", "fused", "--dataset", "synthetic",
                "--steps", "2", "--remat", "--dtype", "bfloat16",
-               "--tracking", "noop", "--data-dir", "/tmp/slt-test-remat"])
+               "--tracking", "noop", "--data-dir", str(tmp_path)])
     assert rc == 0
