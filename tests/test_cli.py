@@ -45,8 +45,11 @@ def test_train_cli_http_loopback(tmp_path, capsys):
         server.stop()
 
 
-def test_train_cli_pipeline(tmp_path, capsys):
-    rc = main(["train", "--mode", "split", "--transport", "pipeline",
+@pytest.mark.parametrize("mode", ["split", "u_split"])
+def test_train_cli_pipeline(tmp_path, capsys, mode):
+    """Pipeline transport over the ppermute mesh — including the U-shaped
+    3-stage plan (BASELINE config 5 as a 3-hop pipeline)."""
+    rc = main(["train", "--mode", mode, "--transport", "pipeline",
                "--dataset", "synthetic", "--steps", "2",
                "--batch-size", "16", "--microbatches", "2", "--epochs", "1",
                "--data-dir", str(tmp_path), "--tracking", "noop"])
