@@ -61,14 +61,20 @@ class SplitClientTrainer:
                  transport: Transport,
                  failure_policy: str = FailurePolicy.RAISE,
                  max_retries: int = 3,
+                 retry_backoff: float = 0.5,
                  logger: Optional[Any] = None,
                  profiler: Optional[Any] = None,
                  client_id: int = 0) -> None:
+        """retry_backoff: base seconds for exponential backoff between
+        retries (0.5 -> 0.5, 1, 2, 4...). Without it, a restarting server
+        (seconds of downtime) would exhaust every retry in microseconds —
+        elastic recovery needs the client to outwait the outage."""
         self.plan = plan
         self.cfg = cfg
         self.transport = transport
         self.failure_policy = failure_policy
         self.max_retries = max_retries
+        self.retry_backoff = retry_backoff
         self.logger = logger
         self.client_id = client_id
         self.profiler = profiler  # PhaseProfiler: compute-vs-transport split
@@ -124,6 +130,9 @@ class SplitClientTrainer:
                 attempt += 1
                 if (self.failure_policy == FailurePolicy.RETRY
                         and attempt <= self.max_retries):
+                    if self.retry_backoff > 0:
+                        import time
+                        time.sleep(self.retry_backoff * 2 ** (attempt - 1))
                     continue
                 if self.failure_policy == FailurePolicy.SKIP:
                     # reference behavior: drop the batch, keep going
