@@ -1,0 +1,26 @@
+"""bench.py contract: the driver parses exactly one JSON line
+{"metric", "value", "unit", "vs_baseline"} from stdout. A broken bench
+means an unscored round, so the contract gets its own test (hermetic: the
+subprocesses inherit this env's CPU-forced JAX)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_quick_prints_contract_json():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--quick"],
+        capture_output=True, text=True, timeout=900, cwd=REPO)
+    assert out.returncode == 0, out.stderr[-2000:]
+    json_lines = [l for l in out.stdout.splitlines()
+                  if l.strip().startswith("{")]
+    assert len(json_lines) == 1, out.stdout
+    rec = json.loads(json_lines[0])
+    assert rec["metric"] == "mnist_split_cnn_steps_per_sec"
+    assert rec["unit"] == "steps/sec"
+    assert rec["value"] and rec["value"] > 0
+    assert rec["vs_baseline"] and rec["vs_baseline"] > 1
