@@ -221,6 +221,11 @@ def cmd_train(args) -> int:
 
         scan = getattr(args, "scan_steps", 0) or 0
         can_scan = args.transport == "fused" and scan > 1
+        if can_scan and jax.devices()[0].platform == "cpu":
+            # XLA CPU runs the scan-rolled epoch far slower than eager
+            # per-step dispatch (~40x measured); the flag is a TPU idiom
+            print("[warn] --scan-steps on CPU is typically much slower "
+                  "than stepwise dispatch; intended for TPU", file=sys.stderr)
 
         step = start_step
         with trace_ctx:
