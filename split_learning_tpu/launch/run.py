@@ -387,7 +387,9 @@ def cmd_train(args) -> int:
         logger.log_metric("steps_per_sec", n_steps / dt, step=n_steps)
     if ckptr is not None:
         # finally use the artifact root the reference configures but never
-        # writes to (SURVEY.md §5 checkpoint gap); no-op off-mlflow
+        # writes to (SURVEY.md §5 checkpoint gap); no-op off-mlflow.
+        # saves are async now — drain them before shipping the directory
+        ckptr.wait_until_finished()
         logger.log_artifact(ckptr.directory)
 
     if args.eval:
@@ -430,6 +432,7 @@ def cmd_serve(args) -> int:
     # the server party owns its half's persistence (the client cannot
     # checkpoint it across HTTP): periodic saves + resume with the step
     # handshake re-armed, so a restarted pair picks up in sync
+    ckptr = None
     if cfg.checkpoint_dir:
         ckptr = Checkpointer(cfg.checkpoint_dir)
         _write_ckpt_meta(cfg.checkpoint_dir, "server_only", cfg)
@@ -456,6 +459,12 @@ def cmd_serve(args) -> int:
     except KeyboardInterrupt:
         print("[serve] shutting down")
         server.stop()
+    finally:
+        if ckptr is not None:
+            # saves are async — make the in-flight checkpoint durable
+            # before the process exits, or a resume comes back behind the
+            # clients' own checkpoints (step-handshake mismatch)
+            ckptr.close()
     return 0
 
 

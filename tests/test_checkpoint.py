@@ -91,3 +91,50 @@ def test_joint_mpmd_checkpoint_keeps_halves_in_sync(tmp_path):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-6, atol=1e-7)
     ckpt.close()
+
+
+def test_save_is_async_and_reads_barrier(tmp_path, monkeypatch):
+    """Round-1 VERDICT weak #6 regression: save() must enqueue without
+    waiting (the blocking predecessor stalled every client under the
+    server lock on checkpoint steps), while every read path and close()
+    must barrier on in-flight writes. Pinned at the manager seam so the
+    contract holds regardless of disk speed."""
+    import jax.numpy as jnp
+    from split_learning_tpu.runtime.checkpoint import Checkpointer
+
+    ckpt = Checkpointer(str(tmp_path / "async"))
+    calls = []
+    orig_wait = ckpt._mgr.wait_until_finished
+    orig_save = ckpt._mgr.save
+    monkeypatch.setattr(
+        ckpt._mgr, "wait_until_finished",
+        lambda: (calls.append("wait"), orig_wait())[1])
+
+    def save(*a, **kw):
+        calls.append("save_enter")
+        out = orig_save(*a, **kw)
+        calls.append("save_exit")
+        return out
+
+    monkeypatch.setattr(ckpt._mgr, "save", save)
+
+    ckpt.save(1, {"w": jnp.ones((8,))})
+    # orbax's save may internally barrier on the PREVIOUS write (that is
+    # pipelining, fine); the regression was OUR save barriering on its own
+    # write — i.e. a "wait" AFTER the enqueue returns
+    assert "save_exit" in calls
+    assert "wait" not in calls[calls.index("save_exit") + 1:], \
+        "save() must not block on its own write"
+
+    calls.clear()
+    assert ckpt.latest_step() == 1
+    assert "wait" in calls, "latest_step() must barrier first"
+
+    calls.clear()
+    restored = ckpt.restore_raw(step=1)
+    np.testing.assert_allclose(np.asarray(restored["w"]), np.ones(8))
+    assert "wait" in calls, "restore must barrier first"
+
+    calls.clear()
+    ckpt.close()
+    assert "wait" in calls, "close() must drain outstanding writes"
