@@ -182,6 +182,18 @@ def cmd_train(args) -> int:
     final_loss = float("nan")
     full_params = None  # for --eval
 
+    if args.transport != "fused":
+        # these knobs only exist on the fused single-program path; say so
+        # instead of silently ignoring them (round-1 ADVICE)
+        if cfg.model_parallel > 1:
+            print(f"[warn] --model-parallel ignored on transport="
+                  f"{args.transport!r} (tensor parallelism requires the "
+                  f"fused transport)", file=sys.stderr)
+        if (getattr(args, "scan_steps", 0) or 0) > 1:
+            print(f"[warn] --scan-steps ignored on transport="
+                  f"{args.transport!r} (only the fused transport scans "
+                  f"steps)", file=sys.stderr)
+
     if args.transport in ("fused", "pipeline"):
         from split_learning_tpu.parallel import global_mesh
         from split_learning_tpu.parallel.mesh import replicated
@@ -216,11 +228,21 @@ def cmd_train(args) -> int:
                       f"{cfg.checkpoint_dir}", file=sys.stderr)
 
         def save(step: int) -> None:
-            if ckptr is not None and ckptr.latest_step() != step:
-                ckptr.save(step, {"trainer": trainer.state})
+            if ckptr is not None:
+                ckptr.save_once(step, {"trainer": trainer.state})
 
         scan = getattr(args, "scan_steps", 0) or 0
         can_scan = args.transport == "fused" and scan > 1
+        if can_scan and ckptr is not None and args.checkpoint_every:
+            # a scan chunk is one opaque device dispatch — saves can only
+            # happen at chunk boundaries. Cap the chunk so every
+            # --checkpoint-every boundary still produces a save instead of
+            # silently coarsening the cadence.
+            if scan > args.checkpoint_every:
+                print(f"[warn] --scan-steps {scan} capped to "
+                      f"--checkpoint-every {args.checkpoint_every} so "
+                      f"checkpoint cadence is preserved", file=sys.stderr)
+                scan = args.checkpoint_every
         if can_scan and jax.devices()[0].platform == "cpu":
             # XLA CPU runs the scan-rolled epoch far slower than eager
             # per-step dispatch (~40x measured); the flag is a TPU idiom
@@ -347,8 +369,8 @@ def cmd_train(args) -> int:
                         return 3
 
         def on_epoch_end(epoch: int, next_step: int) -> None:
-            if ckptr is not None and ckptr.latest_step() != next_step:
-                ckptr.save(next_step, party_tree())
+            if ckptr is not None:
+                ckptr.save_once(next_step, party_tree())
 
         with trace_ctx:
             records = client.train(data_iter, epochs=cfg.epochs,
@@ -446,8 +468,11 @@ def cmd_serve(args) -> int:
         every = max(args.checkpoint_every, 1)
 
         def on_step(step: int) -> None:
-            if (step + 1) % every == 0 and ckptr.latest_step() != step + 1:
-                ckptr.save(step + 1, {"server": runtime.state})
+            # save_once: no barriering latest_step() here — this hook runs
+            # under the runtime lock, so a barrier would stall every client
+            # on the previous in-flight write
+            if (step + 1) % every == 0:
+                ckptr.save_once(step + 1, {"server": runtime.state})
 
         runtime.on_step = on_step
 

