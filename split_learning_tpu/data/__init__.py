@@ -6,11 +6,14 @@ from split_learning_tpu.data.datasets import (
     Split,
     batches,
     epoch_steps,
+    download_dataset,
     load_dataset,
+    store_from_config,
     synthetic,
 )
 
 __all__ = [
     "Dataset", "Split", "DatasetStore", "LocalStore", "S3Store",
-    "load_dataset", "synthetic", "batches", "epoch_steps",
+    "load_dataset", "download_dataset", "store_from_config",
+    "synthetic", "batches", "epoch_steps",
 ]

@@ -21,10 +21,12 @@ from __future__ import annotations
 
 import dataclasses
 import gzip
+import hashlib
 import os
 import struct
+import sys
 import tarfile
-from typing import Dict, Iterator, Optional, Tuple
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -258,6 +260,87 @@ def load_cifar10_binary(data_dir: str) -> Optional[Dataset]:
 
 
 # --------------------------------------------------------------------- #
+# opt-in raw-file downloader (the reference's cache-miss path,
+# src/client_part.py:56-78, downloads MNIST via torchvision; here: stdlib
+# urllib against the canonical distributions, sha256-verified, and OFF by
+# default so the hermetic/zero-egress default behavior is unchanged)
+
+# (filename in data_dir, canonical URL, expected sha256). The hashes are
+# the published checksums of the canonical distributions; pass ``urls``
+# to download_dataset to override both URL and hash (e.g. an internal
+# mirror), or sha256=None to skip verification explicitly.
+_MNIST_BASE = "https://ossci-datasets.s3.amazonaws.com/mnist/"
+_DOWNLOADS: Dict[str, List[Tuple[str, str, Optional[str]]]] = {
+    "mnist": [
+        ("train-images-idx3-ubyte.gz", _MNIST_BASE + "train-images-idx3-ubyte.gz",
+         "440fcabf73cc546fa21475e81ea370265605f56be210a4024d2ca8f203523609"),
+        ("train-labels-idx1-ubyte.gz", _MNIST_BASE + "train-labels-idx1-ubyte.gz",
+         "3552534a0a558bbed6aed32b30c495cca23d567ec52cac8be1a0730e8010255c"),
+        ("t10k-images-idx3-ubyte.gz", _MNIST_BASE + "t10k-images-idx3-ubyte.gz",
+         "8d422c7b0a1c1c79245a5bcf07fe86e33eeafee792b84584aec276f5a2dbc4e6"),
+        ("t10k-labels-idx1-ubyte.gz", _MNIST_BASE + "t10k-labels-idx1-ubyte.gz",
+         "f7ae60f92e00ec6debd23a6088c31dbd2371eca3ffa0defaefb259924204aec6"),
+    ],
+    "cifar10": [
+        ("cifar-10-binary.tar.gz",
+         "https://www.cs.toronto.edu/~kriz/cifar-10-binary.tar.gz",
+         # no pinned hash yet: this build environment has no egress to
+         # verify one, and a wrong pin would hard-fail every valid
+         # download. The downloader prints the computed sha256 so the
+         # first verified fetch can pin it here.
+         None),
+    ],
+}
+
+
+class ChecksumError(ValueError):
+    """Downloaded bytes do not match the pinned sha256."""
+
+
+def download_dataset(name: str, data_dir: str,
+                     urls: Optional[Sequence[Tuple[str, str, Optional[str]]]]
+                     = None, timeout: float = 120.0) -> List[str]:
+    """Fetch ``name``'s raw files into ``data_dir``, sha256-verified.
+
+    Files already present are left untouched (the cache-hit path). Writes
+    are atomic (tmp + rename) so a killed download never leaves a torn
+    file for load_mnist_idx/load_cifar10_binary to trip on. Returns the
+    list of paths downloaded this call."""
+    import urllib.request
+
+    specs = list(urls) if urls is not None else _DOWNLOADS.get(name)
+    if specs is None:
+        raise ValueError(
+            f"no download recipe for dataset {name!r} "
+            f"(have {sorted(_DOWNLOADS)})")
+    root = os.path.expanduser(data_dir)
+    os.makedirs(root, exist_ok=True)
+    fetched: List[str] = []
+    for fname, url, want in specs:
+        dest = os.path.join(root, fname)
+        if os.path.exists(dest):
+            continue
+        print(f"[data] downloading {url}", file=sys.stderr)
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            data = resp.read()
+        got = hashlib.sha256(data).hexdigest()
+        if want is None:
+            print(f"[data] {fname}: sha256 {got} (unpinned — verify and "
+                  f"pin in _DOWNLOADS)", file=sys.stderr)
+        elif got != want:
+            raise ChecksumError(
+                f"{fname}: sha256 mismatch\n  expected {want}\n  got      "
+                f"{got}\n(refusing to write; pass urls=[(file, url, None)] "
+                f"to skip verification for a trusted mirror)")
+        tmp = dest + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, dest)
+        fetched.append(dest)
+    return fetched
+
+
+# --------------------------------------------------------------------- #
 # synthetic fallback (zero-egress environments)
 
 _SHAPES = {"mnist": (28, 28, 1), "cifar10": (32, 32, 3)}
@@ -279,15 +362,30 @@ def synthetic(name: str, n_train: int = 4096, n_test: int = 512,
                    name=name, num_classes=num_classes, synthetic=True)
 
 
+def store_from_config(cfg) -> Optional[DatasetStore]:
+    """The deployment seam: an S3Store when the reference's S3 env surface
+    (S3_ENDPOINT_URL / AWS_* -> Config.s3_*) is configured — in-cluster
+    that's the MinIO from deploy/mlflow-stack.yaml — else None, letting
+    load_dataset fall back to the LocalStore default."""
+    if getattr(cfg, "s3_endpoint", None):
+        return S3Store(cfg.s3_endpoint, cfg.s3_access_key or "",
+                       cfg.s3_secret_key or "", cfg.s3_bucket)
+    return None
+
+
 # --------------------------------------------------------------------- #
 # the C6-shaped load path: cache probe -> hit/miss -> raw load or synthetic
 
 def load_dataset(name: str, data_dir: str,
                  store: Optional[DatasetStore] = None,
-                 allow_synthetic: bool = True) -> Dataset:
+                 allow_synthetic: bool = True,
+                 download: bool = False) -> Dataset:
     """Cache-first dataset load, mirroring src/client_part.py:36-98:
     probe the store; on hit, fetch the prepared blob; on miss, build from
-    raw files (or synthesize) and upload the blob for next time.
+    raw files (or synthesize) and upload the blob for next time. With
+    ``download=True`` a raw-file miss first tries the checksummed
+    downloader (≡ the reference's torchvision download at
+    src/client_part.py:56-78); the default stays hermetic.
 
     Real and synthetic data use distinct cache keys, so a synthetic blob
     cached in a data-less environment never shadows real files that appear
@@ -301,14 +399,19 @@ def load_dataset(name: str, data_dir: str,
     if store.exists(real_key):
         return _from_blob(name, store.fetch(real_key))
 
-    if name == "mnist":
-        ds = load_mnist_idx(data_dir)
-    elif name == "cifar10":
-        ds = load_cifar10_binary(data_dir)
-    elif name == "synthetic":
-        ds = None
-    else:
+    def load_raw():
+        if name == "mnist":
+            return load_mnist_idx(data_dir)
+        if name == "cifar10":
+            return load_cifar10_binary(data_dir)
+        return None
+
+    if name not in ("mnist", "cifar10", "synthetic"):
         raise ValueError(f"Unknown dataset: {name!r}")
+    ds = load_raw()
+    if ds is None and download and name in _DOWNLOADS:
+        download_dataset(name, data_dir)
+        ds = load_raw()
     if ds is not None:
         store.put(real_key, _to_blob(ds))
         return ds

@@ -125,7 +125,8 @@ def cmd_train(args) -> int:
 
     import jax
 
-    from split_learning_tpu.data import batches, load_dataset
+    from split_learning_tpu.data import (
+        batches, load_dataset, store_from_config)
     from split_learning_tpu.models import get_plan
     from split_learning_tpu.tracking import make_logger
     from split_learning_tpu.runtime import (
@@ -138,7 +139,9 @@ def cmd_train(args) -> int:
     cfg = _config_from_args(args)
     plan = get_plan(model=cfg.model, mode=cfg.mode, dtype=cfg.dtype)
     ds = load_dataset(cfg.dataset, cfg.data_dir,
-                      allow_synthetic=not args.require_real)
+                      store=store_from_config(cfg),
+                      allow_synthetic=not args.require_real,
+                      download=getattr(args, "download", False))
     if ds.synthetic:
         print(f"[data] using synthetic {ds.name} "
               f"({len(ds.train)} train examples)", file=sys.stderr)
@@ -243,6 +246,9 @@ def cmd_train(args) -> int:
                       f"--checkpoint-every {args.checkpoint_every} so "
                       f"checkpoint cadence is preserved", file=sys.stderr)
                 scan = args.checkpoint_every
+                # a cap to 1 means every step checkpoints — scanning buys
+                # nothing; fall back to the stepwise path
+                can_scan = scan > 1
         if can_scan and jax.devices()[0].platform == "cpu":
             # XLA CPU runs the scan-rolled epoch far slower than eager
             # per-step dispatch (~40x measured); the flag is a TPU idiom
@@ -514,7 +520,8 @@ def cmd_eval(args) -> int:
     step = args.step if args.step is not None else ckptr.latest_step()
     raw = ckptr.restore_raw(step)
     params = _assemble_full_params(meta["layout"], raw)
-    ds = load_dataset(dataset, cfg.data_dir)
+    from split_learning_tpu.data import store_from_config as _sfc
+    ds = load_dataset(dataset, cfg.data_dir, store=_sfc(cfg))
     res = evaluate(plan, params, ds.test, batch_size=cfg.batch_size)
     print(json.dumps({"checkpoint_step": step, "dataset": dataset,
                       "accuracy": round(res["accuracy"], 4),
@@ -563,6 +570,10 @@ def main(argv: Optional[list] = None) -> int:
     pt.add_argument("--require-real", action="store_true",
                     help="fail if real dataset files are absent instead of "
                          "falling back to synthetic data")
+    pt.add_argument("--download", action="store_true",
+                    help="on a raw-file miss, download the canonical "
+                         "distribution into --data-dir (sha256-verified; "
+                         "default stays hermetic/offline)")
     pt.add_argument("--compress", choices=["none", "int8"], default=None,
                     help="wire compression of the cut-layer tensors "
                          "(http transport only)")

@@ -3,6 +3,7 @@
 import gzip
 import json
 import os
+import re
 import struct
 
 import numpy as np
@@ -172,3 +173,110 @@ def test_log_artifact_is_noop_without_artifact_store(tmp_path):
                JsonlLogger(str(tmp_path / "m.jsonl"))):
         lg.log_artifact(str(p))  # must not raise
         lg.close()
+
+
+# --------------------------------------------------------------------- #
+# opt-in downloader (round-1 VERDICT missing #1) — against a local HTTP
+# fixture, so the test stays hermetic while exercising the real
+# urllib + sha256 + atomic-write path end to end.
+
+import hashlib
+import http.server
+import threading
+
+from split_learning_tpu.data.datasets import (
+    ChecksumError, download_dataset)
+
+
+@pytest.fixture()
+def idx_http_server(tmp_path):
+    """Serve generated MNIST IDX .gz files over local HTTP; yields
+    (base_url, {filename: sha256})."""
+    src = tmp_path / "srv"
+    _write_idx_mnist(str(src))
+    # the downloader fetches the canonical .gz names; gzip the two plain
+    # files the fixture writes uncompressed
+    for plain in ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"):
+        data = (src / plain).read_bytes()
+        (src / (plain + ".gz")).write_bytes(gzip.compress(data))
+    sums = {}
+    for p in src.iterdir():
+        if p.name.endswith(".gz"):
+            sums[p.name] = hashlib.sha256(p.read_bytes()).hexdigest()
+
+    handler = lambda *a, **kw: http.server.SimpleHTTPRequestHandler(
+        *a, directory=str(src), **kw)
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield f"http://127.0.0.1:{httpd.server_address[1]}/", sums
+    finally:
+        httpd.shutdown()
+
+
+def _specs(base, sums):
+    return [(name, base + name, sums[name]) for name in sorted(sums)]
+
+
+def test_download_verifies_and_loads(tmp_path, idx_http_server):
+    base, sums = idx_http_server
+    dest = str(tmp_path / "data")
+    fetched = download_dataset("mnist", dest, urls=_specs(base, sums))
+    assert len(fetched) == 4
+    ds = load_dataset("mnist", dest, allow_synthetic=False)
+    assert not ds.synthetic and ds.train.x.shape[1:] == (28, 28, 1)
+    # second call: cache hit, nothing re-downloaded
+    assert download_dataset("mnist", dest, urls=_specs(base, sums)) == []
+
+
+def test_download_rejects_checksum_mismatch(tmp_path, idx_http_server):
+    base, sums = idx_http_server
+    dest = str(tmp_path / "data")
+    bad = [(n, u, "0" * 64) for n, u, _ in _specs(base, sums)]
+    with pytest.raises(ChecksumError, match="sha256 mismatch"):
+        download_dataset("mnist", dest, urls=bad)
+    assert not os.path.exists(os.path.join(
+        dest, "train-images-idx3-ubyte.gz")), "torn/bad file left behind"
+
+
+def test_load_dataset_download_flag(tmp_path, idx_http_server, monkeypatch):
+    """--require-real --download works with no pre-placed files: the
+    VERDICT's done-criterion, against the local fixture."""
+    import split_learning_tpu.data.datasets as dsm
+    base, sums = idx_http_server
+    monkeypatch.setitem(dsm._DOWNLOADS, "mnist", _specs(base, sums))
+    dest = str(tmp_path / "fresh")
+    ds = load_dataset("mnist", dest, allow_synthetic=False, download=True)
+    assert not ds.synthetic and len(ds.train) == 64
+
+
+def test_load_dataset_hermetic_default_unchanged(tmp_path):
+    """Without download=True a raw miss still refuses (--require-real) —
+    the downloader must never fire implicitly."""
+    with pytest.raises(FileNotFoundError):
+        load_dataset("mnist", str(tmp_path / "empty"),
+                     allow_synthetic=False)
+
+
+def test_download_pins_are_well_formed():
+    """A malformed pinned hash (wrong length/charset) would hard-fail
+    every valid download; catch typos structurally. None = explicitly
+    unpinned (the downloader logs the computed hash instead)."""
+    from split_learning_tpu.data.datasets import _DOWNLOADS
+    for name, specs in _DOWNLOADS.items():
+        for fname, url, sha in specs:
+            assert url.startswith("https://"), (name, fname)
+            if sha is not None:
+                assert re.fullmatch(r"[0-9a-f]{64}", sha), (
+                    f"{name}/{fname}: malformed sha256 pin {sha!r}")
+
+
+def test_download_unpinned_accepts_and_logs(tmp_path, idx_http_server,
+                                            capsys):
+    base, sums = idx_http_server
+    specs = [(n, u, None) for n, u, _ in _specs(base, sums)]
+    fetched = download_dataset("mnist", str(tmp_path / "d"), urls=specs)
+    assert len(fetched) == 4
+    err = capsys.readouterr().err
+    assert "unpinned" in err and list(sums.values())[0] in err

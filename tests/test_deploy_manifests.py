@@ -1,0 +1,187 @@
+"""Structural validation of deploy/ manifests (round-1 VERDICT next #7).
+
+No cluster and no kubeconform in the hermetic environment, so this is a
+schema-shaped lint over the parsed YAML: the invariants that have actually
+bitten (cross-namespace secret refs, selector/label drift, dead probes,
+floating image tags, DNS names pointing at services that don't exist) are
+asserted directly. Reference analog: the manifests these mirror are
+`/root/reference/k8s/mlflow-stack.yaml` and `k8s/split-learning.yaml`.
+"""
+
+import os
+import re
+
+import pytest
+import yaml
+
+DEPLOY = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "deploy")
+MANIFESTS = ["mlflow-stack.yaml", "split-learning.yaml"]
+
+
+def _docs():
+    out = []
+    for name in MANIFESTS:
+        with open(os.path.join(DEPLOY, name)) as f:
+            for doc in yaml.safe_load_all(f):
+                if doc:
+                    out.append((name, doc))
+    return out
+
+
+DOCS = _docs()
+
+
+def _by_kind(kind):
+    return [(n, d) for n, d in DOCS if d.get("kind") == kind]
+
+
+def _pod_spec(doc):
+    return doc["spec"]["template"]["spec"]
+
+
+def _containers(doc):
+    spec = _pod_spec(doc)
+    return spec.get("initContainers", []) + spec["containers"]
+
+
+def test_every_doc_has_identity():
+    assert len(DOCS) >= 10
+    for name, doc in DOCS:
+        assert doc.get("apiVersion"), (name, doc)
+        assert doc.get("kind"), (name, doc)
+        assert doc.get("metadata", {}).get("name"), (name, doc)
+
+
+def test_workloads_pin_image_tags():
+    for name, doc in _by_kind("Deployment") + _by_kind("StatefulSet") + \
+            _by_kind("Job"):
+        for c in _containers(doc):
+            image = c["image"]
+            if c.get("imagePullPolicy") == "Never" or \
+                    _pod_spec(doc).get("imagePullPolicy") == "Never":
+                continue  # locally-built image, tag is meaningless
+            if image.startswith("split-learning-tpu:"):
+                continue  # the repo's own image, built+imported locally
+            assert ":" in image and not image.endswith(":latest"), (
+                f"{name}: {doc['metadata']['name']} container {c['name']} "
+                f"uses a floating tag: {image}")
+
+
+def test_deployments_and_statefulsets_have_readiness_probes():
+    for name, doc in _by_kind("Deployment") + _by_kind("StatefulSet"):
+        assert any("readinessProbe" in c for c in _containers(doc)), (
+            f"{name}: {doc['metadata']['name']} has no readiness probe "
+            f"(the reference's in-cluster /health was dead code — "
+            f"SURVEY.md §4)")
+
+
+def test_service_selectors_match_pod_labels():
+    workloads = _by_kind("Deployment") + _by_kind("StatefulSet")
+    for name, svc in _by_kind("Service"):
+        sel = svc["spec"].get("selector")
+        if not sel:
+            continue
+        ns = svc["metadata"].get("namespace")
+        matched = False
+        for _, w in workloads:
+            if w["metadata"].get("namespace") != ns:
+                continue
+            labels = w["spec"]["template"]["metadata"].get("labels", {})
+            if all(labels.get(k) == v for k, v in sel.items()):
+                matched = True
+        assert matched, (
+            f"{name}: Service {svc['metadata']['name']} selector {sel} "
+            f"matches no workload pod labels in namespace {ns}")
+
+
+def _secrets_by_ns():
+    out = {}
+    for _, doc in _by_kind("Secret"):
+        ns = doc["metadata"].get("namespace")
+        keys = set(doc.get("stringData", {})) | set(doc.get("data", {}))
+        out.setdefault(ns, {})[doc["metadata"]["name"]] = keys
+    return out
+
+
+def test_secret_refs_resolve_within_their_namespace():
+    """secretKeyRef is namespace-local — the class of bug where a pod
+    references a Secret that only exists in another namespace."""
+    secrets = _secrets_by_ns()
+    for name, doc in _by_kind("Deployment") + _by_kind("StatefulSet") + \
+            _by_kind("Job"):
+        ns = doc["metadata"].get("namespace")
+        for c in _containers(doc):
+            for env in c.get("env", []):
+                ref = env.get("valueFrom", {}).get("secretKeyRef")
+                if not ref:
+                    continue
+                if ref.get("optional"):
+                    continue
+                have = secrets.get(ns, {})
+                assert ref["name"] in have, (
+                    f"{name}: {doc['metadata']['name']} env {env['name']} "
+                    f"references Secret {ref['name']} which does not exist "
+                    f"in namespace {ns}")
+                assert ref["key"] in have[ref["name"]], (
+                    f"{name}: Secret {ref['name']} has no key {ref['key']}")
+
+
+def test_cluster_dns_names_point_at_defined_services():
+    """Every *.svc.cluster.local URL in env values must resolve to a
+    Service defined in these manifests (name + namespace + port)."""
+    services = {}
+    for _, svc in _by_kind("Service"):
+        key = (svc["metadata"]["name"], svc["metadata"].get("namespace"))
+        services[key] = {p["port"] for p in svc["spec"]["ports"]}
+    pat = re.compile(
+        r"https?://([a-z0-9-]+)\.([a-z0-9-]+)\.svc\.cluster\.local:(\d+)")
+    found = 0
+    for name, doc in DOCS:
+        for m in pat.finditer(yaml.safe_dump(doc)):
+            svc_name, ns, port = m.group(1), m.group(2), int(m.group(3))
+            found += 1
+            assert (svc_name, ns) in services, (
+                f"{name}: URL references undefined Service "
+                f"{svc_name}.{ns}: {m.group(0)}")
+            assert port in services[(svc_name, ns)], (
+                f"{name}: Service {svc_name}.{ns} does not expose "
+                f"port {port}")
+    assert found >= 2  # minio endpoint(s) + mlflow tracking URI
+
+
+def test_s3_stack_is_deployable():
+    """The round-1 gap: S3Store and the MLflow artifact root had no
+    in-cluster backing. Pin the pieces: a MinIO StatefulSet, a bucket-init
+    Job creating mlops-bucket, and MLflow pointed at s3://mlops-bucket."""
+    kinds = {(d["kind"], d["metadata"]["name"]) for _, d in DOCS}
+    assert ("StatefulSet", "minio") in kinds
+    assert ("Job", "bucket-init") in kinds
+    [(_, mlflow)] = [(n, d) for n, d in _by_kind("Deployment")
+                     if d["metadata"]["name"] == "mlflow"]
+    blob = yaml.safe_dump(mlflow)
+    assert "s3://mlops-bucket" in blob  # ≡ reference artifact root
+    assert "MLFLOW_S3_ENDPOINT_URL" in blob
+    [(_, job)] = [(n, d) for n, d in _by_kind("Job")
+                  if d["metadata"]["name"] == "bucket-init"]
+    assert "mlops-bucket" in yaml.safe_dump(job)
+
+
+def test_store_from_config_uses_the_same_env_surface():
+    """The client pod env (S3_ENDPOINT_URL/AWS_*) must map onto
+    Config.s3_* and activate S3Store; without the endpoint the loader
+    stays local. boto3 is absent in the test image, so activation is
+    observed as S3Store's ImportError rather than a live client."""
+    from split_learning_tpu.data import store_from_config
+    from split_learning_tpu.utils import Config
+
+    assert store_from_config(Config()) is None
+    cfg = Config(s3_endpoint="http://minio.mlflow.svc.cluster.local:9000",
+                 s3_access_key="a", s3_secret_key="b")
+    try:
+        store = store_from_config(cfg)
+    except ImportError as e:
+        assert "boto3" in str(e)
+    else:  # boto3 present: it must be a real S3Store on that endpoint
+        from split_learning_tpu.data import S3Store
+        assert isinstance(store, S3Store)
