@@ -109,11 +109,10 @@ def _walk(jaxpr) -> float:
             length = eqn.params.get("length", 1)
             total += length * sum(_walk(j) for j in _sub_jaxprs(eqn.params))
         elif name in ("cond", "switch"):
-            # data-dependent: count the most expensive branch (upper bound
-            # of what actually runs; under SPMD lax.switch all branches
-            # are *evaluated* on every rank — see parallel/pipeline.py —
-            # so callers measuring the pipeline should multiply by S
-            # themselves if they want executed-FLOPs, not model-FLOPs)
+            # data-dependent: count the most expensive branch — an upper
+            # bound on what actually runs (XLA compiles collective-free
+            # branches to a real HLO conditional, one branch per device;
+            # see parallel/pipeline.py)
             branches = [_walk(j) for j in _sub_jaxprs(eqn.params)]
             total += max(branches, default=0.0)
         else:

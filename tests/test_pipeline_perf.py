@@ -1,0 +1,74 @@
+"""Pipeline compile-shape guarantees (round-1 VERDICT weak #4).
+
+Round 1 documented a feared S-times compute blowup: "under SPMD every
+rank evaluates all S stage branches (lax.switch)". That claim is about
+the COMPILED program, so it is pinned here from the compiled program:
+the per-rank stage dispatch must lower to a real HLO ``conditional``
+(one branch executes per device), not a flattened select (all branches
+execute everywhere). If a future change moves a collective inside the
+branches, XLA flattens the conditional and this test fails — which is
+exactly the regression it guards.
+
+Wall-clock comparisons live in BASELINE.md (benchmarks/, run manually):
+timing on the 8-virtual-device CPU mesh measures scheduling overhead
+only, since the "devices" share one host's cores.
+"""
+
+import re
+
+import jax
+import numpy as np
+import pytest
+
+from split_learning_tpu.models import get_plan
+from split_learning_tpu.parallel import make_mesh
+from split_learning_tpu.parallel.pipeline import PipelinedTrainer
+from split_learning_tpu.utils import Config
+
+
+def _compiled_hlo(model, mode, n_pipe, batch, shape, microbatches):
+    plan = get_plan(model=model, mode=mode)
+    mesh = make_mesh(num_clients=1, num_stages=n_pipe,
+                     devices=jax.devices()[:n_pipe])
+    cfg = Config(mode=mode, batch_size=batch, microbatches=microbatches,
+                 num_stages=n_pipe)
+    x = np.zeros((batch,) + shape, np.float32)
+    y = np.zeros((batch,), np.int64)
+    tr = PipelinedTrainer(plan, cfg, jax.random.PRNGKey(0), x, mesh)
+    import jax.numpy as jnp
+    lowered = tr._step.lower(
+        tr.state,
+        jax.device_put(jnp.asarray(x), tr._x_sharding),
+        jax.device_put(jnp.asarray(y), tr._y_sharding))
+    return lowered.compile().as_text()
+
+
+@pytest.mark.parametrize("model,n_pipe,shape,mode", [
+    ("split_cnn", 2, (28, 28, 1), "split"),
+    ("split_cnn", 3, (28, 28, 1), "u_split"),
+])
+def test_stage_dispatch_compiles_to_hlo_conditional(model, n_pipe, shape,
+                                                    mode):
+    hlo = _compiled_hlo(model, mode, n_pipe, batch=8, shape=shape,
+                        microbatches=2)
+    n_conditional = len(re.findall(r"\bconditional\b", hlo))
+    assert n_conditional >= 1, (
+        "stage switch was flattened out of the compiled module — every "
+        "rank would execute every stage's compute (the S-times blowup "
+        "round 1 warned about)")
+
+
+def test_stage_compute_lives_inside_branches_not_toplevel():
+    """The conv kernels must appear inside the conditional's branch
+    computations; an unconditional top-level copy would mean some stage's
+    compute runs on every rank regardless of the conditional."""
+    hlo = _compiled_hlo("split_cnn", "split", 2, batch=8,
+                        shape=(28, 28, 1), microbatches=2)
+    # split the module into named computations; find which contain convs
+    comps = re.split(r"\n(?=%?\w[\w.-]* \(|ENTRY )", hlo)
+    conv_comps = [c for c in comps if "convolution" in c]
+    assert conv_comps, "no convolutions in the compiled module?"
+    entry = [c for c in comps if c.startswith("ENTRY")]
+    assert entry and "convolution" not in entry[0], (
+        "stage convolution found in the ENTRY computation — it executes "
+        "unconditionally on every rank")
