@@ -24,3 +24,44 @@ def test_bench_quick_prints_contract_json():
     assert rec["unit"] == "steps/sec"
     assert rec["value"] and rec["value"] > 0
     assert rec["vs_baseline"] and rec["vs_baseline"] > 1
+    # the fused leg in the detail line must have passed the publication
+    # gate: physically possible throughput + work-scaling timed window
+    detail_lines = [l for l in out.stderr.splitlines()
+                    if l.startswith("[bench] detail:")]
+    assert detail_lines, out.stderr[-2000:]
+    fused = json.loads(detail_lines[0].split("detail:", 1)[1])["fused"]
+    assert fused["valid"] is True
+    util = fused.get("util_vs_bf16_peak")
+    assert util is None or util <= 1.0
+    assert 1.5 <= fused["linearity_2x"] <= 2.6
+
+
+def test_validate_leg_gates_impossible_throughput():
+    """The round-1/2 failure mode — a steps/sec figure above chip peak —
+    must be refused, whether the peak is known (util>1) or not (absolute
+    TFLOP/s bound); a dispatch-only timer must be caught by linearity."""
+    sys.path.insert(0, REPO)
+    from bench import validate_leg
+
+    ok, reason = validate_leg({"util_vs_bf16_peak": 0.10,
+                               "model_tflops_per_sec": 20.0,
+                               "linearity_2x": 1.9})
+    assert ok and reason is None
+
+    # round-2's actual artifact: 60.5x peak
+    ok, reason = validate_leg({"util_vs_bf16_peak": 60.53,
+                               "model_tflops_per_sec": 11925.0,
+                               "linearity_2x": 1.9})
+    assert not ok and "peak" in reason
+
+    # unknown peak (CPU): absolute bound
+    ok, reason = validate_leg({"util_vs_bf16_peak": None,
+                               "model_tflops_per_sec": 500.0,
+                               "linearity_2x": 2.0})
+    assert not ok and "5 TFLOP/s" in reason
+
+    # dispatch-only timer: doubling the work doesn't double the window
+    ok, reason = validate_leg({"util_vs_bf16_peak": 0.5,
+                               "model_tflops_per_sec": 1.0,
+                               "linearity_2x": 1.02})
+    assert not ok and "linearity" in reason
