@@ -166,6 +166,7 @@ def measure_fused(quick: bool) -> dict:
     model = os.environ.get("SLT_BENCH_MODEL", "split_cnn")
     dtype = os.environ.get("SLT_BENCH_DTYPE", "float32")
     batch = int(os.environ.get("SLT_BENCH_BATCH", str(BATCH)))
+    mode = os.environ.get("SLT_BENCH_MODE", "split")  # "u_split" = config 5
 
     # full run = the reference's complete 3-epoch workload (2,814 steps)
     chunk, n_chunks = (100, 2) if quick else (469, 6)
@@ -182,8 +183,8 @@ def measure_fused(quick: bool) -> dict:
     import jax.numpy as jnp
     xd, yd = jnp.asarray(x), jnp.asarray(y)
 
-    cfg = Config(mode="split", batch_size=batch, dtype=dtype)
-    plan = get_plan(model=model, mode="split", dtype=dtype)
+    cfg = Config(mode=mode, batch_size=batch, dtype=dtype)
+    plan = get_plan(model=model, mode=mode, dtype=dtype)
     trainer = FusedSplitTrainer(plan, cfg, jax.random.PRNGKey(0), x[0])
     device = trainer.state.step.devices().pop()
     platform = device.platform
@@ -233,6 +234,7 @@ def measure_fused(quick: bool) -> dict:
     peak = device_peak_flops(device)
     leg = {
         "model": model,
+        "mode": mode,
         "batch": batch,
         "dtype": dtype,
         "steps_per_sec": steps_per_sec,
@@ -258,6 +260,108 @@ def measure_fused(quick: bool) -> dict:
     }
     leg["valid"], leg["invalid_reason"] = validate_leg(leg)
     return leg
+
+
+def measure_dp(quick: bool) -> dict:
+    """Config 3 (BASELINE.md): multi-client data parallelism. The global
+    batch shards over the mesh's ``data`` axis; gradient psum over ICI
+    replaces the reference's per-epoch weight shipping.
+
+    Run on the virtual host-platform mesh (no multi-chip hardware in this
+    image), so steps/sec is **scheduling-relative**: N virtual devices
+    share one host core, which measures the collective schedule's
+    overhead, not a speedup. The loss-parity column is exact math, not
+    relative: DP-N on the same global batch must reproduce the 1-device
+    loss series (psum-mean of shard gradients ≡ full-batch gradient)."""
+    import jax
+    import numpy as np
+
+    from split_learning_tpu.models import get_plan
+    from split_learning_tpu.parallel.mesh import make_mesh
+    from split_learning_tpu.runtime.fused import FusedSplitTrainer
+    from split_learning_tpu.utils import Config
+
+    n_clients = int(os.environ.get("SLT_BENCH_DP_CLIENTS", "4"))
+    global_batch = 256
+    steps = 5 if quick else 20
+    rs = np.random.RandomState(0)
+    x = rs.randn(steps, global_batch, 28, 28, 1).astype(np.float32)
+    y = rs.randint(0, 10, (steps, global_batch)).astype(np.int64)
+    cfg = Config(mode="split", batch_size=global_batch)
+
+    def run(n: int):
+        mesh = make_mesh(num_clients=n) if n > 1 else None
+        trainer = FusedSplitTrainer(
+            get_plan(mode="split"), cfg, jax.random.PRNGKey(0), x[0],
+            mesh=mesh)
+        trainer.train_step(x[0], y[0])  # compile
+        losses = []
+        t0 = time.perf_counter()
+        for i in range(steps):
+            losses.append(trainer.train_step(x[i], y[i]))  # float() = sync
+        return time.perf_counter() - t0, losses
+
+    dt_1, losses_1 = run(1)
+    dt_n, losses_n = run(n_clients)
+    return {
+        "leg": "multi_client_dp",
+        "clients": n_clients,
+        "global_batch": global_batch,
+        "platform": jax.devices()[0].platform,
+        "scheduling_relative": True,
+        "steps_per_sec_1_client": steps / dt_1,
+        f"steps_per_sec_{n_clients}_clients": steps / dt_n,
+        "loss_max_abs_diff_vs_1_client": float(np.max(np.abs(
+            np.asarray(losses_1) - np.asarray(losses_n)))),
+        "valid": True, "invalid_reason": None,
+    }
+
+
+def measure_wire(quick: bool) -> dict:
+    """The int8 wire-compression claim (VERDICT round 2, weak #5): HTTP
+    cut-layer round-trip p50 with ``compress="int8"`` vs ``"none"`` on the
+    same loopback server. The 4x byte reduction is implemented in C++ and
+    Pallas (native/slt_codec.cc, ops/quantize.py); this measures whether
+    it buys wall-clock on the wire path."""
+    import jax
+    import numpy as np
+
+    from split_learning_tpu.models import get_plan
+    from split_learning_tpu.runtime import ServerRuntime, SplitClientTrainer
+    from split_learning_tpu.transport.http import HttpTransport, SplitHTTPServer
+    from split_learning_tpu.utils import Config
+
+    steps = 5 if quick else 25
+    cfg = Config(mode="split", batch_size=BATCH)
+    plan = get_plan(mode="split")
+    x, y = _data(steps + 2, "split_cnn")
+    out = {"leg": "http_wire_compression", "platform": "cpu+http-loopback",
+           "valid": True, "invalid_reason": None}
+    for compress in ("none", "int8"):
+        runtime = ServerRuntime(plan, cfg, jax.random.PRNGKey(0), x[0])
+        server = SplitHTTPServer(runtime).start()
+        transport = HttpTransport(server.url, compress=compress)
+        client = SplitClientTrainer(plan, cfg, jax.random.PRNGKey(0),
+                                    transport)
+        try:
+            for i in range(2):
+                client.train_step(x[i], y[i], i)
+            from split_learning_tpu.transport.base import TransportStats
+            transport.stats = TransportStats()  # drop warmup from the window
+            for i in range(2, steps + 2):
+                client.train_step(x[i], y[i], i)
+            s = transport.stats.summary()
+            out[f"p50_ms_{compress}"] = s["p50_ms"]
+            out[f"bytes_per_step_{compress}"] = (
+                (s["bytes_sent"] + s["bytes_received"]) / steps)
+        finally:
+            transport.close()
+            server.stop()
+    if out.get("bytes_per_step_int8"):
+        out["byte_reduction"] = (out["bytes_per_step_none"]
+                                 / out["bytes_per_step_int8"])
+        out["p50_speedup"] = out["p50_ms_none"] / out["p50_ms_int8"]
+    return out
 
 
 def _run_subprocess(role: str, quick: bool, env_overrides: dict,
@@ -331,17 +435,16 @@ def _probe_device(budget_s: float) -> bool:
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--role", choices=["baseline", "fused"], default=None)
+    ap.add_argument("--role", choices=["baseline", "fused", "dp", "wire"],
+                    default=None)
     ap.add_argument("--quick", action="store_true")
     args = ap.parse_args()
 
-    if args.role == "baseline":
+    if args.role is not None:
         _drop_axon_if_cpu()
-        print(json.dumps(measure_baseline(args.quick)))
-        return
-    if args.role == "fused":
-        _drop_axon_if_cpu()
-        print(json.dumps(measure_fused(args.quick)))
+        fn = {"baseline": measure_baseline, "fused": measure_fused,
+              "dp": measure_dp, "wire": measure_wire}[args.role]
+        print(json.dumps(fn(args.quick)))
         return
 
     # orchestrator: baseline on hermetic CPU; fused on the default backend
@@ -399,6 +502,33 @@ def main() -> None:
                         "flops_per_step", "valid", "invalid_reason")
                 resnet = {k: resnet.get(k) for k in keep}
             detail["resnet18_b256_bf16"] = resnet
+        # config 5: U-shaped 3-hop split, fused on the device (the client
+        # holds stages A and C; one program, labels never cross the cut).
+        # Same scope as bf16/resnet: device legs only next to a valid
+        # device headline.
+        usplit = _run_subprocess("fused", args.quick,
+                                 {"SLT_BENCH_MODE": "u_split"}, timeout=900)
+        if usplit is not None and usplit.get("valid"):
+            detail["u_split_fused"] = usplit
+        elif usplit is not None:
+            print(f"[bench] u_split leg INVALID: "
+                  f"{usplit.get('invalid_reason')}", file=sys.stderr)
+
+    if not args.quick and fused is not None and fused.get("valid"):
+        # CPU side legs — skipped when the headline is doomed to exit(1)
+        # below, so an invalid run never burns subprocess budget on them.
+        # config 3: multi-client DP on the virtual host mesh (no
+        # multi-chip hardware here; scheduling-relative, loss parity is
+        # the exact part)
+        dp_env = dict(CPU_ENV)
+        dp_env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        dp = _run_subprocess("dp", args.quick, dp_env, timeout=900)
+        if dp is not None:
+            detail["multi_client_dp"] = dp
+        # the int8 wire-compression latency claim
+        wire = _run_subprocess("wire", args.quick, CPU_ENV, timeout=900)
+        if wire is not None:
+            detail["http_wire_compression"] = wire
 
     detail["fused"] = fused
     if fused is None:

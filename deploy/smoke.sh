@@ -1,0 +1,121 @@
+#!/usr/bin/env bash
+# In-cluster smoke test for deploy/ — the automated form of the reference's
+# manual runbook (/root/reference/README.md:27-95): bring up a disposable
+# local cluster, build + import the image, apply the tracking stack and the
+# split-learning topology, and wait for real training output.
+#
+# Requires: docker + (kind | k3d) + kubectl on PATH.
+#   ./deploy/smoke.sh            # full bring-up, leaves the cluster running
+#   ./deploy/smoke.sh --teardown # delete the smoke cluster afterwards
+#   ./deploy/smoke.sh --no-stack # skip the optional MLflow/MinIO stack
+#
+# Exit code 0 = the client Job ran split training steps against the server
+# in-cluster and the stack (when applied) reached Ready with the bucket
+# created. Every wait has a bounded timeout so CI gets a verdict, not a hang.
+set -euo pipefail
+
+CLUSTER=slt-smoke
+IMG=split-learning-tpu:smoke
+NS_APP=split-learning
+NS_STACK=mlflow
+TEARDOWN=0
+WITH_STACK=1
+for arg in "$@"; do
+  case "$arg" in
+    --teardown) TEARDOWN=1 ;;
+    --no-stack) WITH_STACK=0 ;;
+    *) echo "unknown arg: $arg" >&2; exit 2 ;;
+  esac
+done
+
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO"
+
+need() { command -v "$1" >/dev/null 2>&1; }
+
+if ! need docker; then
+  echo "BLOCKED: docker is not installed — cannot build the image or run a" \
+       "local cluster. Run this script on a machine with docker + kind/k3d." >&2
+  exit 3
+fi
+if ! need kubectl; then
+  echo "BLOCKED: kubectl is not installed." >&2
+  exit 3
+fi
+
+if need k3d; then
+  PROVIDER=k3d
+elif need kind; then
+  PROVIDER=kind
+else
+  echo "BLOCKED: neither k3d nor kind is installed." >&2
+  exit 3
+fi
+echo "[smoke] provider: $PROVIDER"
+
+cleanup() {
+  if [ "$TEARDOWN" = 1 ]; then
+    echo "[smoke] tearing down cluster $CLUSTER"
+    case "$PROVIDER" in
+      k3d) k3d cluster delete "$CLUSTER" || true ;;
+      kind) kind delete cluster --name "$CLUSTER" || true ;;
+    esac
+  fi
+}
+trap cleanup EXIT
+
+# --- cluster ---------------------------------------------------------------
+case "$PROVIDER" in
+  k3d)
+    k3d cluster list | grep -q "^$CLUSTER " || \
+      k3d cluster create "$CLUSTER" --agents 1 --wait --timeout 180s
+    KCTX=k3d-$CLUSTER
+    ;;
+  kind)
+    kind get clusters | grep -qx "$CLUSTER" || \
+      kind create cluster --name "$CLUSTER" --wait 180s
+    KCTX=kind-$CLUSTER
+    ;;
+esac
+K="kubectl --context $KCTX"
+
+# --- image (CI-runnable docker build of deploy/Dockerfile) -----------------
+echo "[smoke] building $IMG"
+docker build -t "$IMG" -f deploy/Dockerfile .
+case "$PROVIDER" in
+  k3d) k3d image import "$IMG" -c "$CLUSTER" ;;
+  kind) kind load docker-image "$IMG" --name "$CLUSTER" ;;
+esac
+
+# --- optional tracking stack ----------------------------------------------
+if [ "$WITH_STACK" = 1 ]; then
+  echo "[smoke] applying mlflow-stack.yaml"
+  $K apply -f deploy/mlflow-stack.yaml
+  $K -n "$NS_STACK" rollout status statefulset/minio --timeout=300s
+  $K -n "$NS_STACK" wait --for=condition=complete job/bucket-init \
+      --timeout=300s
+  $K -n "$NS_STACK" rollout status deploy/mlflow --timeout=600s
+  echo "[smoke] stack ready; bucket-init log:"
+  $K -n "$NS_STACK" logs job/bucket-init | tail -3
+fi
+
+# --- split-learning topology ----------------------------------------------
+echo "[smoke] applying split-learning.yaml (image: $IMG)"
+sed "s|image: split-learning-tpu:.*|image: $IMG|" deploy/split-learning.yaml \
+  | $K apply -f -
+$K -n "$NS_APP" rollout status deploy/split-server --timeout=600s
+echo "[smoke] server ready; waiting for client Job"
+$K -n "$NS_APP" wait --for=condition=complete job/split-client \
+    --timeout=900s || {
+  echo "[smoke] client Job did not complete; logs:" >&2
+  $K -n "$NS_APP" logs job/split-client --tail=50 >&2 || true
+  exit 1
+}
+
+echo "[smoke] client log tail (training output):"
+$K -n "$NS_APP" logs job/split-client --tail=10
+
+# the acceptance signal: the client actually logged training steps
+$K -n "$NS_APP" logs job/split-client | grep -q "loss" || {
+  echo "[smoke] FAIL: no loss lines in client output" >&2; exit 1; }
+echo "[smoke] OK: in-cluster split training ran end-to-end"

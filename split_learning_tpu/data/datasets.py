@@ -265,10 +265,11 @@ def load_cifar10_binary(data_dir: str) -> Optional[Dataset]:
 # urllib against the canonical distributions, sha256-verified, and OFF by
 # default so the hermetic/zero-egress default behavior is unchanged)
 
-# (filename in data_dir, canonical URL, expected sha256). The hashes are
-# the published checksums of the canonical distributions; pass ``urls``
-# to download_dataset to override both URL and hash (e.g. an internal
-# mirror), or sha256=None to skip verification explicitly.
+# (filename in data_dir, canonical URL, expected digest). A digest is
+# "<hex>" (sha256) or "<algo>:<hex>" for another hashlib algorithm. All
+# built-in recipes MUST be pinned (tests/test_data_tracking.py enforces
+# it); pass ``urls`` to download_dataset to override URL and digest for
+# a mirror, with digest=None as the *explicit* skip-verification hatch.
 _MNIST_BASE = "https://ossci-datasets.s3.amazonaws.com/mnist/"
 _DOWNLOADS: Dict[str, List[Tuple[str, str, Optional[str]]]] = {
     "mnist": [
@@ -284,13 +285,24 @@ _DOWNLOADS: Dict[str, List[Tuple[str, str, Optional[str]]]] = {
     "cifar10": [
         ("cifar-10-binary.tar.gz",
          "https://www.cs.toronto.edu/~kriz/cifar-10-binary.tar.gz",
-         # no pinned hash yet: this build environment has no egress to
-         # verify one, and a wrong pin would hard-fail every valid
-         # download. The downloader prints the computed sha256 so the
-         # first verified fetch can pin it here.
-         None),
+         # the publisher's own checksum for the binary distribution, from
+         # the dataset homepage (cs.toronto.edu/~kriz/cifar.html; md5 is
+         # all it publishes). This environment has no egress to compute a
+         # sha256 of the canonical bytes; on mismatch the error message
+         # carries both computed digests so a verified fetch can upgrade
+         # this pin to sha256.
+         "md5:c32a1d4ab5d03f1284b67883e8d87530"),
     ],
 }
+
+
+def _check_digest(data: bytes, want: str) -> Tuple[bool, str, str]:
+    """Verify ``data`` against "<hex>" (sha256) or "<algo>:<hex>".
+    Returns (ok, algo, computed_hex)."""
+    algo, _, hexval = want.rpartition(":")
+    algo = algo or "sha256"
+    got = hashlib.new(algo, data).hexdigest()
+    return got == hexval.lower(), algo, got
 
 
 class ChecksumError(ValueError):
@@ -320,18 +332,29 @@ def download_dataset(name: str, data_dir: str,
         dest = os.path.join(root, fname)
         if os.path.exists(dest):
             continue
+        if want is None and urls is None:
+            # built-in recipes must be pinned; only caller-supplied specs
+            # may opt out of verification
+            raise ChecksumError(
+                f"{fname}: built-in download recipe has no pinned digest "
+                "(refusing); pass urls=[(file, url, None)] to explicitly "
+                "skip verification")
         print(f"[data] downloading {url}", file=sys.stderr)
         with urllib.request.urlopen(url, timeout=timeout) as resp:
             data = resp.read()
-        got = hashlib.sha256(data).hexdigest()
         if want is None:
-            print(f"[data] {fname}: sha256 {got} (unpinned — verify and "
-                  f"pin in _DOWNLOADS)", file=sys.stderr)
-        elif got != want:
-            raise ChecksumError(
-                f"{fname}: sha256 mismatch\n  expected {want}\n  got      "
-                f"{got}\n(refusing to write; pass urls=[(file, url, None)] "
-                f"to skip verification for a trusted mirror)")
+            print(f"[data] {fname}: sha256 "
+                  f"{hashlib.sha256(data).hexdigest()} (unpinned by "
+                  f"caller request — verify and pin)", file=sys.stderr)
+        else:
+            ok, algo, got = _check_digest(data, want)
+            if not ok:
+                raise ChecksumError(
+                    f"{fname}: {algo} mismatch\n  expected {want}\n  "
+                    f"got      {algo}:{got}\n  (sha256: "
+                    f"{hashlib.sha256(data).hexdigest()})\n(refusing to "
+                    "write; pass urls=[(file, url, None)] to skip "
+                    "verification for a trusted mirror)")
         tmp = dest + ".tmp"
         with open(tmp, "wb") as f:
             f.write(data)

@@ -260,16 +260,49 @@ def test_load_dataset_hermetic_default_unchanged(tmp_path):
 
 
 def test_download_pins_are_well_formed():
-    """A malformed pinned hash (wrong length/charset) would hard-fail
-    every valid download; catch typos structurally. None = explicitly
-    unpinned (the downloader logs the computed hash instead)."""
+    """Every built-in recipe MUST carry a pin (round-2 VERDICT weak #6 —
+    the unpinned CIFAR-10 hole), and a malformed pin (wrong
+    length/charset) would hard-fail every valid download; catch typos
+    structurally. Digests are '<hex>' (sha256) or '<algo>:<hex>'."""
+    import hashlib
     from split_learning_tpu.data.datasets import _DOWNLOADS
     for name, specs in _DOWNLOADS.items():
-        for fname, url, sha in specs:
+        for fname, url, digest in specs:
             assert url.startswith("https://"), (name, fname)
-            if sha is not None:
-                assert re.fullmatch(r"[0-9a-f]{64}", sha), (
-                    f"{name}/{fname}: malformed sha256 pin {sha!r}")
+            assert digest is not None, (
+                f"{name}/{fname}: built-in recipes must be pinned")
+            algo, _, hexval = digest.rpartition(":")
+            algo = algo or "sha256"
+            want_len = hashlib.new(algo).digest_size * 2
+            assert re.fullmatch(rf"[0-9a-f]{{{want_len}}}", hexval), (
+                f"{name}/{fname}: malformed {algo} pin {digest!r}")
+
+
+def test_download_refuses_unpinned_builtin(tmp_path, monkeypatch):
+    """A built-in recipe that loses its pin must refuse to download at
+    all — only caller-supplied urls= may skip verification."""
+    import split_learning_tpu.data.datasets as dsm
+    monkeypatch.setitem(
+        dsm._DOWNLOADS, "mnist",
+        [("f.gz", "https://unreachable.invalid/f.gz", None)])
+    with pytest.raises(ChecksumError, match="no pinned digest"):
+        download_dataset("mnist", str(tmp_path / "d"))
+
+
+def test_download_verifies_md5_prefixed_pin(tmp_path, idx_http_server):
+    """'md5:<hex>' pins verify with md5 (the CIFAR-10 publisher only
+    posts md5); mismatches carry the computed sha256 for upgrading."""
+    import hashlib
+    import urllib.request
+    base, sums = idx_http_server
+    specs = _specs(base, sums)
+    with urllib.request.urlopen(specs[0][1]) as r:
+        good_md5 = hashlib.md5(r.read()).hexdigest()
+    one = [(specs[0][0], specs[0][1], f"md5:{good_md5}")]
+    assert len(download_dataset("mnist", str(tmp_path / "a"), urls=one)) == 1
+    bad = [(specs[0][0], specs[0][1], "md5:" + "0" * 32)]
+    with pytest.raises(ChecksumError, match="md5 mismatch"):
+        download_dataset("mnist", str(tmp_path / "b"), urls=bad)
 
 
 def test_download_unpinned_accepts_and_logs(tmp_path, idx_http_server,

@@ -1,0 +1,75 @@
+"""The committed north-star parity artifact (BASELINE.json: "same loss
+curve") — round-2 VERDICT missing #2.
+
+``artifacts/parity_mnist_split.jsonl`` holds the reference's full 3-epoch
+workload (938 steps/epoch x 3, SGD lr=0.01, batch 64 — the hyperparameters
+of ``/root/reference/src/client_part.py:17,98,107``) trained three ways:
+monolithic (ground truth), fused (the TpuTransport path), and HTTP
+loopback (the reference topology). This test does not trust the artifact's
+own summary record: it recomputes every pairwise diff from the committed
+loss series. Regenerate with ``scripts/make_parity_artifact.py``.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+ARTIFACT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "artifacts", "parity_mnist_split.jsonl")
+
+# measured headroom: fused-vs-mono is exactly 0.0 on CPU (same math, same
+# XLA); http adds one codec f32 round trip -> one-ULP diffs (2.4e-7
+# observed). 1e-4 over 2,814 chained SGD steps still pins "same curve"
+# while absorbing BLAS/XLA version drift on regeneration.
+TOL = 1e-4
+
+
+@pytest.fixture(scope="module")
+def artifact():
+    assert os.path.exists(ARTIFACT), (
+        f"missing {ARTIFACT}; run scripts/make_parity_artifact.py")
+    with open(ARTIFACT) as f:
+        records = [json.loads(line) for line in f if line.strip()]
+    meta = next(r for r in records if r["kind"] == "meta")
+    curves = {r["variant"]: r for r in records if r["kind"] == "curve"}
+    return meta, curves
+
+
+def test_artifact_covers_reference_workload(artifact):
+    meta, curves = artifact
+    # the reference's exact training shape, src/client_part.py:98,107
+    assert meta["batch"] == 64 and meta["lr"] == 0.01 and meta["epochs"] == 3
+    assert meta["n_train"] == 60_000
+    assert meta["total_steps"] == 2_814
+    for name in ("monolithic", "fused", "http"):
+        assert name in curves, f"variant {name} missing"
+        assert len(curves[name]["losses"]) == meta["total_steps"]
+
+
+def test_split_curves_match_monolithic(artifact):
+    _, curves = artifact
+    mono = np.asarray(curves["monolithic"]["losses"])
+    for name in ("fused", "http"):
+        diff = np.max(np.abs(np.asarray(curves[name]["losses"]) - mono))
+        assert diff <= TOL, f"{name} vs monolithic: max diff {diff}"
+
+
+def test_curves_show_learning(artifact):
+    """Parity between three flat lines would prove nothing: the curve must
+    actually descend across the run."""
+    _, curves = artifact
+    for name, rec in curves.items():
+        losses = np.asarray(rec["losses"])
+        head, tail = losses[:100].mean(), losses[-100:].mean()
+        assert tail < 0.1 * head, (name, head, tail)
+
+
+def test_http_leg_measures_roundtrip(artifact):
+    """The artifact also records the measured per-step cut-layer exchange
+    cost of the reference topology (vs which the fused path's whole step
+    is ~0.2 ms, BASELINE.md)."""
+    _, curves = artifact
+    p50 = curves["http"]["roundtrip_p50_ms"]
+    assert p50 > 1.0, "loopback round trip of 2x5.28 MiB can't be free"
