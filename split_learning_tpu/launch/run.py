@@ -196,6 +196,12 @@ def cmd_train(args) -> int:
             print(f"[warn] --scan-steps ignored on transport="
                   f"{args.transport!r} (only the fused transport scans "
                   f"steps)", file=sys.stderr)
+    if (getattr(args, "pipeline_depth", 1) or 1) > 1 \
+            and args.transport in ("fused", "pipeline"):
+        print(f"[warn] --pipeline-depth ignored on transport="
+              f"{args.transport!r} (the in-flight window applies to the "
+              "MPMD local/http transports; fused/pipeline exchange "
+              "in-XLA and have no wire to overlap)", file=sys.stderr)
 
     if args.transport in ("fused", "pipeline"):
         from split_learning_tpu.parallel import global_mesh
@@ -298,11 +304,20 @@ def cmd_train(args) -> int:
         full_params = trainer.state.params
     else:
         # MPMD path: a transport to a (possibly remote) server party
+        depth = getattr(args, "pipeline_depth", 1) or 1
+        if depth > 1 and cfg.mode != "split":
+            print(f"[warn] --pipeline-depth ignored in mode {cfg.mode!r} "
+                  "(split only)", file=sys.stderr)
+            depth = 1
         server: Optional[ServerRuntime] = None
+        transport_factory = None
         if args.transport == "http":
             from split_learning_tpu.transport.http import HttpTransport
             transport = HttpTransport(cfg.server_url,
                                       compress=args.compress or "none")
+            if depth > 1:  # one connection per in-flight lane
+                transport_factory = lambda: HttpTransport(  # noqa: E731
+                    cfg.server_url, compress=args.compress or "none")
             # readiness barrier: the reference's client starts blind and
             # silently drops every pre-server batch (SURVEY.md §3.4)
             info = transport.wait_ready(timeout=args.wait_server)
@@ -311,12 +326,22 @@ def cmd_train(args) -> int:
                       f"but this client wants {cfg.mode!r}", file=sys.stderr)
                 return 4
         else:
+            # in-process server: out-of-order arrival is part of the deal
+            # for a depth-W window, so strictness follows the depth
             server = ServerRuntime(plan, cfg, jax.random.PRNGKey(cfg.seed),
-                                   sample)
+                                   sample, strict_steps=depth <= 1)
             transport = LocalTransport(server)
         if cfg.mode == "split":
-            client = SplitClientTrainer(plan, cfg, rng, transport,
-                                        logger=logger, profiler=phase_prof)
+            if depth > 1:
+                from split_learning_tpu.runtime import (
+                    PipelinedSplitClientTrainer)
+                client = PipelinedSplitClientTrainer(
+                    plan, cfg, rng, transport, depth=depth,
+                    transport_factory=transport_factory, logger=logger)
+            else:
+                client = SplitClientTrainer(plan, cfg, rng, transport,
+                                            logger=logger,
+                                            profiler=phase_prof)
             layout = "split_local" if server is not None else "client_only"
         elif cfg.mode == "u_split":
             client = USplitClientTrainer(plan, cfg, rng, transport,
@@ -378,10 +403,14 @@ def cmd_train(args) -> int:
             if ckptr is not None:
                 ckptr.save_once(next_step, party_tree())
 
-        with trace_ctx:
-            records = client.train(data_iter, epochs=cfg.epochs,
-                                   start_step=start_step,
-                                   on_epoch_end=on_epoch_end)
+        try:
+            with trace_ctx:
+                records = client.train(data_iter, epochs=cfg.epochs,
+                                       start_step=start_step,
+                                       on_epoch_end=on_epoch_end)
+        finally:
+            if hasattr(client, "close"):  # pipelined: join lanes + conns
+                client.close()
         n_steps = len(records)
         final_loss = records[-1].loss if records else float("nan")
         print(f"[transport] {transport.stats.summary()}", file=sys.stderr)
@@ -455,7 +484,8 @@ def cmd_serve(args) -> int:
     shape = _SHAPES.get("mnist" if cfg.dataset == "synthetic" else cfg.dataset,
                         (28, 28, 1))
     sample = np.zeros((cfg.batch_size,) + shape, np.float32)
-    runtime = ServerRuntime(plan, cfg, jax.random.PRNGKey(cfg.seed), sample)
+    runtime = ServerRuntime(plan, cfg, jax.random.PRNGKey(cfg.seed), sample,
+                            strict_steps=not args.allow_out_of_order)
 
     # the server party owns its half's persistence (the client cannot
     # checkpoint it across HTTP): periodic saves + resume with the step
@@ -577,6 +607,12 @@ def main(argv: Optional[list] = None) -> int:
     pt.add_argument("--compress", choices=["none", "int8"], default=None,
                     help="wire compression of the cut-layer tensors "
                          "(http transport only)")
+    pt.add_argument("--pipeline-depth", dest="pipeline_depth", type=int,
+                    default=1,
+                    help="split mode, local/http transports: keep up to N "
+                         "cut-layer exchanges in flight (bounded-staleness "
+                         "async SGD; an http server needs "
+                         "--allow-out-of-order when N > 1)")
     pt.add_argument("--resume", action="store_true",
                     help="restore the latest checkpoint before training")
     pt.add_argument("--checkpoint-every", type=int, default=0,
@@ -595,6 +631,11 @@ def main(argv: Optional[list] = None) -> int:
     ps.add_argument("--checkpoint-every", type=int, default=100,
                     help="checkpoint the server half every N acknowledged "
                          "steps (with --checkpoint-dir)")
+    ps.add_argument("--allow-out-of-order", dest="allow_out_of_order",
+                    action="store_true",
+                    help="accept out-of-order client steps (required by "
+                         "pipelined clients, --pipeline-depth > 1; disables "
+                         "the replay-refusing strict step handshake)")
     ps.set_defaults(fn=cmd_serve)
 
     pe = sub.add_parser("eval", help="evaluate a checkpoint on the test split")

@@ -73,19 +73,21 @@ def init_multi_host(coordinator_address: Optional[str] = None,
             f"multi-host init needs a process id ({_ENV_PROCESS_ID}; on k8s "
             "use the StatefulSet pod ordinal)")
     import jax
-    if os.environ.get("JAX_PLATFORMS", "").strip().lower().startswith("cpu"):
-        # CPU processes need an explicit cross-process collectives backend
-        # (TPU rides ICI/DCN natively). Gloo ships in jaxlib and is what
-        # the 2-process smoke test (tests/test_distributed.py) runs on.
-        try:
-            jax.config.update("jax_cpu_collectives_implementation", "gloo")
-        except Exception as exc:  # pragma: no cover - jaxlib without gloo
-            # do not swallow silently: without a cross-process CPU
-            # collectives backend the first psum hangs, not errors
-            import sys
-            print(f"[distributed] WARNING: could not select gloo CPU "
-                  f"collectives ({type(exc).__name__}: {exc}); cross-"
-                  f"process collectives may hang", file=sys.stderr)
+    # CPU processes need an explicit cross-process collectives backend or
+    # the first psum hangs (TPU rides ICI/DCN natively and ignores this
+    # option, so setting it unconditionally is harmless there — keying it
+    # on JAX_PLATFORMS would silently skip default-CPU hosts with the env
+    # unset). Gloo ships in jaxlib; the 2-process smoke test
+    # (tests/test_distributed.py) runs on it.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception as exc:  # pragma: no cover - jaxlib without gloo
+        # do not swallow silently: without a cross-process CPU
+        # collectives backend the first psum hangs, not errors
+        import sys
+        print(f"[distributed] WARNING: could not select gloo CPU "
+              f"collectives ({type(exc).__name__}: {exc}); cross-"
+              f"process collectives may hang on CPU", file=sys.stderr)
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

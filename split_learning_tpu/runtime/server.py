@@ -141,9 +141,14 @@ class ServerRuntime:
             self._check_step(step, client_id)
             self.state, g_acts, loss = self._split_step(
                 self.state, jnp.asarray(activations), jnp.asarray(labels))
-            self._last_step[client_id] = step
+            # max(): with strict_steps off (pipelined clients) steps can
+            # arrive out of order, and the acknowledged step — what /health
+            # reports and checkpoints are labeled with — must never regress
+            # below state the server has already absorbed
+            acked = max(self._last_step.get(client_id, -1), step)
+            self._last_step[client_id] = acked
             if self.on_step is not None:
-                self.on_step(step)
+                self.on_step(acked)
             return np.asarray(g_acts), float(loss)
 
     # bounds on residuals awaiting their hop-2 u_backward. Per-client FIFO
@@ -189,9 +194,14 @@ class ServerRuntime:
                     f"u_backward for unknown step {step} (client {client_id})")
             self.state, g_acts = self._u_bwd(
                 self.state, acts, jnp.asarray(feat_grads))
-            self._last_step[client_id] = step
+            # max(): with strict_steps off (pipelined clients) steps can
+            # arrive out of order, and the acknowledged step — what /health
+            # reports and checkpoints are labeled with — must never regress
+            # below state the server has already absorbed
+            acked = max(self._last_step.get(client_id, -1), step)
+            self._last_step[client_id] = acked
             if self.on_step is not None:
-                self.on_step(step)
+                self.on_step(acked)
             return np.asarray(g_acts)
 
     def aggregate(self, params: Any, epoch: int, loss: float,

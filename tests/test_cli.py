@@ -45,6 +45,19 @@ def test_train_cli_http_loopback(tmp_path, capsys):
         server.stop()
 
 
+def test_train_cli_pipelined_client_depth(tmp_path, capsys):
+    """--pipeline-depth W drives the in-flight-window client end-to-end
+    (local transport constructs its server with strict_steps=False)."""
+    rc = main(["train", "--mode", "split", "--transport", "local",
+               "--dataset", "synthetic", "--steps", "8",
+               "--batch-size", "16", "--epochs", "1",
+               "--pipeline-depth", "3",
+               "--data-dir", str(tmp_path), "--tracking", "noop"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "[done]" in out and "steps=8" in out
+
+
 @pytest.mark.parametrize("mode", ["split", "u_split"])
 def test_train_cli_pipeline(tmp_path, capsys, mode):
     """Pipeline transport over the ppermute mesh — including the U-shaped
