@@ -325,6 +325,13 @@ def cmd_train(args) -> int:
                 print(f"[transport] server is in mode {info.get('mode')!r} "
                       f"but this client wants {cfg.mode!r}", file=sys.stderr)
                 return 4
+            if depth > 1 and info.get("strict_steps", False):
+                # fail fast: with W lanes, arrival order is a thread race
+                # and a strict server 409s nondeterministically mid-run
+                print(f"[transport] --pipeline-depth {depth} needs the "
+                      "server started with serve --allow-out-of-order "
+                      "(it reports strict_steps=true)", file=sys.stderr)
+                return 5
         else:
             # in-process server: out-of-order arrival is part of the deal
             # for a depth-W window, so strictness follows the depth
@@ -333,6 +340,11 @@ def cmd_train(args) -> int:
             transport = LocalTransport(server)
         if cfg.mode == "split":
             if depth > 1:
+                if phase_prof is not None:
+                    print("[warn] --profile-dir phase accounting is not "
+                          "supported with --pipeline-depth > 1 (phases "
+                          "overlap by design); the XLA trace still "
+                          "records", file=sys.stderr)
                 from split_learning_tpu.runtime import (
                     PipelinedSplitClientTrainer)
                 client = PipelinedSplitClientTrainer(
@@ -413,11 +425,14 @@ def cmd_train(args) -> int:
                 client.close()
         n_steps = len(records)
         final_loss = records[-1].loss if records else float("nan")
-        print(f"[transport] {transport.stats.summary()}", file=sys.stderr)
-        if transport.stats.round_trips:
+        # pipelined client: its .stats merges every lane's transport —
+        # lane 0 alone would undercount round trips/bytes by ~depth
+        stats = client.stats if hasattr(client, "stats") else transport.stats
+        print(f"[transport] {stats.summary()}", file=sys.stderr)
+        if stats.round_trips:
             # the north-star latency series (SURVEY.md §5 metrics)
             logger.log_metric("transport_p50_ms",
-                              transport.stats.percentile(50) * 1e3,
+                              stats.percentile(50) * 1e3,
                               step=n_steps)
 
         if cfg.mode == "federated":

@@ -103,10 +103,9 @@ class PipelinedSplitClientTrainer:
     def _apply(self, entry) -> float:
         """Apply one completed exchange (in step order): remat backward
         under the params the forward used, update current state."""
-        params_then, x, future = entry
+        params_then, xd, future = entry
         g_acts, loss = future.result()
-        g_params = self._bwd(params_then, jnp.asarray(x),
-                             jnp.asarray(g_acts))
+        g_params = self._bwd(params_then, xd, jnp.asarray(g_acts))
         self.state = apply_grads(self._tx, self.state, g_params)
         return loss
 
@@ -126,9 +125,14 @@ class PipelinedSplitClientTrainer:
                     entry = window.pop(0)
                     loss = self._apply(entry[:3])
                     self._record(records, entry[3], epoch, loss)
-                acts = np.asarray(self._fwd(self.state.params, jnp.asarray(x)))
+                # stash the MATERIALIZED device array, not the caller's
+                # buffer: the remat backward re-reads it up to depth-1
+                # batches later, and a loader that recycles one numpy
+                # buffer per batch would silently hand it different data
+                xd = jnp.asarray(x)
+                acts = np.asarray(self._fwd(self.state.params, xd))
                 lane = step % self.depth
-                window.append((self.state.params, x,
+                window.append((self.state.params, xd,
                                self._submit(lane, acts, y, step), step))
                 step += 1
             for entry in window:  # drain
@@ -148,6 +152,16 @@ class PipelinedSplitClientTrainer:
         self._pool.shutdown(wait=True)
         for t in self._transports[1:]:
             t.close()
+
+    @property
+    def stats(self):
+        """Merged TransportStats over ALL lanes — lane 0's view alone
+        undercounts round trips and bytes by ~depth."""
+        from split_learning_tpu.transport.base import TransportStats
+        # dedupe: without a transport_factory every lane shares one
+        # transport object, and merging it depth times would double-count
+        unique = {id(t): t for t in self._transports}
+        return TransportStats.merged([t.stats for t in unique.values()])
 
     @property
     def params(self):

@@ -247,7 +247,11 @@ class ServerRuntime:
             step = max(self._last_step.values(), default=-1)
             step = max(step, self._step_floor)
         return {"status": "healthy", "mode": self.mode,
-                "model_type": model_type, "step": step}
+                "model_type": model_type, "step": step,
+                # pipelined clients (depth > 1) need this False: with W
+                # lanes in flight, arrival order is a thread race and the
+                # strict handshake would 409 nondeterministically
+                "strict_steps": self.strict_steps}
 
 
 class FedAvgAggregator:

@@ -69,6 +69,20 @@ class TransportStats:
                 return float("nan")
             return float(np.percentile(np.asarray(self._latencies), q))
 
+    @classmethod
+    def merged(cls, stats_list: "list[TransportStats]") -> "TransportStats":
+        """Pooled view over several transports (e.g. the pipelined
+        client's lanes): counts sum, percentiles pool all samples."""
+        m = cls()
+        for s in stats_list:
+            with s._lock:
+                m.round_trips += s.round_trips
+                m.bytes_sent += s.bytes_sent
+                m.bytes_received += s.bytes_received
+                m.total_seconds += s.total_seconds
+                m._latencies.extend(s._latencies)
+        return m
+
     def summary(self) -> Dict[str, float]:
         return {
             "round_trips": self.round_trips,

@@ -36,6 +36,24 @@ def test_bench_quick_prints_contract_json():
     assert 1.5 <= fused["linearity_2x"] <= 2.6
 
 
+def test_bench_wire_and_pipelined_roles_quick():
+    """The side legs the orchestrator adds in non-quick runs must at
+    least produce their contract fields (run here in quick mode,
+    in-process on the CPU-forced test env)."""
+    sys.path.insert(0, REPO)
+    from bench import measure_pipelined, measure_wire
+
+    wire = measure_wire(quick=True)
+    assert wire["valid"] and wire["byte_reduction"] > 3.5
+    assert wire["p50_ms_none"] > 1.0 and wire["p50_ms_int8"] > 1.0
+
+    piped = measure_pipelined(quick=True)
+    assert piped["valid"]
+    assert piped["steps_per_sec_sync"] > 0
+    assert piped["steps_per_sec_depth4"] > 0
+    assert "note" in piped  # the shared-core caveat must ship with the leg
+
+
 def test_validate_leg_gates_impossible_throughput():
     """The round-1/2 failure mode — a steps/sec figure above chip peak —
     must be refused, whether the peak is known (util>1) or not (absolute

@@ -45,6 +45,31 @@ def test_train_cli_http_loopback(tmp_path, capsys):
         server.stop()
 
 
+def test_train_cli_pipelined_rejects_strict_http_server(tmp_path, capsys):
+    """Depth > 1 against a strict-handshake http server must fail fast
+    (exit 5) at the readiness barrier, not 409 mid-run on a thread race."""
+    import jax
+    from split_learning_tpu.models import get_plan
+    from split_learning_tpu.runtime import ServerRuntime
+    from split_learning_tpu.transport.http import SplitHTTPServer
+    from split_learning_tpu.utils import Config
+
+    cfg = Config(mode="split", batch_size=16)
+    sample = np.zeros((16, 28, 28, 1), np.float32)
+    runtime = ServerRuntime(get_plan(mode="split"), cfg,
+                            jax.random.PRNGKey(0), sample)  # strict default
+    server = SplitHTTPServer(runtime).start()
+    try:
+        rc = main(["train", "--mode", "split", "--transport", "http",
+                   "--server-url", server.url, "--pipeline-depth", "2",
+                   "--dataset", "synthetic", "--steps", "4",
+                   "--batch-size", "16", "--epochs", "1",
+                   "--data-dir", str(tmp_path), "--tracking", "noop"])
+    finally:
+        server.stop()
+    assert rc == 5
+
+
 def test_train_cli_pipelined_client_depth(tmp_path, capsys):
     """--pipeline-depth W drives the in-flight-window client end-to-end
     (local transport constructs its server with strict_steps=False)."""

@@ -32,7 +32,8 @@ def test_http_split_step_and_training(http_pair):
     cfg, plan, runtime, server, transport = http_pair
     h = transport.health()
     assert h == {"status": "healthy", "mode": "split",
-                 "model_type": "part_b", "step": -1}
+                 "model_type": "part_b", "step": -1,
+                 "strict_steps": True}
 
     client = SplitClientTrainer(plan, cfg, jax.random.PRNGKey(2), transport)
     rs = np.random.RandomState(1)
