@@ -540,8 +540,11 @@ def main() -> None:
 
     # a wedged device tunnel hangs indefinitely, so establish that the
     # default backend answers a trivial op before committing 900s to it
+    # 900s default: the tunnel has been observed to wedge for stretches
+    # and recover; a dead-tunnel round costs 15 min of probing, a
+    # given-up-too-early probe costs the round's TPU headline (round 1)
     probe_budget = float(os.environ.get(
-        "SLT_BENCH_PROBE_BUDGET", "60" if args.quick else "480"))
+        "SLT_BENCH_PROBE_BUDGET", "60" if args.quick else "900"))
     device_ok = _probe_device(probe_budget)
 
     detail = {"baseline": baseline}

@@ -325,7 +325,9 @@ def cmd_train(args) -> int:
                 print(f"[transport] server is in mode {info.get('mode')!r} "
                       f"but this client wants {cfg.mode!r}", file=sys.stderr)
                 return 4
-            if depth > 1 and info.get("strict_steps", False):
+            # default True when absent: servers predating the field are
+            # strict by default, and those are exactly the ones to reject
+            if depth > 1 and info.get("strict_steps", True):
                 # fail fast: with W lanes, arrival order is a thread race
                 # and a strict server 409s nondeterministically mid-run
                 print(f"[transport] --pipeline-depth {depth} needs the "

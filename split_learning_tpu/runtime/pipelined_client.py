@@ -97,8 +97,12 @@ class PipelinedSplitClientTrainer:
     def _submit(self, lane: int, acts: np.ndarray, y: np.ndarray,
                 step: int) -> Future:
         transport = self._transports[lane]
+        # copy the labels: the lane thread serializes them up to depth-1
+        # batches later, and np.asarray of a caller-recycled buffer would
+        # hand it different data (same hazard as x, fixed in train())
         return self._pool.submit(
-            transport.split_step, acts, np.asarray(y), step, self.client_id)
+            transport.split_step, acts, np.array(y, copy=True), step,
+            self.client_id)
 
     def _apply(self, entry) -> float:
         """Apply one completed exchange (in step order): remat backward
