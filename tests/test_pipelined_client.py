@@ -117,6 +117,44 @@ def test_http_lanes_run_concurrently():
     assert runtime._last_step[0] == 19
 
 
+def test_fault_mid_window_raises_and_quiesces():
+    """A transport fault inside the in-flight window surfaces as an
+    exception from train() (the documented RAISE policy) instead of
+    hanging a lane thread, and close() returns promptly afterward."""
+    from split_learning_tpu.transport.base import (
+        FaultInjector, FaultyTransport, TransportError)
+
+    batches = _batches(12)
+    cfg = Config(mode="split", batch_size=BATCH, lr=0.01)
+    plan = get_plan(mode="split")
+    server = ServerRuntime(plan, cfg, jax.random.PRNGKey(SEED),
+                           batches[0][0], strict_steps=False)
+    faulty = FaultyTransport(LocalTransport(server),
+                             FaultInjector(fail_steps={5}))
+    piped = PipelinedSplitClientTrainer(
+        plan, cfg, jax.random.PRNGKey(SEED), faulty, depth=3)
+    with pytest.raises(TransportError, match="injected fault"):
+        piped.train(lambda: iter(batches), epochs=1)
+    piped.close()  # must join lanes without hanging
+
+
+def test_checkpoint_cli_resume_with_depth(tmp_path, capsys):
+    """--pipeline-depth composes with checkpoint/resume: the window
+    drains at each epoch boundary, so the saved joint state is quiesced
+    and a resumed run continues from it."""
+    from split_learning_tpu.launch.run import main
+
+    args = ["train", "--mode", "split", "--transport", "local",
+            "--dataset", "synthetic", "--batch-size", "16",
+            "--epochs", "1", "--steps", "8", "--pipeline-depth", "3",
+            "--data-dir", str(tmp_path / "data"), "--tracking", "noop",
+            "--checkpoint-dir", str(tmp_path / "ckpt")]
+    assert main(args) == 0
+    assert main(args + ["--resume"]) == 0
+    out = capsys.readouterr().out
+    assert "[done]" in out
+
+
 def test_depth_validation():
     plan = get_plan(mode="split")
     cfg = Config(mode="split", batch_size=BATCH)
