@@ -3,7 +3,7 @@ curve") — round-2 VERDICT missing #2.
 
 ``artifacts/parity_mnist_split.jsonl`` holds the reference's full 3-epoch
 workload (938 steps/epoch x 3, SGD lr=0.01, batch 64 — the hyperparameters
-of ``/root/reference/src/client_part.py:17,98,107``) trained three ways:
+of ``/root/reference/src/client_part.py:17,98,107``) trained four ways (the fourth, http_pipelined, checks convergence only):
 monolithic (ground truth), fused (the TpuTransport path), and HTTP
 loopback (the reference topology). This test does not trust the artifact's
 own summary record: it recomputes every pairwise diff from the committed
@@ -64,6 +64,20 @@ def test_curves_show_learning(artifact):
         losses = np.asarray(rec["losses"])
         head, tail = losses[:100].mean(), losses[-100:].mean()
         assert tail < 0.1 * head, (name, head, tail)
+
+
+def test_pipelined_variant_converges_to_monolithic(artifact):
+    """The depth-4 bounded-staleness curve is NOT expected to match
+    monolithic step-for-step (delay < 4); the claim it must support is
+    convergence: over the full 2,814-step workload it ends where the
+    exact curve ends."""
+    _, curves = artifact
+    if "http_pipelined" not in curves:
+        pytest.skip("artifact generated without the http_pipelined variant")
+    piped = np.asarray(curves["http_pipelined"]["losses"])
+    mono = np.asarray(curves["monolithic"]["losses"])
+    assert len(piped) == len(mono)
+    assert piped[-100:].mean() < 2.0 * max(mono[-100:].mean(), 1e-4)
 
 
 def test_http_leg_measures_roundtrip(artifact):

@@ -151,8 +151,8 @@ def test_checkpoint_cli_resume_with_depth(tmp_path, capsys):
             "--checkpoint-dir", str(tmp_path / "ckpt")]
     assert main(args) == 0
     assert main(args + ["--resume"]) == 0
-    out = capsys.readouterr().out
-    assert "[done]" in out
+    err = capsys.readouterr().err
+    assert "[ckpt] resumed at step" in err, err[-800:]
 
 
 def test_depth_validation():
