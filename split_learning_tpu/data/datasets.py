@@ -385,6 +385,30 @@ def synthetic(name: str, n_train: int = 4096, n_test: int = 512,
                    name=name, num_classes=num_classes, synthetic=True)
 
 
+def synthetic_tokens(name: str = "tokens", n_train: int = 4096,
+                     n_test: int = 512, num_classes: int = 10,
+                     vocab: int = 256, seq_len: int = 64,
+                     seed: int = 0) -> Dataset:
+    """Class-conditional token sequences for the transformer family
+    (models/transformer.py): class k draws its tokens from a k-specific
+    categorical distribution, so the task is learnable, deterministic and
+    needs zero egress. ``x`` is ``[N, T] int32`` token ids."""
+    rs = np.random.RandomState(seed)
+    # temperature-sharpened per-class token distributions
+    logits = 2.0 * rs.randn(num_classes, vocab)
+    probs = np.exp(logits - logits.max(axis=1, keepdims=True))
+    probs /= probs.sum(axis=1, keepdims=True)
+
+    def make(n: int, rs: np.random.RandomState) -> Split:
+        y = rs.randint(0, num_classes, (n,)).astype(np.int64)
+        x = np.stack([rs.choice(vocab, size=seq_len, p=probs[k])
+                      for k in y]).astype(np.int32)
+        return Split(x, y)
+
+    return Dataset(train=make(n_train, rs), test=make(n_test, rs),
+                   name=name, num_classes=num_classes, synthetic=True)
+
+
 def store_from_config(cfg) -> Optional[DatasetStore]:
     """The deployment seam: an S3Store when the reference's S3 env surface
     (S3_ENDPOINT_URL / AWS_* -> Config.s3_*) is configured — in-cluster
@@ -429,7 +453,7 @@ def load_dataset(name: str, data_dir: str,
             return load_cifar10_binary(data_dir)
         return None
 
-    if name not in ("mnist", "cifar10", "synthetic"):
+    if name not in ("mnist", "cifar10", "synthetic", "tokens"):
         raise ValueError(f"Unknown dataset: {name!r}")
     ds = load_raw()
     if ds is None and download and name in _DOWNLOADS:
@@ -445,7 +469,10 @@ def load_dataset(name: str, data_dir: str,
             "fallback disabled")
     if store.exists(synth_key):
         return _from_blob(name, store.fetch(synth_key))
-    ds = synthetic("mnist" if name == "synthetic" else name)
+    if name == "tokens":
+        ds = synthetic_tokens()
+    else:
+        ds = synthetic("mnist" if name == "synthetic" else name)
     store.put(synth_key, _to_blob(ds))
     return ds
 

@@ -36,9 +36,11 @@ import numpy as np
 def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--mode", choices=["split", "federated", "u_split"],
                    default=None)
-    p.add_argument("--model", default=None, help="split_cnn | resnet18")
+    p.add_argument("--model", default=None,
+                   help="split_cnn | resnet18 | resnet18_4stage | "
+                        "transformer")
     p.add_argument("--dataset", default=None,
-                   help="mnist | cifar10 | synthetic")
+                   help="mnist | cifar10 | synthetic | tokens")
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--epochs", type=int, default=None)
     p.add_argument("--lr", type=float, default=None)
@@ -69,7 +71,7 @@ def _config_from_args(args) -> "Config":
         if val is not None:
             overrides[field] = val
     for field in ("transport", "num_clients", "num_stages", "microbatches",
-                  "server_url", "model_parallel"):
+                  "server_url", "model_parallel", "seq_parallel", "attn"):
         val = getattr(args, field, None)
         if val is not None:
             overrides[field] = val
@@ -192,6 +194,10 @@ def cmd_train(args) -> int:
             print(f"[warn] --model-parallel ignored on transport="
                   f"{args.transport!r} (tensor parallelism requires the "
                   f"fused transport)", file=sys.stderr)
+        if cfg.seq_parallel > 1:
+            print(f"[warn] --seq-parallel ignored on transport="
+                  f"{args.transport!r} (context parallelism requires the "
+                  f"fused transport)", file=sys.stderr)
         if (getattr(args, "scan_steps", 0) or 0) > 1:
             print(f"[warn] --scan-steps ignored on transport="
                   f"{args.transport!r} (only the fused transport scans "
@@ -208,10 +214,33 @@ def cmd_train(args) -> int:
         from split_learning_tpu.parallel.mesh import replicated
         if args.transport == "fused":
             from split_learning_tpu.runtime.fused import FusedSplitTrainer
+            if cfg.seq_parallel > 1 and cfg.model != "transformer":
+                # without this guard the trainer would shard an image dim
+                # over 'seq' (or fail on divisibility) — not context
+                # parallelism; only the sequence family has a seq axis
+                print(f"[warn] --seq-parallel ignored: model {cfg.model!r} "
+                      "has no sequence axis (transformer family only)",
+                      file=sys.stderr)
+                cfg = cfg.replace(seq_parallel=1)
             mesh = None
-            if cfg.num_clients > 1 or cfg.model_parallel > 1 or multi_host:
+            if (cfg.num_clients > 1 or cfg.model_parallel > 1
+                    or cfg.seq_parallel > 1 or multi_host):
                 mesh = global_mesh(num_clients=cfg.num_clients, num_stages=1,
-                                   model_parallel=cfg.model_parallel)
+                                   model_parallel=cfg.model_parallel,
+                                   seq_parallel=cfg.seq_parallel)
+            if cfg.model == "transformer" and (cfg.seq_parallel > 1
+                                               or cfg.attn != "full"):
+                # the seq-parallel attention forms need the mesh at plan
+                # build time (the shard_map closes over it)
+                from split_learning_tpu.models.transformer import (
+                    transformer_plan)
+                plan = transformer_plan(mode=cfg.mode,
+                                        dtype=np.dtype(cfg.dtype),
+                                        mesh=mesh, attn=cfg.attn)
+            elif cfg.attn != "full":
+                print(f"[warn] --attn {cfg.attn!r} ignored: model "
+                      f"{cfg.model!r} has no attention (transformer "
+                      "family only)", file=sys.stderr)
             trainer = FusedSplitTrainer(plan, cfg, rng, sample, mesh=mesh)
         else:
             from split_learning_tpu.parallel.pipeline import PipelinedTrainer
@@ -606,6 +635,15 @@ def main(argv: Optional[list] = None) -> int:
                     default=None,
                     help="tensor-parallel shards (mesh 'model' axis; "
                          "fused transport)")
+    pt.add_argument("--seq-parallel", dest="seq_parallel", type=int,
+                    default=None,
+                    help="context-parallel shards (mesh 'seq' axis; fused "
+                         "transport, transformer family — ring/Ulysses "
+                         "attention over ICI)")
+    pt.add_argument("--attn", choices=["full", "ring", "ulysses"],
+                    default=None,
+                    help="transformer attention math (seq-parallel forms "
+                         "need --seq-parallel > 1 to shard anything)")
     pt.add_argument("--coordinator", default=None,
                     help="host:port of process 0 for multi-host DCN runs "
                          "(or SLT_COORDINATOR; on k8s, a headless Service)")

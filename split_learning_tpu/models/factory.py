@@ -56,6 +56,15 @@ def _resnet18_4stage(mode: str, dtype: Any) -> SplitPlan:
     return resnet18_plan(mode=mode, dtype=dtype, stages=4)
 
 
+@register_model("transformer")
+def _transformer(mode: str, dtype: Any) -> SplitPlan:
+    """Long-context family (beyond reference scope): dense attention by
+    default; build seq-parallel variants via
+    models.transformer.transformer_plan(mesh=..., attn="ring")."""
+    from split_learning_tpu.models.transformer import transformer_plan
+    return transformer_plan(mode=mode, dtype=dtype)
+
+
 def get_plan(model: str = "split_cnn", mode: str = "split",
              dtype: Any = jnp.float32) -> SplitPlan:
     """Build the SplitPlan for a model family under a learning mode."""

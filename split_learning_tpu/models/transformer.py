@@ -1,0 +1,221 @@
+"""Split transformer — the framework's long-context model family.
+
+The reference's models are 2-conv CNNs on 28x28 images with no sequence
+axis anywhere (SURVEY.md §5 "Long-context: absent — definitively"); this
+family extends the same split-learning capability surface (a cut layer,
+two/three-party ownership, every transport and trainer unchanged) to
+sequence models whose activations ``[B, T, E]`` can be context-sharded
+over the mesh's ``seq`` axis via ring or Ulysses attention
+(ops/ring_attention.py).
+
+Stage layout mirrors the CNN family (models/cnn.py):
+
+- split:   client(embed + N_c blocks)  ->  server(N_s blocks + head)
+- u_split: client(embed + N_c blocks)  ->  server(N_s blocks)
+           -> client(LN + mean-pool + Dense head) — labels and logits
+           never leave the client (BASELINE.md config 5 semantics)
+- federated: the composition of the split plan (same params by
+  construction, core/stage.py).
+
+The cut-layer tensor is ``[B, T, d_model]`` — unlike the CNN's fixed
+5.28 MiB hop it grows with context length, which is exactly why the
+fused path shards it over ``seq`` instead of shipping it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from split_learning_tpu.core.stage import SplitPlan, from_flax
+from split_learning_tpu.ops.ring_attention import (
+    full_attention, ring_attention, ulysses_attention)
+
+_ATTN_IMPLS = ("full", "ring", "ulysses")
+
+
+class MultiHeadAttention(nn.Module):
+    """Projections + attention; the attention math itself is selectable
+    between dense and the two sequence-parallel forms."""
+
+    num_heads: int
+    mesh: Any = None          # jax.sharding.Mesh (hashable) or None
+    attn: str = "full"
+    causal: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, e = x.shape
+        if e % self.num_heads != 0:
+            raise ValueError(f"d_model {e} % heads {self.num_heads} != 0")
+        d = e // self.num_heads
+        heads_shape = (b, t, self.num_heads, d)
+        q = nn.Dense(e, dtype=self.dtype, name="q")(x).reshape(heads_shape)
+        k = nn.Dense(e, dtype=self.dtype, name="k")(x).reshape(heads_shape)
+        v = nn.Dense(e, dtype=self.dtype, name="v")(x).reshape(heads_shape)
+        if self.attn == "ring":
+            o = ring_attention(q, k, v, mesh=self.mesh, causal=self.causal)
+        elif self.attn == "ulysses":
+            o = ulysses_attention(q, k, v, mesh=self.mesh,
+                                  causal=self.causal)
+        elif self.attn == "full":
+            o = full_attention(q, k, v, causal=self.causal)
+        else:
+            raise ValueError(
+                f"Unknown attn impl: {self.attn!r} (expected {_ATTN_IMPLS})")
+        o = o.reshape((b, t, e))
+        return nn.Dense(e, dtype=self.dtype, name="out")(o)
+
+
+class Block(nn.Module):
+    """Pre-LN transformer block: x + MHA(LN(x)); x + MLP(LN(x))."""
+
+    num_heads: int
+    mlp_ratio: int = 4
+    mesh: Any = None
+    attn: str = "full"
+    causal: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        e = x.shape[-1]
+        h = MultiHeadAttention(self.num_heads, mesh=self.mesh,
+                               attn=self.attn, causal=self.causal,
+                               dtype=self.dtype, name="mha")(
+            nn.LayerNorm(dtype=self.dtype, name="ln1")(x))
+        x = x + h
+        y = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        y = nn.Dense(self.mlp_ratio * e, dtype=self.dtype, name="up")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(e, dtype=self.dtype, name="down")(y)
+        return x + y
+
+
+class EmbedStage(nn.Module):
+    """Client bottom stage: token + learned positional embeddings, then
+    ``depth`` blocks. ``[B, T] int -> [B, T, d_model]`` (the cut tensor)."""
+
+    vocab: int
+    d_model: int
+    num_heads: int
+    depth: int
+    max_len: int
+    mesh: Any = None
+    attn: str = "full"
+    causal: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens):
+        t = tokens.shape[1]
+        if t > self.max_len:
+            raise ValueError(f"sequence length {t} > max_len {self.max_len}")
+        x = nn.Embed(self.vocab, self.d_model, dtype=self.dtype,
+                     name="tok")(tokens)
+        pos = self.param("pos", nn.initializers.normal(0.02),
+                         (self.max_len, self.d_model), self.dtype)
+        x = x + pos[None, :t]
+        for i in range(self.depth):
+            x = Block(self.num_heads, mesh=self.mesh, attn=self.attn,
+                      causal=self.causal, dtype=self.dtype,
+                      name=f"block{i}")(x)
+        return x
+
+
+class TrunkStage(nn.Module):
+    """Server middle stage: ``depth`` blocks, ``[B, T, E] -> [B, T, E]``."""
+
+    num_heads: int
+    depth: int
+    mesh: Any = None
+    attn: str = "full"
+    causal: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        for i in range(self.depth):
+            x = Block(self.num_heads, mesh=self.mesh, attn=self.attn,
+                      causal=self.causal, dtype=self.dtype,
+                      name=f"block{i}")(x)
+        return x
+
+
+class HeadStage(nn.Module):
+    """Final LN -> mean-pool over T -> Dense(num_classes). Owned by the
+    server in the 2-party split, by the client in the U-shape."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        x = x.mean(axis=1)
+        return nn.Dense(self.num_classes, dtype=self.dtype, name="fc")(x)
+
+
+class TrunkAndHead(nn.Module):
+    """Server top stage for the 2-party split: trunk + head fused, so the
+    plan stays 2-stage like the CNN's (client A / server B)."""
+
+    num_heads: int
+    depth: int
+    num_classes: int = 10
+    mesh: Any = None
+    attn: str = "full"
+    causal: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = TrunkStage(self.num_heads, self.depth, mesh=self.mesh,
+                       attn=self.attn, causal=self.causal,
+                       dtype=self.dtype, name="trunk")(x)
+        return HeadStage(self.num_classes, dtype=self.dtype, name="head")(x)
+
+
+def transformer_plan(mode: str = "split", dtype: Any = jnp.float32, *,
+                     vocab: int = 256, d_model: int = 64,
+                     num_heads: int = 4, client_depth: int = 1,
+                     server_depth: int = 2, num_classes: int = 10,
+                     max_len: int = 2048, mesh: Optional[Any] = None,
+                     attn: str = "full", causal: bool = False) -> SplitPlan:
+    """Build the split-transformer :class:`SplitPlan` for ``mode``.
+
+    ``mesh``/``attn`` choose the attention math: pass a mesh with a
+    ``seq`` axis and ``attn="ring"``/``"ulysses"`` for context
+    parallelism; the default is dense attention anywhere.
+    """
+    if attn not in _ATTN_IMPLS:
+        raise ValueError(
+            f"Unknown attn impl: {attn!r} (expected {_ATTN_IMPLS})")
+    common = dict(mesh=mesh, attn=attn, causal=causal, dtype=dtype)
+    embed = from_flax("embed", EmbedStage(
+        vocab=vocab, d_model=d_model, num_heads=num_heads,
+        depth=client_depth, max_len=max_len, **common))
+    if mode == "u_split":
+        return SplitPlan(
+            stages=(
+                embed,
+                from_flax("trunk", TrunkStage(
+                    num_heads=num_heads, depth=server_depth, **common)),
+                from_flax("head", HeadStage(num_classes, dtype=dtype)),
+            ),
+            owners=("client", "server", "client"),
+        )
+    # split and federated share the 2-stage plan (the composition IS the
+    # federated full model, core/stage.py)
+    return SplitPlan(
+        stages=(
+            embed,
+            from_flax("trunk_head", TrunkAndHead(
+                num_heads=num_heads, depth=server_depth,
+                num_classes=num_classes, **common)),
+        ),
+        owners=("client", "server"),
+    )

@@ -16,6 +16,11 @@ the split-step hot path (SURVEY.md §3.1):
 - :mod:`~split_learning_tpu.ops.quantize` — int8 symmetric-scale
   quantize/dequantize for the cut-layer payload, shrinking the 5.28 MiB
   activation/gradient hop (SURVEY.md §2 derived facts) 4x on the wire.
+- :mod:`~split_learning_tpu.ops.ring_attention` — sequence/context-
+  parallel attention (ring over ``ppermute``, Ulysses over
+  ``all_to_all``) for the long-context transformer family; not a Pallas
+  kernel but an explicitly-scheduled collective op in the same "fast
+  layer beneath the models" slot.
 
 Every op has a pure-jnp reference implementation; kernels run compiled on
 TPU and in interpreter mode elsewhere (tests use the 8-device CPU mesh,
@@ -23,6 +28,11 @@ SURVEY.md §4 item 4). Select with ``Config.kernels = "xla" | "pallas"``.
 """
 
 from split_learning_tpu.ops.common import pallas_available, use_interpret
+from split_learning_tpu.ops.ring_attention import (
+    full_attention,
+    ring_attention,
+    ulysses_attention,
+)
 from split_learning_tpu.ops.cross_entropy import (
     fused_cross_entropy,
     reference_cross_entropy,
@@ -37,6 +47,9 @@ from split_learning_tpu.ops.quantize import (
 __all__ = [
     "pallas_available",
     "use_interpret",
+    "full_attention",
+    "ring_attention",
+    "ulysses_attention",
     "fused_cross_entropy",
     "reference_cross_entropy",
     "fused_sgd_step",

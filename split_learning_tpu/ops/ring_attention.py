@@ -1,0 +1,182 @@
+"""Sequence/context-parallel attention — ring and Ulysses forms.
+
+The reference has no attention and no sequence axis at all (SURVEY.md §5
+"Long-context / sequence parallelism: absent — definitively"); this module
+is the framework's long-context extension beyond reference capability, so
+the split-transformer family (models/transformer.py) can train on
+sequences longer than one chip's HBM allows.
+
+Both forms shard the sequence axis of ``[B, T, H, D]`` activations over a
+``seq`` mesh axis and exchange only what the math requires over ICI:
+
+- **Ring attention** (:func:`ring_attention`): each rank keeps its query
+  block resident and the K/V blocks rotate around the ring via
+  ``lax.ppermute``, one neighbor hop per step — the flash-attention
+  online-softmax recurrence (running max ``m``, denominator ``l``,
+  unnormalized accumulator ``o``) makes the partial results exact, so the
+  full ``T x T`` score matrix never materializes on any chip and per-chip
+  attention memory is O(T_local^2). Communication is nearest-neighbor
+  only, which is exactly what the TPU torus is built for.
+- **Ulysses attention** (:func:`ulysses_attention`): two
+  ``lax.all_to_all`` transposes swap the sharded axis — in: sequence
+  shards -> head shards, run dense per-head attention on the full
+  sequence, out: heads -> sequence. Fewer, larger collectives; requires
+  ``H % seq_shards == 0``.
+
+Everything is pure ``jnp`` inside ``shard_map``, so ``jax.grad``
+differentiates straight through (the cotangent of a ``ppermute`` is the
+inverse ``ppermute``; of an ``all_to_all``, the reverse ``all_to_all``)
+and the same code runs on the 8-virtual-device CPU test mesh
+(tests/test_ring_attention.py asserts fwd+grad equivalence vs
+:func:`full_attention`).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.8 public API; the experimental home is deprecated
+    from jax import shard_map as _shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs, check_rep=False):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=check_rep)
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+from split_learning_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
+
+_NEG_BIG = -1e30  # additive mask value; never fed to exp un-rebased
+
+
+def full_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   causal: bool = False) -> jax.Array:
+    """Plain dense softmax attention, ``[B, T, H, D] -> [B, T, H, D]``.
+
+    The single-device reference semantics both parallel forms must
+    reproduce; also the path the transformer uses with no ``seq`` mesh
+    axis.
+    """
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
+                          axis_name: str, causal: bool) -> jax.Array:
+    """Per-rank body (inside shard_map): q stays, k/v rotate n times."""
+    n = lax.psum(1, axis_name)          # ring size (static under shard_map)
+    rank = lax.axis_index(axis_name)
+    b, t_local, h, d = q.shape
+    scale = d ** -0.5
+    q_pos = rank * t_local + jnp.arange(t_local)
+
+    # accumulators in [B, H, Tq] / [B, H, Tq, D] layout so the softmax
+    # reductions run over the trailing (lane) dim
+    o0 = jnp.zeros((b, h, t_local, d), jnp.float32)
+    l0 = jnp.zeros((b, h, t_local), jnp.float32)
+    m0 = jnp.full((b, h, t_local), _NEG_BIG, jnp.float32)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def accumulate(o, l, m, kb, vb, i):
+        # after i forward rotations this rank holds the block that
+        # started on rank - i (mod n)
+        src = (rank - i) % n
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, kb,
+                       preferred_element_type=jnp.float32) * scale
+        k_pos = src * t_local + jnp.arange(t_local)
+        mask = None
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]       # [Tq, Tk]
+            s = jnp.where(mask[None, None], s, _NEG_BIG)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        # rebase then zero fully-masked entries: exp(_NEG_BIG - _NEG_BIG)
+        # would be 1, so masking must be re-applied after the exp
+        p = jnp.exp(s - m_new[..., None])
+        if causal:
+            p = jnp.where(mask[None, None], p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(axis=-1)
+        o = o * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, vb,
+            preferred_element_type=jnp.float32)
+        return o, l, m_new
+
+    def step(carry, i):
+        o, l, m, kb, vb = carry
+        o, l, m = accumulate(o, l, m, kb, vb, i)
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return (o, l, m, kb, vb), None
+
+    # n-1 (compute, rotate) steps, then the last block needs no rotation
+    # — n-1 ppermute hops total, and a 1-rank ring never communicates
+    (o, l, m, kb, vb), _ = lax.scan(
+        step, (o0, l0, m0, k, v), jnp.arange(n - 1))
+    o, l, _ = accumulate(o, l, m, kb, vb, n - 1)
+    out = o / l[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B,Tq,H,D]
+
+
+def _ulysses_local(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str, causal: bool) -> jax.Array:
+    """Per-rank body: all-to-all seq->heads, dense attention, heads->seq."""
+    n = lax.psum(1, axis_name)
+    if q.shape[2] % n != 0:
+        raise ValueError(
+            f"ulysses needs heads ({q.shape[2]}) divisible by the seq axis "
+            f"size ({n}); use ring_attention for odd head counts")
+    # [B, T/n, H, D] -> [B, T, H/n, D]: gather sequence, scatter heads
+    gather = functools.partial(lax.all_to_all, axis_name=axis_name,
+                               split_axis=2, concat_axis=1, tiled=True)
+    qg, kg, vg = gather(q), gather(k), gather(v)
+    og = full_attention(qg, kg, vg, causal=causal)
+    # [B, T, H/n, D] -> [B, T/n, H, D]
+    return lax.all_to_all(og, axis_name=axis_name, split_axis=1,
+                          concat_axis=2, tiled=True)
+
+
+def _sharded(mesh: Mesh, body, causal: bool, axis_name: str):
+    spec_axes = [None, axis_name, None, None]
+    if DATA_AXIS in mesh.axis_names:
+        spec_axes[0] = DATA_AXIS
+    spec = P(*spec_axes)
+    return shard_map(
+        functools.partial(body, axis_name=axis_name, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   mesh: Optional[Mesh] = None, causal: bool = False,
+                   axis_name: str = SEQ_AXIS) -> jax.Array:
+    """Sequence-parallel attention over ``mesh``'s ``seq`` axis.
+
+    ``q/k/v``: global ``[B, T, H, D]`` (call from inside ``jit`` — the
+    shard_map partitions them; T must divide by the seq axis size).
+    Falls back to :func:`full_attention` when ``mesh`` is None or has no
+    ``seq`` axis, so model code can call it unconditionally.
+    """
+    if mesh is None or axis_name not in mesh.axis_names:
+        return full_attention(q, k, v, causal=causal)
+    return _sharded(mesh, _ring_attention_local, causal, axis_name)(q, k, v)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      mesh: Optional[Mesh] = None, causal: bool = False,
+                      axis_name: str = SEQ_AXIS) -> jax.Array:
+    """All-to-all (DeepSpeed-Ulysses form) sequence-parallel attention."""
+    if mesh is None or axis_name not in mesh.axis_names:
+        return full_attention(q, k, v, causal=causal)
+    return _sharded(mesh, _ulysses_local, causal, axis_name)(q, k, v)

@@ -122,7 +122,7 @@ def _grid_rows(devices: Sequence, num_stages: int,
 
 
 def global_mesh(num_clients: int = 1, num_stages: int = 1,
-                model_parallel: int = 1,
+                model_parallel: int = 1, seq_parallel: int = 1,
                 devices: Optional[Sequence] = None):
     """A (data x pipe[, model]) mesh over every device of every host.
 
@@ -139,12 +139,19 @@ def global_mesh(num_clients: int = 1, num_stages: int = 1,
     n_procs = len({d.process_index for d in devices})
     if n_procs <= 1:
         return make_mesh(num_clients=num_clients, num_stages=num_stages,
-                         model_parallel=model_parallel, devices=devices)
+                         model_parallel=model_parallel,
+                         seq_parallel=seq_parallel, devices=devices)
     if model_parallel > 1:
         raise ValueError(
             "tensor parallelism (model axis) shards per-layer activation "
             "collectives and must stay on ICI; it is not supported across "
             "hosts — use data/pipe axes over DCN instead")
+    if seq_parallel > 1:
+        raise ValueError(
+            "context parallelism (seq axis) is wired for single-host ICI "
+            "meshes; cross-host ring attention over DCN is not laid out "
+            "by this policy — use the data axis across hosts and the seq "
+            "axis within one")
     rows = _grid_rows(devices, num_stages)
     if num_clients != len(rows):
         # never silently drop a host's devices: a truncated mesh would leave

@@ -25,29 +25,36 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DATA_AXIS = "data"
 PIPE_AXIS = "pipe"
 MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
 
 
 def make_mesh(num_clients: int = 1, num_stages: int = 1,
-              model_parallel: int = 1,
+              model_parallel: int = 1, seq_parallel: int = 1,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """A (data × pipe[, model]) mesh over the first
-    num_clients*num_stages*model_parallel devices. The model axis is only
-    materialized when model_parallel > 1, so existing (data × pipe)
-    callers are unchanged."""
+    """A (data × pipe[, model][, seq]) mesh over the first
+    num_clients*num_stages*model_parallel*seq_parallel devices. The model
+    and seq axes are only materialized when their sizes exceed 1, so
+    existing (data × pipe) callers are unchanged. The ``seq`` axis is the
+    long-context/context-parallel axis (ops/ring_attention.py): sequence
+    shards are neighbors on it so the ring's ppermute hops ride ICI."""
     if devices is None:
         devices = jax.devices()
-    need = num_clients * num_stages * model_parallel
+    need = num_clients * num_stages * model_parallel * seq_parallel
     if len(devices) < need:
         raise ValueError(
             f"mesh needs {need} devices ({num_clients} clients x "
-            f"{num_stages} stages x {model_parallel} model shards), "
-            f"only {len(devices)} available")
+            f"{num_stages} stages x {model_parallel} model shards x "
+            f"{seq_parallel} seq shards), only {len(devices)} available")
+    shape = [num_clients, num_stages]
+    names = [DATA_AXIS, PIPE_AXIS]
     if model_parallel > 1:
-        grid = np.asarray(devices[:need]).reshape(
-            num_clients, num_stages, model_parallel)
-        return Mesh(grid, (DATA_AXIS, PIPE_AXIS, MODEL_AXIS))
-    grid = np.asarray(devices[:need]).reshape(num_clients, num_stages)
-    return Mesh(grid, (DATA_AXIS, PIPE_AXIS))
+        shape.append(model_parallel)
+        names.append(MODEL_AXIS)
+    if seq_parallel > 1:
+        shape.append(seq_parallel)
+        names.append(SEQ_AXIS)
+    grid = np.asarray(devices[:need]).reshape(shape)
+    return Mesh(grid, tuple(names))
 
 
 def tp_param_sharding(mesh: Mesh, params: Any) -> Any:

@@ -32,7 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from split_learning_tpu.core.losses import cross_entropy
 from split_learning_tpu.core.stage import SplitPlan, remat_plan
 from split_learning_tpu.parallel.mesh import (
-    DATA_AXIS, batch_sharding, replicated, tp_param_sharding)
+    DATA_AXIS, SEQ_AXIS, batch_sharding, replicated, tp_param_sharding)
 from split_learning_tpu.runtime.state import TrainState, apply_grads, make_state, sgd
 from split_learning_tpu.utils.config import Config
 
@@ -70,10 +70,19 @@ class FusedSplitTrainer:
             # device_put with it before stepping (launch/run.py resume).
             self.state_sharding = tp_param_sharding(mesh, state)
             state = jax.device_put(state, self.state_sharding)
-            self._x_sharding = batch_sharding(mesh)
+            self._y_sharding = batch_sharding(mesh)
+            if SEQ_AXIS in mesh.axis_names and np.ndim(sample_input) >= 2:
+                # context parallelism: inputs [B, T, ...] shard their
+                # sequence dim over 'seq' so the non-attention compute
+                # partitions along T and ring/Ulysses attention
+                # (ops/ring_attention.py) finds its shards in place
+                self._x_sharding = NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS))
+            else:
+                self._x_sharding = self._y_sharding
         else:
             self.state_sharding = None
             self._x_sharding = None
+            self._y_sharding = None
         self.state = state
 
         microbatches = cfg.microbatches
@@ -137,21 +146,23 @@ class FusedSplitTrainer:
 
         if mesh is not None:
             state_sh = self.state_sharding
-            data_sh = batch_sharding(mesh)
-            seq_sh = NamedSharding(mesh, P(None, DATA_AXIS))
+            x_sh, y_sh = self._x_sharding, self._y_sharding
+            # epoch inputs carry a leading step axis: same spec shifted by 1
+            ep_x = NamedSharding(mesh, P(None, *tuple(x_sh.spec)))
+            ep_y = NamedSharding(mesh, P(None, *tuple(y_sh.spec)))
             self._step = jax.jit(
                 step_fn,
-                in_shardings=(state_sh, data_sh, data_sh),
+                in_shardings=(state_sh, x_sh, y_sh),
                 out_shardings=(state_sh, replicated(mesh)),
                 donate_argnums=(0,),
             )
             self._epoch = jax.jit(
                 epoch_fn,
-                in_shardings=(state_sh, seq_sh, seq_sh),
+                in_shardings=(state_sh, ep_x, ep_y),
                 out_shardings=(state_sh, replicated(mesh)),
                 donate_argnums=(0,),
             )
-            self._seq_sharding = seq_sh
+            self._seq_sharding = (ep_x, ep_y)
         else:
             self._step = jax.jit(step_fn, donate_argnums=(0,))
             self._epoch = jax.jit(epoch_fn, donate_argnums=(0,))
@@ -163,7 +174,7 @@ class FusedSplitTrainer:
         y = jnp.asarray(y)
         if self._x_sharding is not None:
             x = jax.device_put(x, self._x_sharding)
-            y = jax.device_put(y, self._x_sharding)
+            y = jax.device_put(y, self._y_sharding)
         self.state, loss = self._step(self.state, x, y)
         return float(loss)
 
@@ -173,8 +184,9 @@ class FusedSplitTrainer:
         xs = jnp.asarray(xs)
         ys = jnp.asarray(ys)
         if self._seq_sharding is not None:
-            xs = jax.device_put(xs, self._seq_sharding)
-            ys = jax.device_put(ys, self._seq_sharding)
+            ep_x, ep_y = self._seq_sharding
+            xs = jax.device_put(xs, ep_x)
+            ys = jax.device_put(ys, ep_y)
         self.state, losses = self._epoch(self.state, xs, ys)
         return losses
 
@@ -185,7 +197,7 @@ class FusedSplitTrainer:
         y = jnp.asarray(y)
         if self._x_sharding is not None:
             x = jax.device_put(x, self._x_sharding)
-            y = jax.device_put(y, self._x_sharding)
+            y = jax.device_put(y, self._y_sharding)
         self.state, loss = self._step(self.state, x, y)
         return loss
 

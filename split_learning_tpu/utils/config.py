@@ -40,6 +40,8 @@ _ENV_MAP = {
     "microbatches": "SLT_MICROBATCHES",
     "remat": "SLT_REMAT",
     "model_parallel": "SLT_MODEL_PARALLEL",
+    "seq_parallel": "SLT_SEQ_PARALLEL",
+    "attn": "SLT_ATTN",
     "data_dir": "SLT_DATA_DIR",
     "checkpoint_dir": "SLT_CHECKPOINT_DIR",
     "tracking": "SLT_TRACKING",
@@ -72,6 +74,8 @@ class Config:
     num_clients: int = 1      # data-parallel client replicas (mesh "data" axis)
     num_stages: int = 2       # pipeline stages (mesh "pipe" axis)
     model_parallel: int = 1   # tensor-parallel shards (mesh "model" axis)
+    seq_parallel: int = 1     # context-parallel shards (mesh "seq" axis)
+    attn: str = "full"        # "full" | "ring" | "ulysses" (transformer)
     microbatches: int = 1     # GPipe microbatches per step
     remat: bool = False       # jax.checkpoint stage forwards (FLOPs for HBM)
 
@@ -133,3 +137,9 @@ class Config:
             raise ValueError(
                 f"Unknown kernels backend: {self.kernels!r} "
                 "(expected 'xla' or 'pallas')")
+        if self.seq_parallel <= 0:
+            raise ValueError("seq_parallel must be positive")
+        if self.attn not in ("full", "ring", "ulysses"):
+            raise ValueError(
+                f"Unknown attn impl: {self.attn!r} "
+                "(expected 'full', 'ring' or 'ulysses')")

@@ -1,0 +1,95 @@
+"""Sequence-parallel attention (ops/ring_attention.py) vs dense reference.
+
+The property both parallel forms must satisfy — on the 8-virtual-device
+mesh (SURVEY.md §4 item 4) — is exact math: sharding the sequence axis
+over ``seq`` must not change the attention output *or its gradients*
+beyond float32 reassociation noise.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from split_learning_tpu.ops.ring_attention import (
+    full_attention, ring_attention, ulysses_attention)
+
+B, T, H, D = 4, 32, 4, 8
+
+
+@pytest.fixture(scope="module")
+def qkv():
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    shape = (B, T, H, D)
+    return tuple(jax.random.normal(k, shape, jnp.float32) for k in ks)
+
+
+def seq_mesh(devices, data=2, seq=4):
+    grid = np.asarray(devices[: data * seq]).reshape(data, seq)
+    return Mesh(grid, ("data", "seq"))
+
+
+@pytest.mark.parametrize("attn", [ring_attention, ulysses_attention])
+@pytest.mark.parametrize("causal", [False, True])
+def test_matches_dense_forward(devices, qkv, attn, causal):
+    q, k, v = qkv
+    mesh = seq_mesh(devices)
+    want = full_attention(q, k, v, causal=causal)
+    got = jax.jit(lambda a, b, c: attn(a, b, c, mesh=mesh, causal=causal))(
+        q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("attn", [ring_attention, ulysses_attention])
+@pytest.mark.parametrize("causal", [False, True])
+def test_matches_dense_gradients(devices, qkv, attn, causal):
+    q, k, v = qkv
+    mesh = seq_mesh(devices)
+    w = jax.random.normal(jax.random.PRNGKey(3), q.shape, jnp.float32)
+
+    def loss(fn):
+        def f(a, b, c):
+            return jnp.sum(fn(a, b, c) * w)
+        return f
+
+    want = jax.grad(loss(lambda a, b, c: full_attention(
+        a, b, c, causal=causal)), argnums=(0, 1, 2))(q, k, v)
+    got = jax.jit(jax.grad(loss(lambda a, b, c: attn(
+        a, b, c, mesh=mesh, causal=causal)), argnums=(0, 1, 2)))(q, k, v)
+    for g, wgrad in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(wgrad),
+                                   atol=5e-5, rtol=5e-5)
+
+
+def test_no_seq_axis_falls_back_to_dense(devices, qkv):
+    """Model code calls ring_attention unconditionally; without a seq
+    mesh axis it must be exactly the dense path."""
+    q, k, v = qkv
+    grid = np.asarray(devices[:4]).reshape(2, 2)
+    mesh = Mesh(grid, ("data", "pipe"))
+    want = full_attention(q, k, v)
+    np.testing.assert_array_equal(
+        np.asarray(ring_attention(q, k, v, mesh=mesh)), np.asarray(want))
+    np.testing.assert_array_equal(
+        np.asarray(ring_attention(q, k, v, mesh=None)), np.asarray(want))
+
+
+def test_causal_first_token_ignores_future(devices, qkv):
+    """Causal masking across shard boundaries: token 0's output depends
+    only on token 0, even though later tokens live on other ranks."""
+    q, k, v = qkv
+    mesh = seq_mesh(devices)
+    out = jax.jit(lambda a, b, c: ring_attention(
+        a, b, c, mesh=mesh, causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out[:, 0]), np.asarray(v[:, 0]),
+                               atol=1e-6)
+
+
+def test_ulysses_rejects_indivisible_heads(devices):
+    mesh = seq_mesh(devices, data=2, seq=4)
+    shape = (B, T, 6, D)  # 6 heads % 4 seq shards != 0
+    q = jnp.zeros(shape)
+    with pytest.raises(ValueError, match="divisible"):
+        jax.jit(lambda a: ulysses_attention(a, a, a, mesh=mesh))(q)
