@@ -64,11 +64,16 @@ def _drop_axon_if_cpu() -> None:
               f"CPU-pinned process", file=sys.stderr)
 
 
+SEQ_LEN = 256  # transformer bench context length
+
+
 def _data(n_steps: int, model: str):
     import numpy as np
     rs = np.random.RandomState(0)
     if model == "resnet18":
         x = rs.randn(n_steps, BATCH, 32, 32, 3).astype(np.float32)
+    elif model == "transformer":
+        x = rs.randint(0, 256, (n_steps, BATCH, SEQ_LEN)).astype(np.int32)
     else:
         x = rs.randn(n_steps, BATCH, 28, 28, 1).astype(np.float32)
     y = rs.randint(0, 10, (n_steps, BATCH)).astype(np.int64)
@@ -168,6 +173,7 @@ def measure_fused(quick: bool) -> dict:
     batch = int(os.environ.get("SLT_BENCH_BATCH", str(BATCH)))
     mode = os.environ.get("SLT_BENCH_MODE", "split")  # "u_split" = config 5
     kernels = os.environ.get("SLT_BENCH_KERNELS", "xla")  # "pallas" = ops/
+    attn = os.environ.get("SLT_BENCH_ATTN", "full")  # transformer only
 
     # full run = the reference's complete 3-epoch workload (2,814 steps)
     chunk, n_chunks = (100, 2) if quick else (469, 6)
@@ -175,22 +181,40 @@ def measure_fused(quick: bool) -> dict:
         # ~0.95 TFLOP/step at b256: far fewer steps make a stable window,
         # and the scan input buffer must fit HBM
         chunk, n_chunks = (4, 2) if quick else (15, 4)
+    elif model == "transformer":
+        chunk, n_chunks = (20, 2) if quick else (100, 4)
     x, y = _data(chunk, model)
     if batch != BATCH:
         reps = (batch + BATCH - 1) // BATCH
-        x = np.tile(x, (1, reps, 1, 1, 1))[:, :batch]
+        tile = (1, reps) + (1,) * (x.ndim - 2)
+        x = np.tile(x, tile)[:, :batch]
         y = np.tile(y, (1, reps))[:, :batch]
 
     import jax.numpy as jnp
     xd, yd = jnp.asarray(x), jnp.asarray(y)
 
-    cfg = Config(mode=mode, batch_size=batch, dtype=dtype, kernels=kernels)
-    plan = get_plan(model=model, mode=mode, dtype=dtype)
+    cfg = Config(mode=mode, batch_size=batch, dtype=dtype, kernels=kernels,
+                 attn=attn)
+    if model == "transformer" and attn != "full":
+        from split_learning_tpu.models.transformer import transformer_plan
+        plan = transformer_plan(mode=mode, dtype=np.dtype(dtype), attn=attn)
+    else:
+        plan = get_plan(model=model, mode=mode, dtype=dtype)
     trainer = FusedSplitTrainer(plan, cfg, jax.random.PRNGKey(0), x[0])
     device = trainer.state.step.devices().pop()
     platform = device.platform
 
-    flops_step = trainer.step_flops(x[0], y[0])
+    if model == "transformer" and attn != "full":
+        # the flash kernels hide their matmuls inside pallas_call, which
+        # the jaxpr FLOPs counter cannot see; count the dense-attention
+        # step of identical shapes instead (same math, trace-only)
+        ref_trainer = FusedSplitTrainer(
+            get_plan(model=model, mode=mode, dtype=dtype), cfg,
+            jax.random.PRNGKey(0), x[0])
+        flops_step = ref_trainer.step_flops(x[0], y[0])
+        del ref_trainer
+    else:
+        flops_step = trainer.step_flops(x[0], y[0])
 
     if platform == "cpu":
         # the scanned epoch is a TPU idiom; XLA *CPU* executes the
@@ -237,6 +261,7 @@ def measure_fused(quick: bool) -> dict:
         "model": model,
         "mode": mode,
         "kernels": kernels,
+        "attn": attn,
         "batch": batch,
         "dtype": dtype,
         "steps_per_sec": steps_per_sec,
@@ -606,6 +631,19 @@ def main() -> None:
         elif pallas is not None:
             print(f"[bench] pallas leg INVALID: "
                   f"{pallas.get('invalid_reason')}", file=sys.stderr)
+        # the long-context family on the device: dense vs Pallas-flash
+        # attention at T=256 (models/transformer.py, ops/flash_attention.py)
+        for leg_name, extra in (
+                ("transformer_t256_dense", {}),
+                ("transformer_t256_flash", {"SLT_BENCH_ATTN": "flash"})):
+            env = {"SLT_BENCH_MODEL": "transformer",
+                   "SLT_BENCH_DTYPE": "bfloat16", **extra}
+            tfm = _run_subprocess("fused", args.quick, env, timeout=900)
+            if tfm is not None and tfm.get("valid"):
+                detail[leg_name] = tfm
+            elif tfm is not None:
+                print(f"[bench] {leg_name} leg INVALID: "
+                      f"{tfm.get('invalid_reason')}", file=sys.stderr)
 
     if not args.quick and fused is not None and fused.get("valid"):
         # CPU side legs — skipped when the headline is doomed to exit(1)

@@ -198,6 +198,10 @@ def cmd_train(args) -> int:
             print(f"[warn] --seq-parallel ignored on transport="
                   f"{args.transport!r} (context parallelism requires the "
                   f"fused transport)", file=sys.stderr)
+        if cfg.attn != "full":
+            print(f"[warn] --attn {cfg.attn!r} ignored on transport="
+                  f"{args.transport!r} (attention math selection requires "
+                  f"the fused transport)", file=sys.stderr)
         if (getattr(args, "scan_steps", 0) or 0) > 1:
             print(f"[warn] --scan-steps ignored on transport="
                   f"{args.transport!r} (only the fused transport scans "
@@ -640,10 +644,12 @@ def main(argv: Optional[list] = None) -> int:
                     help="context-parallel shards (mesh 'seq' axis; fused "
                          "transport, transformer family — ring/Ulysses "
                          "attention over ICI)")
-    pt.add_argument("--attn", choices=["full", "ring", "ulysses"],
+    pt.add_argument("--attn",
+                    choices=["full", "flash", "ring", "ulysses"],
                     default=None,
-                    help="transformer attention math (seq-parallel forms "
-                         "need --seq-parallel > 1 to shard anything)")
+                    help="transformer attention math (flash = Pallas "
+                         "blockwise kernels; ring/ulysses shard the "
+                         "sequence and need --seq-parallel > 1)")
     pt.add_argument("--coordinator", default=None,
                     help="host:port of process 0 for multi-host DCN runs "
                          "(or SLT_COORDINATOR; on k8s, a headless Service)")

@@ -30,10 +30,11 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from split_learning_tpu.core.stage import SplitPlan, from_flax
+from split_learning_tpu.ops.flash_attention import flash_attention
 from split_learning_tpu.ops.ring_attention import (
     full_attention, ring_attention, ulysses_attention)
 
-_ATTN_IMPLS = ("full", "ring", "ulysses")
+_ATTN_IMPLS = ("full", "flash", "ring", "ulysses")
 
 
 class MultiHeadAttention(nn.Module):
@@ -61,6 +62,8 @@ class MultiHeadAttention(nn.Module):
         elif self.attn == "ulysses":
             o = ulysses_attention(q, k, v, mesh=self.mesh,
                                   causal=self.causal)
+        elif self.attn == "flash":
+            o = flash_attention(q, k, v, causal=self.causal)
         elif self.attn == "full":
             o = full_attention(q, k, v, causal=self.causal)
         else:

@@ -16,6 +16,10 @@ the split-step hot path (SURVEY.md §3.1):
 - :mod:`~split_learning_tpu.ops.quantize` — int8 symmetric-scale
   quantize/dequantize for the cut-layer payload, shrinking the 5.28 MiB
   activation/gradient hop (SURVEY.md §2 derived facts) 4x on the wire.
+- :mod:`~split_learning_tpu.ops.flash_attention` — blockwise-streamed
+  attention forward/backward kernels for the transformer family: VMEM-
+  resident online softmax, O(T*D) HBM traffic per head instead of the
+  dense path's O(T^2) score matrix.
 - :mod:`~split_learning_tpu.ops.ring_attention` — sequence/context-
   parallel attention (ring over ``ppermute``, Ulysses over
   ``all_to_all``) for the long-context transformer family; not a Pallas
@@ -28,6 +32,7 @@ SURVEY.md §4 item 4). Select with ``Config.kernels = "xla" | "pallas"``.
 """
 
 from split_learning_tpu.ops.common import pallas_available, use_interpret
+from split_learning_tpu.ops.flash_attention import flash_attention
 from split_learning_tpu.ops.ring_attention import (
     full_attention,
     ring_attention,
@@ -47,6 +52,7 @@ from split_learning_tpu.ops.quantize import (
 __all__ = [
     "pallas_available",
     "use_interpret",
+    "flash_attention",
     "full_attention",
     "ring_attention",
     "ulysses_attention",

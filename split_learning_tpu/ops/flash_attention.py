@@ -1,0 +1,304 @@
+"""Flash attention — Pallas forward/backward kernel set.
+
+The hot op of the transformer family (models/transformer.py). Dense
+softmax attention materializes the ``[T, T]`` score matrix in HBM and
+reads it back through the softmax and the ``P @ V`` matmul; this kernel
+streams K/V blocks through VMEM with the online-softmax recurrence, so
+HBM traffic per (batch, head) is O(T*D) instead of O(T^2) and the block
+matmuls stay on the MXU.
+
+- Forward saves only O and the per-row logsumexp (LSE) as residuals.
+- Backward is the standard two-kernel flash split: a dQ kernel gridded
+  over query blocks and a dK/dV kernel gridded over key blocks, each
+  recomputing P blockwise from (Q, K, LSE) — the FLOPs-for-HBM trade.
+- Causal masking uses global block coordinates, so block pairs entirely
+  in the future are masked (not skipped — grid shapes stay static).
+
+Like every op in this package there is a pure-jnp reference
+(:func:`split_learning_tpu.ops.ring_attention.full_attention`) and the
+kernels run under the Mosaic interpreter off-TPU
+(tests/test_flash_attention.py asserts fwd+grad equivalence). Head dim
+pads to the 128-lane tile and T to the block size, with masks keeping
+the math exact for ragged shapes.
+
+Composition note: flash is the *single-device* attention math; the ring
+form (ops/ring_attention.py) shards T across chips and could use these
+kernels for its per-block compute — today its block math is plain jnp
+(XLA fuses it well at ring block sizes), so ``attn="flash"`` and
+``attn="ring"`` are separate choices.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from split_learning_tpu.ops.common import LANE, pad_axis, round_up, use_interpret
+
+_NEG_BIG = -1e30
+_BLOCK_Q = 128
+_BLOCK_K = 128
+
+
+def _causal_mask(q0, k0, bq, bk):
+    """[bq, bk] bool: query global row >= key global col."""
+    rows = q0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    cols = k0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return rows >= cols
+
+
+def _fwd_kernel(t: int, scale: float, causal: bool, block_q: int,
+                block_k: int, q_ref, k_ref, v_ref, o_ref, lse_ref):
+    """One query block vs all key blocks: online softmax accumulation.
+
+    q_ref [block_q, Dp]; k_ref/v_ref [Tp, Dp]; o_ref [block_q, Dp];
+    lse_ref [block_q, LANE] (LSE broadcast over the lane dim).
+    """
+    q0 = pl.program_id(1) * block_q
+    qb = q_ref[:].astype(jnp.float32)
+    bq, dp = qb.shape
+    tp = k_ref.shape[0]
+
+    acc = jnp.zeros((bq, dp), jnp.float32)
+    l = jnp.zeros((bq,), jnp.float32)
+    m = jnp.full((bq,), _NEG_BIG, jnp.float32)
+
+    def body(kb, carry):
+        acc, l, m = carry
+        k0 = kb * block_k
+        kblk = k_ref[pl.ds(k0, block_k), :].astype(jnp.float32)
+        vblk = v_ref[pl.ds(k0, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            qb, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+        # T padding cols are invalid; causal adds the future mask
+        cols = k0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        ok = cols < t
+        if causal:
+            ok &= _causal_mask(q0, k0, bq, block_k)
+        s = jnp.where(ok, s, _NEG_BIG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where(ok, p, 0.0)                         # exp(0)=1 guard
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=1)
+        acc = acc * corr[:, None] + jax.lax.dot_general(
+            p, vblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, l, m_new
+
+    acc, l, m = jax.lax.fori_loop(0, tp // block_k, body, (acc, l, m))
+    # padded query rows never see a valid key: l == 0 there; guard the div
+    l_safe = jnp.where(l > 0.0, l, 1.0)
+    o_ref[:] = acc / l_safe[:, None]
+    lse = jnp.where(l > 0.0, m + jnp.log(l_safe), _NEG_BIG)
+    lse_ref[:] = jnp.broadcast_to(lse[:, None], (bq, LANE))
+
+
+def _dq_kernel(t: int, scale: float, causal: bool, block_q: int,
+               block_k: int, q_ref, k_ref, v_ref, do_ref, lse_ref,
+               delta_ref, dq_ref):
+    """dQ for one query block: dQ = scale * sum_k dS_k @ K_k,
+    dS = P * (dO @ V^T - delta)."""
+    q0 = pl.program_id(1) * block_q
+    qb = q_ref[:].astype(jnp.float32)
+    dob = do_ref[:].astype(jnp.float32)
+    lse = lse_ref[:][:, 0]                                # [bq]
+    delta = delta_ref[:][:, 0]                            # [bq]
+    bq, dp = qb.shape
+    tp = k_ref.shape[0]
+
+    def body(kb, dq):
+        k0 = kb * block_k
+        kblk = k_ref[pl.ds(k0, block_k), :].astype(jnp.float32)
+        vblk = v_ref[pl.ds(k0, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            qb, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        cols = k0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        ok = cols < t
+        if causal:
+            ok &= _causal_mask(q0, k0, bq, block_k)
+        p = jnp.exp(jnp.where(ok, s, _NEG_BIG) - lse[:, None])
+        p = jnp.where(ok, p, 0.0)
+        dp = jax.lax.dot_general(
+            dob, vblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [bq, bk]
+        ds = p * (dp - delta[:, None])
+        return dq + jax.lax.dot_general(
+            ds, kblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, tp // block_k,
+                           body, jnp.zeros((bq, dp), jnp.float32))
+    dq_ref[:] = dq * scale
+
+
+def _dkv_kernel(t: int, scale: float, causal: bool, block_q: int,
+                block_k: int, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                delta_ref, dk_ref, dv_ref):
+    """dK/dV for one key block: dV = sum_q P^T @ dO,
+    dK = scale * sum_q dS^T @ Q. q_ref/do_ref/lse_ref/delta_ref span the
+    full (padded) T; k_ref/v_ref are this key block."""
+    k0 = pl.program_id(1) * block_k
+    kblk = k_ref[:].astype(jnp.float32)                   # [bk, Dp]
+    vblk = v_ref[:].astype(jnp.float32)
+    bk, dp = kblk.shape
+    tp = q_ref.shape[0]
+
+    def body(qi, carry):
+        dk, dv = carry
+        q0 = qi * block_q
+        qb = q_ref[pl.ds(q0, block_q), :].astype(jnp.float32)
+        dob = do_ref[pl.ds(q0, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(q0, block_q), :][:, 0]
+        delta = delta_ref[pl.ds(q0, block_q), :][:, 0]
+        s = jax.lax.dot_general(
+            qb, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+        cols = k0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        # padded q rows carry lse=_NEG_BIG -> exp(s - (-1e30)) overflows;
+        # mask rows as well as cols
+        rows = q0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        ok = (cols < t) & (rows < t)
+        if causal:
+            ok &= _causal_mask(q0, k0, block_q, bk)
+        p = jnp.exp(jnp.where(ok, s - lse[:, None], _NEG_BIG))
+        p = jnp.where(ok, p, 0.0)
+        dv = dv + jax.lax.dot_general(
+            p, dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [bk, Dp]
+        dpp = jax.lax.dot_general(
+            dob, vblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [bq, bk]
+        ds = p * (dpp - delta[:, None])
+        dk = dk + jax.lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [bk, Dp]
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(
+        0, tp // block_q, body,
+        (jnp.zeros((bk, dp), jnp.float32), jnp.zeros((bk, dp), jnp.float32)))
+    dk_ref[:] = dk * scale
+    dv_ref[:] = dv
+
+
+# --------------------------------------------------------------------- #
+@functools.lru_cache(maxsize=None)
+def _make_flash(bh: int, t: int, d: int, causal: bool, dtype_name: str):
+    """Custom-VJP flash attention for one static ([BH, T, D], causal)."""
+    in_dtype = jnp.dtype(dtype_name)
+    scale = d ** -0.5
+    # one block size for both axes: tp is then a common multiple, so the
+    # q-grid and the k-loop cover exactly the same padded range
+    block_q = block_k = _BLOCK_Q
+    tp = round_up(t, block_q)
+    dp = round_up(d, LANE)
+    n_q = tp // block_q
+    n_k = tp // block_k
+
+    def pad_qkv(x):
+        return pad_axis(pad_axis(x, 1, tp), 2, dp)
+
+    qkv_spec = pl.BlockSpec((1, tp, dp), lambda b, i: (b, 0, 0),
+                            memory_space=pltpu.VMEM)
+    qblk_spec = pl.BlockSpec((1, block_q, dp), lambda b, i: (b, i, 0),
+                             memory_space=pltpu.VMEM)
+    row_spec = pl.BlockSpec((1, block_q, LANE), lambda b, i: (b, i, 0),
+                            memory_space=pltpu.VMEM)
+    kblk_spec = pl.BlockSpec((1, block_k, dp), lambda b, i: (b, i, 0),
+                             memory_space=pltpu.VMEM)
+    full_spec = pl.BlockSpec((1, tp, dp), lambda b, i: (b, 0, 0),
+                             memory_space=pltpu.VMEM)
+    row_full_spec = pl.BlockSpec((1, tp, LANE), lambda b, i: (b, 0, 0),
+                                 memory_space=pltpu.VMEM)
+
+    def squeeze(kernel):
+        """Kernels are written rank-2; drop each ref's leading block dim."""
+        def wrapped(*refs):
+            kernel(*[r.at[0] for r in refs])
+        return wrapped
+
+    def fwd_call(q, k, v):
+        qp, kp, vp = pad_qkv(q), pad_qkv(k), pad_qkv(v)
+        o, lse = pl.pallas_call(
+            squeeze(functools.partial(
+                _fwd_kernel, t, scale, causal, block_q, block_k)),
+            out_shape=(
+                jax.ShapeDtypeStruct((bh, tp, dp), jnp.float32),
+                jax.ShapeDtypeStruct((bh, tp, LANE), jnp.float32),
+            ),
+            grid=(bh, n_q),
+            in_specs=[qblk_spec, qkv_spec, qkv_spec],
+            out_specs=(qblk_spec, row_spec),
+            interpret=use_interpret(),
+        )(qp, kp, vp)
+        return o, lse, (qp, kp, vp)
+
+    @jax.custom_vjp
+    def attn(q, k, v):
+        o, _, _ = fwd_call(q, k, v)
+        return o[:, :t, :d].astype(in_dtype)
+
+    def vjp_fwd(q, k, v):
+        o, lse, (qp, kp, vp) = fwd_call(q, k, v)
+        return o[:, :t, :d].astype(in_dtype), (qp, kp, vp, o, lse)
+
+    def vjp_bwd(res, g):
+        qp, kp, vp, o, lse = res
+        dop = pad_axis(pad_axis(g.astype(jnp.float32), 1, tp), 2, dp)
+        # delta[i] = sum_d dO[i,d] * O[i,d], broadcast over the lane dim
+        delta = jnp.sum(dop * o, axis=2, keepdims=True)
+        delta = jnp.broadcast_to(delta, (bh, tp, LANE))
+        dq = pl.pallas_call(
+            squeeze(functools.partial(
+                _dq_kernel, t, scale, causal, block_q, block_k)),
+            out_shape=jax.ShapeDtypeStruct((bh, tp, dp), jnp.float32),
+            grid=(bh, n_q),
+            in_specs=[qblk_spec, qkv_spec, qkv_spec, qblk_spec,
+                      row_spec, row_spec],
+            out_specs=qblk_spec,
+            interpret=use_interpret(),
+        )(qp, kp, vp, dop, lse, delta)
+        dk, dv = pl.pallas_call(
+            squeeze(functools.partial(
+                _dkv_kernel, t, scale, causal, block_q, block_k)),
+            out_shape=(
+                jax.ShapeDtypeStruct((bh, tp, dp), jnp.float32),
+                jax.ShapeDtypeStruct((bh, tp, dp), jnp.float32),
+            ),
+            grid=(bh, n_k),
+            in_specs=[full_spec, kblk_spec, kblk_spec, full_spec,
+                      row_full_spec, row_full_spec],
+            out_specs=(kblk_spec, kblk_spec),
+            interpret=use_interpret(),
+        )(qp, kp, vp, dop, lse, delta)
+        trim = lambda x: x[:, :t, :d].astype(in_dtype)
+        return trim(dq), trim(dk), trim(dv)
+
+    attn.defvjp(vjp_fwd, vjp_bwd)
+    return attn
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = False) -> jax.Array:
+    """Blockwise-streamed attention, ``[B, T, H, D] -> [B, T, H, D]``.
+
+    Drop-in for
+    :func:`split_learning_tpu.ops.ring_attention.full_attention` with a
+    Pallas kernel forward/backward (compiled on TPU, interpreted
+    elsewhere).
+    """
+    b, t, h, d = q.shape
+    fn = _make_flash(b * h, t, d, causal, str(q.dtype))
+
+    def fold(x):  # [B, T, H, D] -> [B*H, T, D]
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
+
+    o = fn(fold(q), fold(k), fold(v))
+    return jnp.transpose(o.reshape(b, h, t, d), (0, 2, 1, 3))

@@ -125,4 +125,4 @@ def test_long_sequence_sharded_memory_shape(devices):
 
 def test_bad_attn_impl_raises():
     with pytest.raises(ValueError, match="Unknown attn impl"):
-        transformer_plan(attn="flash")
+        transformer_plan(attn="blocksparse")
