@@ -64,7 +64,11 @@ def _drop_axon_if_cpu() -> None:
               f"CPU-pinned process", file=sys.stderr)
 
 
-SEQ_LEN = 256  # transformer bench context length
+SEQ_LEN = 256  # transformer bench context length (SLT_BENCH_SEQ overrides)
+
+
+def _seq_len() -> int:
+    return int(os.environ.get("SLT_BENCH_SEQ", str(SEQ_LEN)))
 
 
 def _data(n_steps: int, model: str):
@@ -73,7 +77,7 @@ def _data(n_steps: int, model: str):
     if model == "resnet18":
         x = rs.randn(n_steps, BATCH, 32, 32, 3).astype(np.float32)
     elif model == "transformer":
-        x = rs.randint(0, 256, (n_steps, BATCH, SEQ_LEN)).astype(np.int32)
+        x = rs.randint(0, 256, (n_steps, BATCH, _seq_len())).astype(np.int32)
     else:
         x = rs.randn(n_steps, BATCH, 28, 28, 1).astype(np.float32)
     y = rs.randint(0, 10, (n_steps, BATCH)).astype(np.int64)
@@ -195,9 +199,15 @@ def measure_fused(quick: bool) -> dict:
 
     cfg = Config(mode=mode, batch_size=batch, dtype=dtype, kernels=kernels,
                  attn=attn)
-    if model == "transformer" and attn != "full":
+    if model == "transformer":
+        # TPU-shaped dimensions: head_dim = d_model/heads = 128 fills the
+        # 128-lane tile exactly — the factory default (64/4 -> D=16) pads
+        # every attention matmul's lane dim 8x on both the dense and
+        # flash paths, which benchmarks the padding, not the math
         from split_learning_tpu.models.transformer import transformer_plan
-        plan = transformer_plan(mode=mode, dtype=np.dtype(dtype), attn=attn)
+        tkw = dict(mode=mode, dtype=np.dtype(dtype), d_model=256,
+                   num_heads=2, max_len=max(2048, _seq_len()))
+        plan = transformer_plan(attn=attn, **tkw)
     else:
         plan = get_plan(model=model, mode=mode, dtype=dtype)
     trainer = FusedSplitTrainer(plan, cfg, jax.random.PRNGKey(0), x[0])
@@ -206,13 +216,22 @@ def measure_fused(quick: bool) -> dict:
 
     if model == "transformer" and attn != "full":
         # the flash kernels hide their matmuls inside pallas_call, which
-        # the jaxpr FLOPs counter cannot see; count the dense-attention
-        # step of identical shapes instead (same math, trace-only)
-        ref_trainer = FusedSplitTrainer(
-            get_plan(model=model, mode=mode, dtype=dtype), cfg,
-            jax.random.PRNGKey(0), x[0])
-        flops_step = ref_trainer.step_flops(x[0], y[0])
-        del ref_trainer
+        # the jaxpr FLOPs counter cannot see; count a dense-attention
+        # step of identical shapes instead. Trace-only on the existing
+        # params — building a second trainer would run plan.init
+        # *eagerly*, and the eager dense forward materializes the
+        # [B,H,T,T] scores (17 GB at T=16k: an instant OOM)
+        from split_learning_tpu.core.losses import cross_entropy as _ce
+        from split_learning_tpu.utils.flops import jaxpr_matmul_flops
+        dense_plan = transformer_plan(attn="full", **tkw)
+
+        def _dense_step(params, xb, yb):
+            return jax.value_and_grad(
+                lambda p, a, b: _ce(dense_plan.apply(p, a), b))(
+                params, xb, yb)
+
+        flops_step = jaxpr_matmul_flops(
+            _dense_step, trainer.state.params, xd[0], yd[0])
     else:
         flops_step = trainer.step_flops(x[0], y[0])
 
@@ -263,6 +282,7 @@ def measure_fused(quick: bool) -> dict:
         "kernels": kernels,
         "attn": attn,
         "batch": batch,
+        "seq_len": _seq_len() if model == "transformer" else None,
         "dtype": dtype,
         "steps_per_sec": steps_per_sec,
         "step_ms": t_med / step_count * 1e3,

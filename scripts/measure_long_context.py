@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Measure the long-context transformer on the attached TPU chip:
+dense vs Pallas-flash attention across context lengths, plus the
+memory-ceiling probe (the T where the dense path stops compiling).
+
+Writes artifacts/bench_tpu_transformer_<date>.json. Each leg is a
+`bench.py --role fused` subprocess (fresh PJRT client per measurement —
+the tunnel degrades across large programs in one process), so every
+number carries bench.py's own publication gate (util <= 1, work-scaling
+window) and its full leg record.
+
+Usage:
+    python scripts/measure_long_context.py [--quick] [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (seq_len, batch, attn, quick_leg) — batch drops as T grows so the
+# *linear* activations fit; the point is the attention term
+MATRIX = [
+    (256, 64, "full", False),
+    (256, 64, "flash", False),
+    (1024, 64, "full", False),
+    (1024, 64, "flash", False),
+    (4096, 16, "full", True),
+    (4096, 16, "flash", True),
+    (16384, 16, "full", True),   # expected: dense OOM (P = 16 GiB > HBM)
+    (16384, 16, "flash", True),
+]
+
+
+def run_leg(seq: int, batch: int, attn: str, quick: bool,
+            timeout: float) -> dict:
+    env = dict(os.environ)
+    env.update({"SLT_BENCH_MODEL": "transformer",
+                "SLT_BENCH_DTYPE": "bfloat16",
+                "SLT_BENCH_SEQ": str(seq),
+                "SLT_BENCH_BATCH": str(batch),
+                "SLT_BENCH_ATTN": attn})
+    cmd = [sys.executable, os.path.join(REPO, "bench.py"), "--role", "fused"]
+    if quick:
+        cmd.append("--quick")
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=timeout, env=env, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return {"seq_len": seq, "batch": batch, "attn": attn,
+                "status": "timeout", "timeout_s": timeout}
+    if out.returncode != 0:
+        err = out.stderr + out.stdout
+        oom = "Ran out of memory in memory space hbm" in err
+        rec = {"seq_len": seq, "batch": batch, "attn": attn,
+               "status": "oom" if oom else "error"}
+        if oom:
+            # keep the one line that states the ceiling
+            for line in err.splitlines():
+                if "Ran out of memory" in line:
+                    rec["detail"] = line.split("ERROR")[-1].strip()[:300]
+                    break
+        else:
+            rec["detail"] = err[-500:]
+        return rec
+    for line in out.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            leg = json.loads(line)
+            leg["status"] = "ok" if leg.get("valid") else "invalid"
+            return leg
+    return {"seq_len": seq, "batch": batch, "attn": attn,
+            "status": "no-output"}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="run every leg in --quick mode")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    date = datetime.date.today().isoformat()
+    out_path = args.out or os.path.join(
+        REPO, "artifacts", f"bench_tpu_transformer_{date}.json")
+    legs = []
+    for seq, batch, attn, quick_leg in MATRIX:
+        quick = args.quick or quick_leg
+        timeout = 1700 if seq >= 4096 else 900
+        print(f"[long-context] T={seq} b={batch} attn={attn} "
+              f"(quick={quick})...", file=sys.stderr, flush=True)
+        leg = run_leg(seq, batch, attn, quick, timeout)
+        print(f"[long-context]   -> {leg.get('status')} "
+              f"{leg.get('steps_per_sec', '')}", file=sys.stderr, flush=True)
+        legs.append(leg)
+
+    doc = {
+        "date": date,
+        "what": ("Long-context split transformer on one TPU chip: dense "
+                 "(XLA) vs Pallas-flash attention (ops/flash_attention.py), "
+                 "d_model 256, 2 heads (head_dim 128), bf16, "
+                 "bench.py fused role per leg (gated: util<=1 + "
+                 "work-scaling window)"),
+        "legs": legs,
+    }
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(out_path)
+
+
+if __name__ == "__main__":
+    main()

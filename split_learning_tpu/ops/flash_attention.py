@@ -1,31 +1,42 @@
 """Flash attention — Pallas forward/backward kernel set.
 
 The hot op of the transformer family (models/transformer.py). Dense
-softmax attention materializes the ``[T, T]`` score matrix in HBM and
-reads it back through the softmax and the ``P @ V`` matmul; this kernel
-streams K/V blocks through VMEM with the online-softmax recurrence, so
-HBM traffic per (batch, head) is O(T*D) instead of O(T^2) and the block
-matmuls stay on the MXU.
+softmax attention materializes the ``[T, T]`` score matrix — and XLA
+saves it for the backward pass, so on a 16 GB v5e chip the dense path
+cannot train past ``B*H*T^2*2B ~ HBM`` (measured: b16/h2/T=16384 fails
+to compile with "Used 16.00G of 15.75G hbm"). These kernels stream K/V
+blocks through VMEM with the online-softmax recurrence: nothing
+quadratic in T ever exists in HBM *or* VMEM, so max trainable context is
+set by the O(T*D) activations alone.
 
-- Forward saves only O and the per-row logsumexp (LSE) as residuals.
-- Backward is the standard two-kernel flash split: a dQ kernel gridded
-  over query blocks and a dK/dV kernel gridded over key blocks, each
-  recomputing P blockwise from (Q, K, LSE) — the FLOPs-for-HBM trade.
-- Causal masking uses global block coordinates, so block pairs entirely
-  in the future are masked (not skipped — grid shapes stay static).
+Design (the canonical TPU flash schedule):
+- 3-D sequential grid ``(batch*heads, outer block, inner block)`` with
+  the inner dimension iterating fastest; VMEM scratch accumulators
+  persist across the inner grid dimension and are initialized at
+  ``inner == 0`` / finalized at ``inner == n-1`` (``pl.when``).
+- Block inputs stream per grid step via BlockSpec index maps — Pallas
+  double-buffers the DMAs, so K/V never resides whole in VMEM.
+- Forward saves only O and the per-row logsumexp (LSE).
+- Backward is the two-kernel flash split: dQ grids over (query, key)
+  blocks, dK/dV over (key, query) blocks, each recomputing P blockwise
+  from (Q, K, LSE) — the FLOPs-for-HBM trade. This costs ~1.8x the
+  dense backward's matmul FLOPs, so at compute-bound shapes (large B,
+  modest T) the dense path is faster; flash's win is the memory
+  ceiling and the long-T regime (see BASELINE.md long-context rows).
+- Causal masking uses global block coordinates (static grid, masked
+  blocks computed-and-discarded rather than skipped).
 
 Like every op in this package there is a pure-jnp reference
 (:func:`split_learning_tpu.ops.ring_attention.full_attention`) and the
 kernels run under the Mosaic interpreter off-TPU
-(tests/test_flash_attention.py asserts fwd+grad equivalence). Head dim
-pads to the 128-lane tile and T to the block size, with masks keeping
-the math exact for ragged shapes.
+(tests/test_flash_attention.py asserts fwd+grad equivalence; also
+validated compiled on a real v5e chip). Head dim pads to the 128-lane
+tile and T to the block size, with masks keeping ragged shapes exact.
 
 Composition note: flash is the *single-device* attention math; the ring
 form (ops/ring_attention.py) shards T across chips and could use these
-kernels for its per-block compute — today its block math is plain jnp
-(XLA fuses it well at ring block sizes), so ``attn="flash"`` and
-``attn="ring"`` are separate choices.
+kernels for its per-block compute — today its block math is plain jnp,
+so ``attn="flash"`` and ``attn="ring"`` are separate choices.
 """
 
 from __future__ import annotations
@@ -40,8 +51,8 @@ from jax.experimental.pallas import tpu as pltpu
 from split_learning_tpu.ops.common import LANE, pad_axis, round_up, use_interpret
 
 _NEG_BIG = -1e30
-_BLOCK_Q = 128
-_BLOCK_K = 128
+_BLOCK = 128   # both block axes; tp = round_up(t, _BLOCK) divides evenly
+_ROWW = 8      # lane width of the LSE/delta row vectors (tile-masked)
 
 
 def _causal_mask(q0, k0, bq, bk):
@@ -51,141 +62,126 @@ def _causal_mask(q0, k0, bq, bk):
     return rows >= cols
 
 
-def _fwd_kernel(t: int, scale: float, causal: bool, block_q: int,
-                block_k: int, q_ref, k_ref, v_ref, o_ref, lse_ref):
-    """One query block vs all key blocks: online softmax accumulation.
-
-    q_ref [block_q, Dp]; k_ref/v_ref [Tp, Dp]; o_ref [block_q, Dp];
-    lse_ref [block_q, LANE] (LSE broadcast over the lane dim).
-    """
-    q0 = pl.program_id(1) * block_q
-    qb = q_ref[:].astype(jnp.float32)
-    bq, dp = qb.shape
-    tp = k_ref.shape[0]
-
-    acc = jnp.zeros((bq, dp), jnp.float32)
-    l = jnp.zeros((bq,), jnp.float32)
-    m = jnp.full((bq,), _NEG_BIG, jnp.float32)
-
-    def body(kb, carry):
-        acc, l, m = carry
-        k0 = kb * block_k
-        kblk = k_ref[pl.ds(k0, block_k), :].astype(jnp.float32)
-        vblk = v_ref[pl.ds(k0, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            qb, kblk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale   # [bq, bk]
-        # T padding cols are invalid; causal adds the future mask
-        cols = k0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        ok = cols < t
-        if causal:
-            ok &= _causal_mask(q0, k0, bq, block_k)
-        s = jnp.where(ok, s, _NEG_BIG)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1))
-        p = jnp.exp(s - m_new[:, None])
-        p = jnp.where(ok, p, 0.0)                         # exp(0)=1 guard
-        corr = jnp.exp(m - m_new)
-        l = l * corr + jnp.sum(p, axis=1)
-        acc = acc * corr[:, None] + jax.lax.dot_general(
-            p, vblk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return acc, l, m_new
-
-    acc, l, m = jax.lax.fori_loop(0, tp // block_k, body, (acc, l, m))
-    # padded query rows never see a valid key: l == 0 there; guard the div
-    l_safe = jnp.where(l > 0.0, l, 1.0)
-    o_ref[:] = acc / l_safe[:, None]
-    lse = jnp.where(l > 0.0, m + jnp.log(l_safe), _NEG_BIG)
-    lse_ref[:] = jnp.broadcast_to(lse[:, None], (bq, LANE))
+def _scores(qb, kb, t, k0, q0, scale, causal):
+    """Masked scaled scores for one (q block, k block) pair."""
+    s = jax.lax.dot_general(
+        qb, kb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    cols = k0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    ok = cols < t
+    if causal:
+        ok &= _causal_mask(q0, k0, s.shape[0], s.shape[1])
+    return jnp.where(ok, s, _NEG_BIG), ok
 
 
-def _dq_kernel(t: int, scale: float, causal: bool, block_q: int,
-               block_k: int, q_ref, k_ref, v_ref, do_ref, lse_ref,
-               delta_ref, dq_ref):
-    """dQ for one query block: dQ = scale * sum_k dS_k @ K_k,
+def _fwd_kernel(t: int, scale: float, causal: bool, n_k: int,
+                q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref):
+    """Grid (bh, q block, k block), k fastest. Scratch accumulators carry
+    the online softmax across the k dimension."""
+    kb_i = pl.program_id(2)
+    q0 = pl.program_id(1) * _BLOCK
+    k0 = kb_i * _BLOCK
+
+    @pl.when(kb_i == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_BIG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    qb = q_ref[0].astype(jnp.float32)
+    s, ok = _scores(qb, k_ref[0].astype(jnp.float32), t, k0, q0,
+                    scale, causal)
+    m = m_ref[:, 0]
+    m_new = jnp.maximum(m, jnp.max(s, axis=1))
+    # rebase then re-mask: exp(_NEG_BIG - _NEG_BIG) would be 1
+    p = jnp.where(ok, jnp.exp(s - m_new[:, None]), 0.0)
+    corr = jnp.exp(m - m_new)
+    l_ref[:] = l_ref[:] * corr[:, None] + jnp.broadcast_to(
+        jnp.sum(p, axis=1)[:, None], l_ref.shape)
+    acc_ref[:] = acc_ref[:] * corr[:, None] + jax.lax.dot_general(
+        p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+
+    @pl.when(kb_i == n_k - 1)
+    def _finish():
+        l = l_ref[:, 0]
+        # padded query rows never meet a valid key: l == 0 there
+        l_safe = jnp.where(l > 0.0, l, 1.0)
+        o_ref[0] = acc_ref[:] / l_safe[:, None]
+        lse = jnp.where(l > 0.0, m_ref[:, 0] + jnp.log(l_safe), _NEG_BIG)
+        lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
+
+
+def _dq_kernel(t: int, scale: float, causal: bool, n_k: int,
+               q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, acc_ref):
+    """Grid (bh, q block, k block): dQ = scale * sum_k dS_k @ K_k,
     dS = P * (dO @ V^T - delta)."""
-    q0 = pl.program_id(1) * block_q
-    qb = q_ref[:].astype(jnp.float32)
-    dob = do_ref[:].astype(jnp.float32)
-    lse = lse_ref[:][:, 0]                                # [bq]
-    delta = delta_ref[:][:, 0]                            # [bq]
-    bq, dp = qb.shape
-    tp = k_ref.shape[0]
+    kb_i = pl.program_id(2)
+    q0 = pl.program_id(1) * _BLOCK
+    k0 = kb_i * _BLOCK
 
-    def body(kb, dq):
-        k0 = kb * block_k
-        kblk = k_ref[pl.ds(k0, block_k), :].astype(jnp.float32)
-        vblk = v_ref[pl.ds(k0, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            qb, kblk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        cols = k0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        ok = cols < t
-        if causal:
-            ok &= _causal_mask(q0, k0, bq, block_k)
-        p = jnp.exp(jnp.where(ok, s, _NEG_BIG) - lse[:, None])
-        p = jnp.where(ok, p, 0.0)
-        dp = jax.lax.dot_general(
-            dob, vblk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)           # [bq, bk]
-        ds = p * (dp - delta[:, None])
-        return dq + jax.lax.dot_general(
-            ds, kblk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+    @pl.when(kb_i == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    dq = jax.lax.fori_loop(0, tp // block_k,
-                           body, jnp.zeros((bq, dp), jnp.float32))
-    dq_ref[:] = dq * scale
+    qb = q_ref[0].astype(jnp.float32)
+    kb = k_ref[0].astype(jnp.float32)
+    s, ok = _scores(qb, kb, t, k0, q0, scale, causal)
+    p = jnp.where(ok, jnp.exp(s - lse_ref[0][:, :1]), 0.0)
+    dp = jax.lax.dot_general(
+        do_ref[0].astype(jnp.float32), v_ref[0].astype(jnp.float32),
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_ref[0][:, :1])
+    acc_ref[:] += jax.lax.dot_general(
+        ds, kb, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(kb_i == n_k - 1)
+    def _finish():
+        dq_ref[0] = acc_ref[:] * scale
 
 
-def _dkv_kernel(t: int, scale: float, causal: bool, block_q: int,
-                block_k: int, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                delta_ref, dk_ref, dv_ref):
-    """dK/dV for one key block: dV = sum_q P^T @ dO,
-    dK = scale * sum_q dS^T @ Q. q_ref/do_ref/lse_ref/delta_ref span the
-    full (padded) T; k_ref/v_ref are this key block."""
-    k0 = pl.program_id(1) * block_k
-    kblk = k_ref[:].astype(jnp.float32)                   # [bk, Dp]
-    vblk = v_ref[:].astype(jnp.float32)
-    bk, dp = kblk.shape
-    tp = q_ref.shape[0]
+def _dkv_kernel(t: int, scale: float, causal: bool, n_q: int,
+                k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc):
+    """Grid (bh, k block, q block): dV = sum_q P^T @ dO,
+    dK = scale * sum_q dS^T @ Q."""
+    qb_i = pl.program_id(2)
+    k0 = pl.program_id(1) * _BLOCK
+    q0 = qb_i * _BLOCK
 
-    def body(qi, carry):
-        dk, dv = carry
-        q0 = qi * block_q
-        qb = q_ref[pl.ds(q0, block_q), :].astype(jnp.float32)
-        dob = do_ref[pl.ds(q0, block_q), :].astype(jnp.float32)
-        lse = lse_ref[pl.ds(q0, block_q), :][:, 0]
-        delta = delta_ref[pl.ds(q0, block_q), :][:, 0]
-        s = jax.lax.dot_general(
-            qb, kblk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale   # [bq, bk]
-        cols = k0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        # padded q rows carry lse=_NEG_BIG -> exp(s - (-1e30)) overflows;
-        # mask rows as well as cols
-        rows = q0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        ok = (cols < t) & (rows < t)
-        if causal:
-            ok &= _causal_mask(q0, k0, block_q, bk)
-        p = jnp.exp(jnp.where(ok, s - lse[:, None], _NEG_BIG))
-        p = jnp.where(ok, p, 0.0)
-        dv = dv + jax.lax.dot_general(
-            p, dob, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)           # [bk, Dp]
-        dpp = jax.lax.dot_general(
-            dob, vblk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)           # [bq, bk]
-        ds = p * (dpp - delta[:, None])
-        dk = dk + jax.lax.dot_general(
-            ds, qb, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)           # [bk, Dp]
-        return dk, dv
+    @pl.when(qb_i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    dk, dv = jax.lax.fori_loop(
-        0, tp // block_q, body,
-        (jnp.zeros((bk, dp), jnp.float32), jnp.zeros((bk, dp), jnp.float32)))
-    dk_ref[:] = dk * scale
-    dv_ref[:] = dv
+    qb = q_ref[0].astype(jnp.float32)
+    kb = k_ref[0].astype(jnp.float32)
+    dob = do_ref[0].astype(jnp.float32)
+    s, ok = _scores(qb, kb, t, k0, q0, scale, causal)
+    # padded q rows carry lse = _NEG_BIG; their p must be 0, and the ok
+    # mask only covers cols — mask rows via the recomputed scores' rows
+    rows = q0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    ok &= rows < t
+    p = jnp.where(ok, jnp.exp(s - lse_ref[0][:, :1]), 0.0)
+    dv_acc[:] += jax.lax.dot_general(
+        p, dob, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(
+        dob, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_ref[0][:, :1])
+    dk_acc[:] += jax.lax.dot_general(
+        ds, qb, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(qb_i == n_q - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:] * scale
+        dv_ref[0] = dv_acc[:]
 
 
 # --------------------------------------------------------------------- #
@@ -194,48 +190,39 @@ def _make_flash(bh: int, t: int, d: int, causal: bool, dtype_name: str):
     """Custom-VJP flash attention for one static ([BH, T, D], causal)."""
     in_dtype = jnp.dtype(dtype_name)
     scale = d ** -0.5
-    # one block size for both axes: tp is then a common multiple, so the
-    # q-grid and the k-loop cover exactly the same padded range
-    block_q = block_k = _BLOCK_Q
-    tp = round_up(t, block_q)
+    tp = round_up(t, _BLOCK)
     dp = round_up(d, LANE)
-    n_q = tp // block_q
-    n_k = tp // block_k
+    n_blk = tp // _BLOCK
+    grid = (bh, n_blk, n_blk)
 
     def pad_qkv(x):
         return pad_axis(pad_axis(x, 1, tp), 2, dp)
 
-    qkv_spec = pl.BlockSpec((1, tp, dp), lambda b, i: (b, 0, 0),
-                            memory_space=pltpu.VMEM)
-    qblk_spec = pl.BlockSpec((1, block_q, dp), lambda b, i: (b, i, 0),
-                             memory_space=pltpu.VMEM)
-    row_spec = pl.BlockSpec((1, block_q, LANE), lambda b, i: (b, i, 0),
-                            memory_space=pltpu.VMEM)
-    kblk_spec = pl.BlockSpec((1, block_k, dp), lambda b, i: (b, i, 0),
-                             memory_space=pltpu.VMEM)
-    full_spec = pl.BlockSpec((1, tp, dp), lambda b, i: (b, 0, 0),
-                             memory_space=pltpu.VMEM)
-    row_full_spec = pl.BlockSpec((1, tp, LANE), lambda b, i: (b, 0, 0),
-                                 memory_space=pltpu.VMEM)
+    def outer(b, i, k):   # block of the outer (grid dim 1) axis
+        return (b, i, 0)
 
-    def squeeze(kernel):
-        """Kernels are written rank-2; drop each ref's leading block dim."""
-        def wrapped(*refs):
-            kernel(*[r.at[0] for r in refs])
-        return wrapped
+    def inner(b, i, k):   # block of the inner (grid dim 2) axis
+        return (b, k, 0)
+
+    blk = lambda idx: pl.BlockSpec((1, _BLOCK, dp), idx,
+                                   memory_space=pltpu.VMEM)
+    row = lambda idx: pl.BlockSpec((1, _BLOCK, _ROWW), idx,
+                                   memory_space=pltpu.VMEM)
+    acc_scratch = pltpu.VMEM((_BLOCK, dp), jnp.float32)
+    row_scratch = pltpu.VMEM((_BLOCK, _ROWW), jnp.float32)
 
     def fwd_call(q, k, v):
         qp, kp, vp = pad_qkv(q), pad_qkv(k), pad_qkv(v)
         o, lse = pl.pallas_call(
-            squeeze(functools.partial(
-                _fwd_kernel, t, scale, causal, block_q, block_k)),
+            functools.partial(_fwd_kernel, t, scale, causal, n_blk),
             out_shape=(
                 jax.ShapeDtypeStruct((bh, tp, dp), jnp.float32),
-                jax.ShapeDtypeStruct((bh, tp, LANE), jnp.float32),
+                jax.ShapeDtypeStruct((bh, tp, _ROWW), jnp.float32),
             ),
-            grid=(bh, n_q),
-            in_specs=[qblk_spec, qkv_spec, qkv_spec],
-            out_specs=(qblk_spec, row_spec),
+            grid=grid,
+            in_specs=[blk(outer), blk(inner), blk(inner)],
+            out_specs=(blk(outer), row(outer)),
+            scratch_shapes=[acc_scratch, row_scratch, row_scratch],
             interpret=use_interpret(),
         )(qp, kp, vp)
         return o, lse, (qp, kp, vp)
@@ -252,32 +239,32 @@ def _make_flash(bh: int, t: int, d: int, causal: bool, dtype_name: str):
     def vjp_bwd(res, g):
         qp, kp, vp, o, lse = res
         dop = pad_axis(pad_axis(g.astype(jnp.float32), 1, tp), 2, dp)
-        # delta[i] = sum_d dO[i,d] * O[i,d], broadcast over the lane dim
+        # delta[i] = sum_d dO[i,d] * O[i,d]
         delta = jnp.sum(dop * o, axis=2, keepdims=True)
-        delta = jnp.broadcast_to(delta, (bh, tp, LANE))
+        delta = jnp.broadcast_to(delta, (bh, tp, _ROWW))
         dq = pl.pallas_call(
-            squeeze(functools.partial(
-                _dq_kernel, t, scale, causal, block_q, block_k)),
+            functools.partial(_dq_kernel, t, scale, causal, n_blk),
             out_shape=jax.ShapeDtypeStruct((bh, tp, dp), jnp.float32),
-            grid=(bh, n_q),
-            in_specs=[qblk_spec, qkv_spec, qkv_spec, qblk_spec,
-                      row_spec, row_spec],
-            out_specs=qblk_spec,
+            grid=grid,
+            in_specs=[blk(outer), blk(inner), blk(inner), blk(outer),
+                      row(outer), row(outer)],
+            out_specs=blk(outer),
+            scratch_shapes=[acc_scratch],
             interpret=use_interpret(),
         )(qp, kp, vp, dop, lse, delta)
         dk, dv = pl.pallas_call(
-            squeeze(functools.partial(
-                _dkv_kernel, t, scale, causal, block_q, block_k)),
+            functools.partial(_dkv_kernel, t, scale, causal, n_blk),
             out_shape=(
                 jax.ShapeDtypeStruct((bh, tp, dp), jnp.float32),
                 jax.ShapeDtypeStruct((bh, tp, dp), jnp.float32),
             ),
-            grid=(bh, n_k),
-            in_specs=[full_spec, kblk_spec, kblk_spec, full_spec,
-                      row_full_spec, row_full_spec],
-            out_specs=(kblk_spec, kblk_spec),
+            grid=grid,
+            in_specs=[blk(outer), blk(outer), blk(inner), blk(inner),
+                      row(inner), row(inner)],
+            out_specs=(blk(outer), blk(outer)),
+            scratch_shapes=[acc_scratch, acc_scratch],
             interpret=use_interpret(),
-        )(qp, kp, vp, dop, lse, delta)
+        )(kp, vp, qp, dop, lse, delta)
         trim = lambda x: x[:, :t, :d].astype(in_dtype)
         return trim(dq), trim(dk), trim(dv)
 

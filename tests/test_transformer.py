@@ -126,3 +126,48 @@ def test_long_sequence_sharded_memory_shape(devices):
 def test_bad_attn_impl_raises():
     with pytest.raises(ValueError, match="Unknown attn impl"):
         transformer_plan(attn="blocksparse")
+
+
+def test_u_split_transformer_gpipe_pipeline(devices):
+    """The GPipe ppermute pipeline carries the transformer plan: integer
+    tokens ride the float cut buffer and are restored for nn.Embed. A
+    (2 data x 3 pipe) mesh step matches the fused u_split step."""
+    from split_learning_tpu.parallel.pipeline import PipelinedTrainer
+    from split_learning_tpu.parallel.mesh import make_mesh
+
+    steps = 2
+    xs, ys = tokens(steps=steps, batch=8, t=16, seed=3)
+    cfg = Config(mode="u_split", model="transformer", batch_size=8,
+                 microbatches=2)
+    plan = transformer_plan(mode="u_split")
+    mesh = make_mesh(num_clients=2, num_stages=3, devices=devices)
+    piped = PipelinedTrainer(plan, cfg, jax.random.PRNGKey(0), xs[0], mesh)
+    fused = FusedSplitTrainer(plan, cfg, jax.random.PRNGKey(0), xs[0])
+    for i in range(steps):
+        lp = piped.train_step(xs[i], ys[i])
+        lf = fused.train_step(xs[i], ys[i])
+        np.testing.assert_allclose(lp, lf, atol=5e-5, rtol=5e-5)
+
+
+def test_bf16_pipeline_preserves_large_token_ids(devices):
+    """bf16 cut buffers represent integers exactly only up to 256; the
+    pipeline must promote the buffer so vocab > 256 token ids survive the
+    encode/decode round trip (id 257 must not become 256)."""
+    from split_learning_tpu.parallel.pipeline import PipelinedTrainer
+    from split_learning_tpu.parallel.mesh import make_mesh
+
+    vocab = 1000
+    rs = np.random.RandomState(0)
+    # force ids in the bf16-inexact range
+    x = rs.randint(257, vocab, (8, 16)).astype(np.int32)
+    y = rs.randint(0, 10, (8,)).astype(np.int32)
+    cfg = Config(mode="u_split", model="transformer", batch_size=8,
+                 microbatches=2, dtype="bfloat16")
+    plan = transformer_plan(mode="u_split", dtype=jnp.bfloat16, vocab=vocab)
+    mesh = make_mesh(num_clients=2, num_stages=3, devices=devices)
+    piped = PipelinedTrainer(plan, cfg, jax.random.PRNGKey(0), x, mesh)
+    assert piped.buf_dtype == jnp.float32  # promoted from bf16
+    fused = FusedSplitTrainer(plan, cfg, jax.random.PRNGKey(0), x)
+    lp = piped.train_step(x, y)
+    lf = fused.train_step(x, y)
+    np.testing.assert_allclose(lp, lf, atol=5e-3, rtol=5e-3)
