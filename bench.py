@@ -488,7 +488,12 @@ def measure_pipelined(quick: bool) -> dict:
 
 
 def _run_subprocess(role: str, quick: bool, env_overrides: dict,
-                    timeout: float) -> dict | None:
+                    timeout: float, capture: bool = False):
+    """Run one measurement role in a fresh process and parse its JSON
+    line. Default: dict | None (errors printed). With ``capture=True``:
+    ``(record | None, CompletedProcess | "timeout")`` so callers (e.g.
+    scripts/measure_long_context.py) can classify failures themselves —
+    the one place the subprocess-and-parse protocol lives."""
     env = dict(os.environ)
     env.update(env_overrides)
     cmd = [sys.executable, os.path.abspath(__file__), "--role", role]
@@ -499,17 +504,24 @@ def _run_subprocess(role: str, quick: bool, env_overrides: dict,
                              timeout=timeout, env=env,
                              cwd=os.path.dirname(os.path.abspath(__file__)))
     except subprocess.TimeoutExpired:
+        if capture:
+            return None, "timeout"
         print(f"[bench] {role} timed out", file=sys.stderr)
         return None
-    if out.returncode != 0:
+    if not capture and out.returncode != 0:
         print(f"[bench] {role} failed:\n{out.stderr[-2000:]}", file=sys.stderr)
         return None
+    rec = None
     for line in out.stdout.splitlines():
         line = line.strip()
         if line.startswith("{"):
-            return json.loads(line)
-    print(f"[bench] {role}: no JSON in output", file=sys.stderr)
-    return None
+            rec = json.loads(line)
+            break
+    if capture:
+        return rec, out
+    if rec is None:
+        print(f"[bench] {role}: no JSON in output", file=sys.stderr)
+    return rec
 
 
 def _probe_device(budget_s: float) -> bool:

@@ -19,10 +19,15 @@ import argparse
 import datetime
 import json
 import os
-import subprocess
+import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import _run_subprocess  # noqa: E402 — the one subprocess protocol
+
+_ANSI = re.compile(r"\x1b\[[0-9;]*m")
 
 # (seq_len, batch, attn, quick_leg) — batch drops as T grows so the
 # *linear* activations fit; the point is the attention term
@@ -40,43 +45,29 @@ MATRIX = [
 
 def run_leg(seq: int, batch: int, attn: str, quick: bool,
             timeout: float) -> dict:
-    env = dict(os.environ)
-    env.update({"SLT_BENCH_MODEL": "transformer",
-                "SLT_BENCH_DTYPE": "bfloat16",
-                "SLT_BENCH_SEQ": str(seq),
-                "SLT_BENCH_BATCH": str(batch),
-                "SLT_BENCH_ATTN": attn})
-    cmd = [sys.executable, os.path.join(REPO, "bench.py"), "--role", "fused"]
-    if quick:
-        cmd.append("--quick")
-    try:
-        out = subprocess.run(cmd, capture_output=True, text=True,
-                             timeout=timeout, env=env, cwd=REPO)
-    except subprocess.TimeoutExpired:
+    env = {"SLT_BENCH_MODEL": "transformer",
+           "SLT_BENCH_DTYPE": "bfloat16",
+           "SLT_BENCH_SEQ": str(seq),
+           "SLT_BENCH_BATCH": str(batch),
+           "SLT_BENCH_ATTN": attn}
+    leg, out = _run_subprocess("fused", quick, env, timeout, capture=True)
+    if out == "timeout":
         return {"seq_len": seq, "batch": batch, "attn": attn,
                 "status": "timeout", "timeout_s": timeout}
-    if out.returncode != 0:
-        err = out.stderr + out.stdout
-        oom = "Ran out of memory in memory space hbm" in err
-        rec = {"seq_len": seq, "batch": batch, "attn": attn,
-               "status": "oom" if oom else "error"}
-        if oom:
-            # keep the one line that states the ceiling
-            for line in err.splitlines():
-                if "Ran out of memory" in line:
-                    rec["detail"] = line.split("ERROR")[-1].strip()[:300]
-                    break
-        else:
-            rec["detail"] = err[-500:]
-        return rec
-    for line in out.stdout.splitlines():
-        line = line.strip()
-        if line.startswith("{"):
-            leg = json.loads(line)
-            leg["status"] = "ok" if leg.get("valid") else "invalid"
-            return leg
-    return {"seq_len": seq, "batch": batch, "attn": attn,
-            "status": "no-output"}
+    if leg is not None and out.returncode == 0:
+        leg["status"] = "ok" if leg.get("valid") else "invalid"
+        return leg
+    err = _ANSI.sub("", out.stderr + out.stdout)
+    marker = "Ran out of memory in memory space hbm"
+    rec = {"seq_len": seq, "batch": batch, "attn": attn,
+           "status": "oom" if marker in err else "error"}
+    if marker in err:
+        # keep just the sentence that states the ceiling
+        start = err.index(marker)
+        rec["detail"] = err[start:start + 200].splitlines()[0]
+    else:
+        rec["detail"] = err[-500:]
+    return rec
 
 
 def main() -> None:

@@ -63,7 +63,9 @@ def _causal_mask(q0, k0, bq, bk):
 
 
 def _scores(qb, kb, t, k0, q0, scale, causal):
-    """Masked scaled scores for one (q block, k block) pair."""
+    """Masked scaled scores for one (q block, k block) pair. Operands
+    stay in their storage dtype (bf16 runs the MXU at full rate) and
+    accumulate in f32."""
     s = jax.lax.dot_general(
         qb, kb, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
@@ -89,9 +91,9 @@ def _fwd_kernel(t: int, scale: float, causal: bool, n_k: int,
         m_ref[:] = jnp.full_like(m_ref, _NEG_BIG)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    qb = q_ref[0].astype(jnp.float32)
-    s, ok = _scores(qb, k_ref[0].astype(jnp.float32), t, k0, q0,
-                    scale, causal)
+    qb = q_ref[0]
+    vb = v_ref[0]
+    s, ok = _scores(qb, k_ref[0], t, k0, q0, scale, causal)
     m = m_ref[:, 0]
     m_new = jnp.maximum(m, jnp.max(s, axis=1))
     # rebase then re-mask: exp(_NEG_BIG - _NEG_BIG) would be 1
@@ -100,7 +102,7 @@ def _fwd_kernel(t: int, scale: float, causal: bool, n_k: int,
     l_ref[:] = l_ref[:] * corr[:, None] + jnp.broadcast_to(
         jnp.sum(p, axis=1)[:, None], l_ref.shape)
     acc_ref[:] = acc_ref[:] * corr[:, None] + jax.lax.dot_general(
-        p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+        p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
     m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
 
@@ -127,16 +129,16 @@ def _dq_kernel(t: int, scale: float, causal: bool, n_k: int,
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    qb = q_ref[0].astype(jnp.float32)
-    kb = k_ref[0].astype(jnp.float32)
+    qb = q_ref[0]
+    kb = k_ref[0]
     s, ok = _scores(qb, kb, t, k0, q0, scale, causal)
     p = jnp.where(ok, jnp.exp(s - lse_ref[0][:, :1]), 0.0)
     dp = jax.lax.dot_general(
-        do_ref[0].astype(jnp.float32), v_ref[0].astype(jnp.float32),
+        do_ref[0], v_ref[0],
         (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
     ds = p * (dp - delta_ref[0][:, :1])
     acc_ref[:] += jax.lax.dot_general(
-        ds, kb, (((1,), (0,)), ((), ())),
+        ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
 
     @pl.when(kb_i == n_k - 1)
@@ -158,9 +160,9 @@ def _dkv_kernel(t: int, scale: float, causal: bool, n_q: int,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    qb = q_ref[0].astype(jnp.float32)
-    kb = k_ref[0].astype(jnp.float32)
-    dob = do_ref[0].astype(jnp.float32)
+    qb = q_ref[0]
+    kb = k_ref[0]
+    dob = do_ref[0]
     s, ok = _scores(qb, kb, t, k0, q0, scale, causal)
     # padded q rows carry lse = _NEG_BIG; their p must be 0, and the ok
     # mask only covers cols — mask rows via the recomputed scores' rows
@@ -168,14 +170,14 @@ def _dkv_kernel(t: int, scale: float, causal: bool, n_q: int,
     ok &= rows < t
     p = jnp.where(ok, jnp.exp(s - lse_ref[0][:, :1]), 0.0)
     dv_acc[:] += jax.lax.dot_general(
-        p, dob, (((0,), (0,)), ((), ())),
+        p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
     dp = jax.lax.dot_general(
-        dob, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+        dob, v_ref[0], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
     ds = p * (dp - delta_ref[0][:, :1])
     dk_acc[:] += jax.lax.dot_general(
-        ds, qb, (((0,), (0,)), ((), ())),
+        ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
 
     @pl.when(qb_i == n_q - 1)
@@ -238,9 +240,10 @@ def _make_flash(bh: int, t: int, d: int, causal: bool, dtype_name: str):
 
     def vjp_bwd(res, g):
         qp, kp, vp, o, lse = res
-        dop = pad_axis(pad_axis(g.astype(jnp.float32), 1, tp), 2, dp)
-        # delta[i] = sum_d dO[i,d] * O[i,d]
-        delta = jnp.sum(dop * o, axis=2, keepdims=True)
+        # dO stays in the storage dtype so the backward matmuls run the
+        # MXU at native rate; delta accumulates in f32
+        dop = pad_axis(pad_axis(g.astype(in_dtype), 1, tp), 2, dp)
+        delta = jnp.sum(dop.astype(jnp.float32) * o, axis=2, keepdims=True)
         delta = jnp.broadcast_to(delta, (bh, tp, _ROWW))
         dq = pl.pallas_call(
             functools.partial(_dq_kernel, t, scale, causal, n_blk),

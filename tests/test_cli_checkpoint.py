@@ -145,3 +145,21 @@ def test_resume_rearms_server_handshake(tmp_path, capsys):
                   "--resume") == 0
     out = capsys.readouterr().out
     assert out.count("[done]") >= 1
+
+
+def test_checkpoint_resume_eval_transformer(tmp_path, capsys):
+    """The long-context family checkpoints/resumes/evals through the same
+    machinery (token dataset, fused transport)."""
+    ck = tmp_path / "ck_tfm"
+    base = ["--mode", "split", "--transport", "fused",
+            "--model", "transformer", "--dataset", "tokens"]
+    assert _train(tmp_path, ck, *base) == 0
+    assert _train(tmp_path, ck, *base, "--resume") == 0
+    assert "resumed at step 4" in capsys.readouterr().err
+    assert main(["eval", "--checkpoint-dir", str(ck),
+                 "--data-dir", str(tmp_path), "--batch-size", "64"]) == 0
+    out = capsys.readouterr().out
+    res = json.loads([l for l in out.splitlines()
+                      if l.startswith("{")][-1])
+    assert res["checkpoint_step"] == 8
+    assert 0.0 <= res["accuracy"] <= 1.0

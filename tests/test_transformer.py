@@ -150,9 +150,11 @@ def test_u_split_transformer_gpipe_pipeline(devices):
 
 
 def test_bf16_pipeline_preserves_large_token_ids(devices):
-    """bf16 cut buffers represent integers exactly only up to 256; the
-    pipeline must promote the buffer so vocab > 256 token ids survive the
-    encode/decode round trip (id 257 must not become 256)."""
+    """bf16 represents integers exactly only up to 256. Token ids ride
+    the raw injection stream (never the cut buffer), so vocab > 256 ids
+    must survive exactly (id 257 must not become 256) WHILE the cut
+    buffer stays bf16 — the ppermute hops keep the mixed-precision
+    bandwidth win."""
     from split_learning_tpu.parallel.pipeline import PipelinedTrainer
     from split_learning_tpu.parallel.mesh import make_mesh
 
@@ -166,7 +168,7 @@ def test_bf16_pipeline_preserves_large_token_ids(devices):
     plan = transformer_plan(mode="u_split", dtype=jnp.bfloat16, vocab=vocab)
     mesh = make_mesh(num_clients=2, num_stages=3, devices=devices)
     piped = PipelinedTrainer(plan, cfg, jax.random.PRNGKey(0), x, mesh)
-    assert piped.buf_dtype == jnp.float32  # promoted from bf16
+    assert piped.buf_dtype == jnp.bfloat16  # cut hops stay half-width
     fused = FusedSplitTrainer(plan, cfg, jax.random.PRNGKey(0), x)
     lp = piped.train_step(x, y)
     lf = fused.train_step(x, y)
