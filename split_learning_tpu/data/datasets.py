@@ -409,6 +409,35 @@ def synthetic_tokens(name: str = "tokens", n_train: int = 4096,
                    name=name, num_classes=num_classes, synthetic=True)
 
 
+def synthetic_lm(name: str = "lm", n_train: int = 4096, n_test: int = 512,
+                 vocab: int = 256, seq_len: int = 64,
+                 seed: int = 0) -> Dataset:
+    """First-order Markov chains for the causal LM
+    (models/transformer.py ``lm=True``): a fixed random transition
+    matrix generates sequences, ``y`` is ``x`` shifted by one — the
+    next-token structure is learnable, deterministic, zero-egress.
+    ``x`` is ``[N, T] int32``, ``y`` is ``[N, T] int64``."""
+    rs = np.random.RandomState(seed)
+    # sharply peaked rows: the bigram structure dominates the unigram
+    # baseline, so plain SGD (the reference's optimizer) shows context
+    # learning within a test-sized budget
+    logits = 4.0 * rs.randn(vocab, vocab)
+    probs = np.exp(logits - logits.max(axis=1, keepdims=True))
+    probs /= probs.sum(axis=1, keepdims=True)
+    cdf = np.cumsum(probs, axis=1)
+
+    def make(n: int, rs: np.random.RandomState) -> Split:
+        chain = np.zeros((n, seq_len + 1), np.int64)
+        chain[:, 0] = rs.randint(0, vocab, n)
+        for t in range(1, seq_len + 1):
+            u = rs.rand(n, 1)
+            chain[:, t] = np.argmax(cdf[chain[:, t - 1]] > u, axis=1)
+        return Split(chain[:, :seq_len].astype(np.int32), chain[:, 1:])
+
+    return Dataset(train=make(n_train, rs), test=make(n_test, rs),
+                   name=name, num_classes=vocab, synthetic=True)
+
+
 def store_from_config(cfg) -> Optional[DatasetStore]:
     """The deployment seam: an S3Store when the reference's S3 env surface
     (S3_ENDPOINT_URL / AWS_* -> Config.s3_*) is configured — in-cluster
@@ -453,7 +482,7 @@ def load_dataset(name: str, data_dir: str,
             return load_cifar10_binary(data_dir)
         return None
 
-    if name not in ("mnist", "cifar10", "synthetic", "tokens"):
+    if name not in ("mnist", "cifar10", "synthetic", "tokens", "lm"):
         raise ValueError(f"Unknown dataset: {name!r}")
     ds = load_raw()
     if ds is None and download and name in _DOWNLOADS:
@@ -471,6 +500,8 @@ def load_dataset(name: str, data_dir: str,
         return _from_blob(name, store.fetch(synth_key))
     if name == "tokens":
         ds = synthetic_tokens()
+    elif name == "lm":
+        ds = synthetic_lm()
     else:
         ds = synthetic("mnist" if name == "synthetic" else name)
     store.put(synth_key, _to_blob(ds))

@@ -38,9 +38,9 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    default=None)
     p.add_argument("--model", default=None,
                    help="split_cnn | resnet18 | resnet18_4stage | "
-                        "transformer")
+                        "transformer | transformer_lm")
     p.add_argument("--dataset", default=None,
-                   help="mnist | cifar10 | synthetic | tokens")
+                   help="mnist | cifar10 | synthetic | tokens | lm")
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--epochs", type=int, default=None)
     p.add_argument("--lr", type=float, default=None)
@@ -218,7 +218,9 @@ def cmd_train(args) -> int:
         from split_learning_tpu.parallel.mesh import replicated
         if args.transport == "fused":
             from split_learning_tpu.runtime.fused import FusedSplitTrainer
-            if cfg.seq_parallel > 1 and cfg.model != "transformer":
+            transformer_family = cfg.model in ("transformer",
+                                               "transformer_lm")
+            if cfg.seq_parallel > 1 and not transformer_family:
                 # without this guard the trainer would shard an image dim
                 # over 'seq' (or fail on divisibility) — not context
                 # parallelism; only the sequence family has a seq axis
@@ -232,15 +234,16 @@ def cmd_train(args) -> int:
                 mesh = global_mesh(num_clients=cfg.num_clients, num_stages=1,
                                    model_parallel=cfg.model_parallel,
                                    seq_parallel=cfg.seq_parallel)
-            if cfg.model == "transformer" and (cfg.seq_parallel > 1
-                                               or cfg.attn != "full"):
+            if transformer_family and (cfg.seq_parallel > 1
+                                       or cfg.attn != "full"):
                 # the seq-parallel attention forms need the mesh at plan
                 # build time (the shard_map closes over it)
                 from split_learning_tpu.models.transformer import (
                     transformer_plan)
                 plan = transformer_plan(mode=cfg.mode,
                                         dtype=np.dtype(cfg.dtype),
-                                        mesh=mesh, attn=cfg.attn)
+                                        mesh=mesh, attn=cfg.attn,
+                                        lm=cfg.model == "transformer_lm")
             elif cfg.attn != "full":
                 print(f"[warn] --attn {cfg.attn!r} ignored: model "
                       f"{cfg.model!r} has no attention (transformer "
@@ -510,7 +513,7 @@ def cmd_train(args) -> int:
             logger.log_metric("test_accuracy", res["accuracy"], step=n_steps)
             logger.log_metric("test_loss", res["loss"], step=n_steps)
             print(f"[eval] accuracy={res['accuracy']:.4f} "
-                  f"loss={res['loss']:.4f} n={res['examples']}")
+                  f"loss={res['loss']:.4f} n={res['predictions']}")
 
     logger.close()
     print(f"[done] mode={cfg.mode} transport={args.transport} "
@@ -606,7 +609,8 @@ def cmd_eval(args) -> int:
     print(json.dumps({"checkpoint_step": step, "dataset": dataset,
                       "accuracy": round(res["accuracy"], 4),
                       "loss": round(res["loss"], 4),
-                      "examples": res["examples"]}))
+                      "examples": res["examples"],
+                      "predictions": res["predictions"]}))
     return 0
 
 

@@ -65,6 +65,14 @@ def _transformer(mode: str, dtype: Any) -> SplitPlan:
     return transformer_plan(mode=mode, dtype=dtype)
 
 
+@register_model("transformer_lm")
+def _transformer_lm(mode: str, dtype: Any) -> SplitPlan:
+    """Causal language model: causal attention + per-token next-token
+    head (train with --dataset lm, labels = inputs shifted by one)."""
+    from split_learning_tpu.models.transformer import transformer_plan
+    return transformer_plan(mode=mode, dtype=dtype, lm=True)
+
+
 def get_plan(model: str = "split_cnn", mode: str = "split",
              dtype: Any = jnp.float32) -> SplitPlan:
     """Build the SplitPlan for a model family under a learning mode."""

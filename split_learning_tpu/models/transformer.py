@@ -162,6 +162,21 @@ class HeadStage(nn.Module):
         return nn.Dense(self.num_classes, dtype=self.dtype, name="fc")(x)
 
 
+class LMHeadStage(nn.Module):
+    """Causal-LM head: LN -> per-token Dense(vocab), ``[B, T, E] ->
+    [B, T, vocab]``. Trains with the same ``cross_entropy`` as every
+    other plan — optax broadcasts over leading dims, so labels are the
+    next-token ids ``[B, T]`` (data/datasets.py ``synthetic_lm``)."""
+
+    vocab: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        return nn.Dense(self.vocab, dtype=self.dtype, name="lm_head")(x)
+
+
 class TrunkAndHead(nn.Module):
     """Server top stage for the 2-party split: trunk + head fused, so the
     plan stays 2-stage like the CNN's (client A / server B)."""
@@ -172,6 +187,7 @@ class TrunkAndHead(nn.Module):
     mesh: Any = None
     attn: str = "full"
     causal: bool = False
+    lm_vocab: int = 0   # > 0: causal-LM head over this vocab instead
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -179,6 +195,9 @@ class TrunkAndHead(nn.Module):
         x = TrunkStage(self.num_heads, self.depth, mesh=self.mesh,
                        attn=self.attn, causal=self.causal,
                        dtype=self.dtype, name="trunk")(x)
+        if self.lm_vocab:
+            return LMHeadStage(self.lm_vocab, dtype=self.dtype,
+                               name="head")(x)
         return HeadStage(self.num_classes, dtype=self.dtype, name="head")(x)
 
 
@@ -187,27 +206,33 @@ def transformer_plan(mode: str = "split", dtype: Any = jnp.float32, *,
                      num_heads: int = 4, client_depth: int = 1,
                      server_depth: int = 2, num_classes: int = 10,
                      max_len: int = 2048, mesh: Optional[Any] = None,
-                     attn: str = "full", causal: bool = False) -> SplitPlan:
+                     attn: str = "full", causal: bool = False,
+                     lm: bool = False) -> SplitPlan:
     """Build the split-transformer :class:`SplitPlan` for ``mode``.
 
     ``mesh``/``attn`` choose the attention math: pass a mesh with a
     ``seq`` axis and ``attn="ring"``/``"ulysses"`` for context
     parallelism; the default is dense attention anywhere.
+    ``lm=True`` builds the causal language model: causal attention in
+    every block and a per-token next-token head over ``vocab``.
     """
     if attn not in _ATTN_IMPLS:
         raise ValueError(
             f"Unknown attn impl: {attn!r} (expected {_ATTN_IMPLS})")
+    causal = causal or lm
     common = dict(mesh=mesh, attn=attn, causal=causal, dtype=dtype)
     embed = from_flax("embed", EmbedStage(
         vocab=vocab, d_model=d_model, num_heads=num_heads,
         depth=client_depth, max_len=max_len, **common))
     if mode == "u_split":
+        head = (LMHeadStage(vocab, dtype=dtype) if lm
+                else HeadStage(num_classes, dtype=dtype))
         return SplitPlan(
             stages=(
                 embed,
                 from_flax("trunk", TrunkStage(
                     num_heads=num_heads, depth=server_depth, **common)),
-                from_flax("head", HeadStage(num_classes, dtype=dtype)),
+                from_flax("head", head),
             ),
             owners=("client", "server", "client"),
         )
@@ -218,7 +243,8 @@ def transformer_plan(mode: str = "split", dtype: Any = jnp.float32, *,
             embed,
             from_flax("trunk_head", TrunkAndHead(
                 num_heads=num_heads, depth=server_depth,
-                num_classes=num_classes, **common)),
+                num_classes=num_classes, lm_vocab=vocab if lm else 0,
+                **common)),
         ),
         owners=("client", "server"),
     )

@@ -38,16 +38,22 @@ def evaluate(plan: SplitPlan, params: Sequence[Any], split: Split,
         return loss, correct
 
     total = 0
+    rows = 0
     correct_sum = 0
     loss_sum = 0.0
     # fixed order, keep the partial tail batch: every example counts once
     for x, y in batches(split, batch_size, shuffle=False):
         loss, correct = fwd(params, jnp.asarray(x), jnp.asarray(y))
-        n = len(y)
+        # one prediction per label element: B for classifiers, B*T for
+        # the causal LM's per-token labels — accuracy/loss weight by
+        # predictions; "examples" stays the row count
+        n = int(np.prod(np.shape(y)))
         total += n
+        rows += len(y)
         correct_sum += int(correct)
         loss_sum += float(loss) * n
     if total == 0:
-        return {"accuracy": float("nan"), "loss": float("nan"), "examples": 0}
+        return {"accuracy": float("nan"), "loss": float("nan"),
+                "examples": 0, "predictions": 0}
     return {"accuracy": correct_sum / total, "loss": loss_sum / total,
-            "examples": total}
+            "examples": rows, "predictions": total}
