@@ -605,8 +605,16 @@ def main() -> None:
     device_ok = _probe_device(probe_budget)
 
     detail = {"baseline": baseline}
-    fused = (_run_subprocess("fused", args.quick, {}, timeout=900)
+    fused = (_run_subprocess("fused", args.quick, {}, timeout=1500)
              if device_ok else None)
+    if fused is None and device_ok:
+        # the tunnel degrades and recovers in stretches (a leg that
+        # completed an hour ago can stall past its timeout); one fresh
+        # subprocess = one fresh PJRT client is the cheap second chance
+        # before abandoning the device headline for the round
+        print("[bench] fused on default backend failed; retrying once",
+              file=sys.stderr)
+        fused = _run_subprocess("fused", args.quick, {}, timeout=1500)
     if fused is None:
         if device_ok:
             print("[bench] fused on default backend failed; CPU fallback",
@@ -617,8 +625,25 @@ def main() -> None:
         # passed the gate — an invalid headline exits below, so spending
         # up to 2x900s on side legs first would be wasted work, and a
         # CPU-fallback headline must not be paired with device side legs
-        bf16 = _run_subprocess("fused", args.quick,
-                               {"SLT_BENCH_DTYPE": "bfloat16"}, timeout=900)
+        side_fails = {"n": 0}
+
+        def side_leg(env_overrides, timeout=900):
+            """Device side legs run after a good headline, but the
+            headline JSON prints only after ALL of them — on a degraded
+            tunnel every dead leg costs its full timeout, so after two
+            consecutive failures stop probing and ship the headline."""
+            if side_fails["n"] >= 2:
+                return None
+            rec = _run_subprocess("fused", args.quick, env_overrides,
+                                  timeout=timeout)
+            side_fails["n"] = 0 if rec is not None else side_fails["n"] + 1
+            if rec is None and side_fails["n"] == 2:
+                print("[bench] two consecutive side legs died; skipping "
+                      "the remaining device side legs (degraded tunnel?)",
+                      file=sys.stderr)
+            return rec
+
+        bf16 = side_leg({"SLT_BENCH_DTYPE": "bfloat16"})
         if bf16 is not None and bf16.get("valid"):
             fused["bf16_steps_per_sec"] = bf16["steps_per_sec"]
             fused["bf16_mfu_vs_bf16_peak"] = bf16.get("util_vs_bf16_peak")
@@ -627,10 +652,9 @@ def main() -> None:
                   file=sys.stderr)
         # ResNet-18/CIFAR-10 leg (BASELINE.md config 4): the model with
         # enough arithmetic intensity for MFU to mean something
-        resnet = _run_subprocess(
-            "fused", args.quick,
-            {"SLT_BENCH_MODEL": "resnet18", "SLT_BENCH_BATCH": "256",
-             "SLT_BENCH_DTYPE": "bfloat16"}, timeout=900)
+        resnet = side_leg({"SLT_BENCH_MODEL": "resnet18",
+                           "SLT_BENCH_BATCH": "256",
+                           "SLT_BENCH_DTYPE": "bfloat16"})
         if resnet is not None:
             if not resnet.get("valid"):
                 # full redaction: every throughput-derived field goes (a
@@ -646,8 +670,7 @@ def main() -> None:
         # holds stages A and C; one program, labels never cross the cut).
         # Same scope as bf16/resnet: device legs only next to a valid
         # device headline.
-        usplit = _run_subprocess("fused", args.quick,
-                                 {"SLT_BENCH_MODE": "u_split"}, timeout=900)
+        usplit = side_leg({"SLT_BENCH_MODE": "u_split"})
         if usplit is not None and usplit.get("valid"):
             detail["u_split_fused"] = usplit
         elif usplit is not None:
@@ -655,9 +678,7 @@ def main() -> None:
                   f"{usplit.get('invalid_reason')}", file=sys.stderr)
         # the hand-written Pallas kernels (ops/) vs plain XLA on the same
         # step — the kernels' first on-device perf evidence
-        pallas = _run_subprocess("fused", args.quick,
-                                 {"SLT_BENCH_KERNELS": "pallas"},
-                                 timeout=900)
+        pallas = side_leg({"SLT_BENCH_KERNELS": "pallas"})
         if pallas is not None and pallas.get("valid"):
             detail["fused_pallas_kernels"] = pallas
         elif pallas is not None:
@@ -670,7 +691,7 @@ def main() -> None:
                 ("transformer_t256_flash", {"SLT_BENCH_ATTN": "flash"})):
             env = {"SLT_BENCH_MODEL": "transformer",
                    "SLT_BENCH_DTYPE": "bfloat16", **extra}
-            tfm = _run_subprocess("fused", args.quick, env, timeout=900)
+            tfm = side_leg(env)
             if tfm is not None and tfm.get("valid"):
                 detail[leg_name] = tfm
             elif tfm is not None:

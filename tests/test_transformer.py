@@ -173,3 +173,29 @@ def test_bf16_pipeline_preserves_large_token_ids(devices):
     lp = piped.train_step(x, y)
     lf = fused.train_step(x, y)
     np.testing.assert_allclose(lp, lf, atol=5e-3, rtol=5e-3)
+
+
+def test_split_transformer_over_http_wire():
+    """The [B, T, E] cut tensor and int32 token labels ride the msgpack
+    wire unchanged — the HTTP transport is family-agnostic too."""
+    import jax
+    from split_learning_tpu.runtime import ServerRuntime, SplitClientTrainer
+    from split_learning_tpu.transport.http import (
+        HttpTransport, SplitHTTPServer)
+
+    x, y = tokens()
+    cfg = Config(mode="split", model="transformer", batch_size=B)
+    plan = transformer_plan()
+    runtime = ServerRuntime(plan, cfg, jax.random.PRNGKey(0), x)
+    server = SplitHTTPServer(runtime).start()
+    transport = HttpTransport(server.url)
+    try:
+        client = SplitClientTrainer(plan, cfg, jax.random.PRNGKey(0),
+                                    transport)
+        fused = FusedSplitTrainer(plan, cfg, jax.random.PRNGKey(0), x)
+        l_http = client.train_step(x, y, 0)
+        l_fused = fused.train_step(x, y)
+        np.testing.assert_allclose(l_http, l_fused, atol=1e-5)
+    finally:
+        transport.close()
+        server.stop()
