@@ -1,0 +1,73 @@
+"""Autoregressive generation for the causal-LM plans
+(models/transformer.py ``lm=True``).
+
+Greedy decode as one jitted program: a fixed-size token buffer and a
+``lax.scan`` over decode positions — static shapes, no Python loop over
+tokens, so XLA compiles one step function reused for every position.
+Each step re-runs the full forward on the buffer (no KV cache); causal
+masking makes the not-yet-written positions invisible to the decoded
+one, so the zero padding is inert. At the framework's model sizes the
+full re-forward is cheap; a KV cache is a later optimization, not a
+correctness need.
+
+Works with every attention implementation the plan was built with, and
+with split ownership: generation needs the full composition
+(``plan.apply``), same as evaluation.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from split_learning_tpu.core.stage import SplitPlan
+
+
+@functools.lru_cache(maxsize=32)
+def _decode_fn(plan: SplitPlan, b: int, p: int, n_new: int,
+               dtype_name: str):
+    """One compiled decode program per (plan, shapes) — SplitPlan is a
+    frozen dataclass of functions, so it keys the cache directly and
+    repeated generation never re-jits."""
+    total = p + n_new
+    dtype = jnp.dtype(dtype_name)
+
+    @jax.jit
+    def run(params, prompt):
+        buf = jnp.zeros((b, total), dtype)
+        buf = jax.lax.dynamic_update_slice(buf, prompt, (0, 0))
+
+        def step(buf, pos):
+            # pos is the index of the last written token; its logits
+            # predict the next one. Positions > pos are zero padding the
+            # causal mask keeps out of every prediction <= pos.
+            logits = plan.apply(params, buf)            # [B, total, V]
+            row = jax.lax.dynamic_index_in_dim(logits, pos, axis=1,
+                                               keepdims=False)
+            nxt = jnp.argmax(row, axis=-1).astype(buf.dtype)  # [B]
+            buf = jax.lax.dynamic_update_slice(
+                buf, nxt[:, None], (0, pos + 1))
+            return buf, nxt
+
+        buf, _ = jax.lax.scan(step, buf, p - 1 + jnp.arange(n_new))
+        return buf
+
+    return run
+
+
+def greedy_generate(plan: SplitPlan, params: Sequence[Any],
+                    prompt: np.ndarray, n_new: int) -> jax.Array:
+    """Extend ``prompt`` ``[B, P] int`` by ``n_new`` greedy tokens.
+
+    Returns ``[B, P + n_new]``. The plan must produce per-token logits
+    ``[B, T, V]`` (an ``lm=True`` transformer plan).
+    """
+    prompt = jnp.asarray(prompt)
+    b, p = prompt.shape
+    params = jax.tree_util.tree_map(jnp.asarray, list(params))
+    run = _decode_fn(plan, b, p, n_new, str(prompt.dtype))
+    return run(params, prompt)
