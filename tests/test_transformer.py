@@ -199,3 +199,24 @@ def test_split_transformer_over_http_wire():
     finally:
         transport.close()
         server.stop()
+
+
+def test_transformer_tensor_parallel_matches_unsharded(devices):
+    """TP (mesh 'model' axis) composes with the transformer: Dense and
+    Embed kernels shard their output-feature dim; the loss series must
+    match the unsharded trainer to reassociation noise."""
+    steps = 3
+    xs, ys = tokens(steps=steps, seed=5)
+    cfg = Config(mode="split", model="transformer", batch_size=B,
+                 num_clients=2, model_parallel=2)
+    plan = transformer_plan()
+    base = FusedSplitTrainer(plan, Config(mode="split", batch_size=B),
+                             jax.random.PRNGKey(0), xs[0])
+    mesh = make_mesh(num_clients=2, num_stages=1, model_parallel=2,
+                     devices=devices)
+    tp = FusedSplitTrainer(plan, cfg, jax.random.PRNGKey(0), xs[0],
+                           mesh=mesh)
+    for i in range(steps):
+        np.testing.assert_allclose(tp.train_step(xs[i], ys[i]),
+                                   base.train_step(xs[i], ys[i]),
+                                   atol=5e-5, rtol=5e-5)
