@@ -385,6 +385,15 @@ def synthetic(name: str, n_train: int = 4096, n_test: int = 512,
                    name=name, num_classes=num_classes, synthetic=True)
 
 
+def _categorical_rows(rs: np.random.RandomState, rows: int, cols: int,
+                      sharpness: float) -> np.ndarray:
+    """[rows, cols] row-stochastic matrix from sharpened random logits —
+    the learnable structure behind both synthetic token datasets."""
+    logits = sharpness * rs.randn(rows, cols)
+    probs = np.exp(logits - logits.max(axis=1, keepdims=True))
+    return probs / probs.sum(axis=1, keepdims=True)
+
+
 def synthetic_tokens(name: str = "tokens", n_train: int = 4096,
                      n_test: int = 512, num_classes: int = 10,
                      vocab: int = 256, seq_len: int = 64,
@@ -394,10 +403,7 @@ def synthetic_tokens(name: str = "tokens", n_train: int = 4096,
     categorical distribution, so the task is learnable, deterministic and
     needs zero egress. ``x`` is ``[N, T] int32`` token ids."""
     rs = np.random.RandomState(seed)
-    # temperature-sharpened per-class token distributions
-    logits = 2.0 * rs.randn(num_classes, vocab)
-    probs = np.exp(logits - logits.max(axis=1, keepdims=True))
-    probs /= probs.sum(axis=1, keepdims=True)
+    probs = _categorical_rows(rs, num_classes, vocab, sharpness=2.0)
 
     def make(n: int, rs: np.random.RandomState) -> Split:
         y = rs.randint(0, num_classes, (n,)).astype(np.int64)
@@ -421,10 +427,8 @@ def synthetic_lm(name: str = "lm", n_train: int = 4096, n_test: int = 512,
     # sharply peaked rows: the bigram structure dominates the unigram
     # baseline, so plain SGD (the reference's optimizer) shows context
     # learning within a test-sized budget
-    logits = 4.0 * rs.randn(vocab, vocab)
-    probs = np.exp(logits - logits.max(axis=1, keepdims=True))
-    probs /= probs.sum(axis=1, keepdims=True)
-    cdf = np.cumsum(probs, axis=1)
+    cdf = np.cumsum(_categorical_rows(rs, vocab, vocab, sharpness=4.0),
+                    axis=1)
 
     def make(n: int, rs: np.random.RandomState) -> Split:
         chain = np.zeros((n, seq_len + 1), np.int64)

@@ -12,6 +12,11 @@ import jax.numpy as jnp
 SUBLANE = 8
 LANE = 128
 
+# shared additive-mask value for softmax-family kernels: large enough to
+# zero out after exp, small enough that (x - NEG_BIG) never overflows —
+# masked entries must still be re-zeroed after any exp rebase
+NEG_BIG = -1e30
+
 
 @functools.lru_cache(maxsize=None)
 def _default_backend_platform() -> str:

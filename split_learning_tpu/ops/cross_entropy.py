@@ -35,13 +35,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 from split_learning_tpu.ops.common import (
     LANE,
+    NEG_BIG as _NEG_INF,
     SUBLANE,
     pad_axis,
     round_up,
     use_interpret,
 )
-
-_NEG_INF = -1e30
 # rows per CE grid block: [1024, 128] fp32 = 512 KiB per operand
 _BLOCK_B = 1024
 

@@ -50,9 +50,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from split_learning_tpu.ops.common import LANE, pad_axis, round_up, use_interpret
+from split_learning_tpu.ops.common import (
+    LANE, NEG_BIG as _NEG_BIG, pad_axis, round_up, use_interpret)
 
-_NEG_BIG = -1e30
 _BLOCK = 128   # both block axes; tp = round_up(t, _BLOCK) divides evenly
 _ROWW = 8      # lane width of the LSE/delta row vectors (tile-masked)
 
@@ -97,7 +97,7 @@ def _fwd_kernel(t: int, scale: float, causal: bool, n_k: int,
     # causal: a key block strictly in the future of the whole query
     # block contributes nothing — skip its matmuls entirely (the grid
     # stays static; only the compute is guarded). Blocks are square, so
-    # "any overlap" is kb_i <= qi.
+    # "any overlap" is kb_i <= qb_i.
     def _accumulate():
         qb = q_ref[0]
         vb = v_ref[0]

@@ -50,9 +50,8 @@ try:  # jax >= 0.8 public API; the experimental home is deprecated
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
+from split_learning_tpu.ops.common import NEG_BIG as _NEG_BIG
 from split_learning_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
-
-_NEG_BIG = -1e30  # additive mask value; never fed to exp un-rebased
 
 
 def full_attention(q: jax.Array, k: jax.Array, v: jax.Array,
