@@ -6,7 +6,7 @@ from split_learning_tpu.runtime.client import (
     USplitClientTrainer,
 )
 from split_learning_tpu.runtime.checkpoint import Checkpointer, joint_state
-from split_learning_tpu.runtime.generate import greedy_generate
+from split_learning_tpu.runtime.generate import greedy_generate, sample_generate
 from split_learning_tpu.runtime.multi_client import MultiClientSplitRunner
 from split_learning_tpu.runtime.pipelined_client import PipelinedSplitClientTrainer
 from split_learning_tpu.runtime.server import (
@@ -21,5 +21,5 @@ __all__ = [
     "FailurePolicy", "StepRecord", "ServerRuntime", "FedAvgAggregator",
     "ProtocolError", "TrainState", "make_state", "apply_grads", "sgd",
     "Checkpointer", "joint_state", "MultiClientSplitRunner",
-    "PipelinedSplitClientTrainer", "greedy_generate",
+    "PipelinedSplitClientTrainer", "greedy_generate", "sample_generate",
 ]

@@ -29,15 +29,16 @@ from split_learning_tpu.core.stage import SplitPlan
 
 @functools.lru_cache(maxsize=32)
 def _decode_fn(plan: SplitPlan, b: int, p: int, n_new: int,
-               dtype_name: str):
-    """One compiled decode program per (plan, shapes) — SplitPlan is a
-    frozen dataclass of functions, so it keys the cache directly and
-    repeated generation never re-jits."""
+               dtype_name: str, sample: bool):
+    """One compiled decode program per (plan, shapes, mode) — SplitPlan
+    is a frozen dataclass of functions, so it keys the cache directly
+    and repeated generation never re-jits. Temperature and PRNG key are
+    runtime arguments, not cache keys."""
     total = p + n_new
     dtype = jnp.dtype(dtype_name)
 
     @jax.jit
-    def run(params, prompt):
+    def run(params, prompt, rng, temperature):
         buf = jnp.zeros((b, total), dtype)
         buf = jax.lax.dynamic_update_slice(buf, prompt, (0, 0))
 
@@ -48,7 +49,13 @@ def _decode_fn(plan: SplitPlan, b: int, p: int, n_new: int,
             logits = plan.apply(params, buf)            # [B, total, V]
             row = jax.lax.dynamic_index_in_dim(logits, pos, axis=1,
                                                keepdims=False)
-            nxt = jnp.argmax(row, axis=-1).astype(buf.dtype)  # [B]
+            if sample:
+                nxt = jax.random.categorical(
+                    jax.random.fold_in(rng, pos), row / temperature,
+                    axis=-1)
+            else:
+                nxt = jnp.argmax(row, axis=-1)
+            nxt = nxt.astype(buf.dtype)                 # [B]
             buf = jax.lax.dynamic_update_slice(
                 buf, nxt[:, None], (0, pos + 1))
             return buf, nxt
@@ -69,5 +76,26 @@ def greedy_generate(plan: SplitPlan, params: Sequence[Any],
     prompt = jnp.asarray(prompt)
     b, p = prompt.shape
     params = jax.tree_util.tree_map(jnp.asarray, list(params))
-    run = _decode_fn(plan, b, p, n_new, str(prompt.dtype))
-    return run(params, prompt)
+    run = _decode_fn(plan, b, p, n_new, str(prompt.dtype), sample=False)
+    return run(params, prompt, jax.random.PRNGKey(0), jnp.float32(1.0))
+
+
+def sample_generate(plan: SplitPlan, params: Sequence[Any],
+                    prompt: np.ndarray, n_new: int, rng: jax.Array,
+                    temperature: float = 1.0) -> jax.Array:
+    """Like :func:`greedy_generate` but samples from the softmax at
+    ``temperature`` (a runtime scalar — changing it never recompiles).
+
+    ``temperature`` must be > 0: division by zero would turn the logits
+    into inf/NaN and ``categorical`` over ties does NOT reduce to
+    argmax — use :func:`greedy_generate` for deterministic decode.
+    """
+    if temperature <= 0.0:
+        raise ValueError(
+            f"temperature must be > 0 (got {temperature}); use "
+            "greedy_generate for deterministic decoding")
+    prompt = jnp.asarray(prompt)
+    b, p = prompt.shape
+    params = jax.tree_util.tree_map(jnp.asarray, list(params))
+    run = _decode_fn(plan, b, p, n_new, str(prompt.dtype), sample=True)
+    return run(params, prompt, rng, jnp.float32(temperature))

@@ -164,3 +164,36 @@ def test_greedy_generate_learns_chain_transitions(lm_data):
     gen_next = out[:, 8:].ravel()
     hit = float(np.mean(gen_next == modal[gen_prev]))
     assert hit > 0.25, f"modal-successor hit rate {hit} barely above chance"
+
+
+def test_sample_generate_determinism_and_range(lm_data):
+    """Sampling decode: deterministic under a fixed key, different keys
+    diverge, tokens stay in-vocab, and a near-zero temperature recovers
+    the greedy path."""
+    from split_learning_tpu.runtime.generate import (
+        greedy_generate, sample_generate)
+
+    plan = get_plan(model="transformer_lm")
+    prompt = lm_data.train.x[:4, :8]
+    params = plan.init(jax.random.PRNGKey(2), prompt)
+    k1, k2 = jax.random.PRNGKey(10), jax.random.PRNGKey(11)
+    a = np.asarray(sample_generate(plan, params, prompt, 8, k1))
+    b = np.asarray(sample_generate(plan, params, prompt, 8, k1))
+    c = np.asarray(sample_generate(plan, params, prompt, 8, k2))
+    np.testing.assert_array_equal(a, b)
+    assert (a != c).any()
+    assert a.min() >= 0 and a.max() < V
+    cold = np.asarray(sample_generate(plan, params, prompt, 8, k1,
+                                      temperature=1e-4))
+    greedy = np.asarray(greedy_generate(plan, params, prompt, 8))
+    np.testing.assert_array_equal(cold, greedy)
+
+
+def test_sample_generate_rejects_nonpositive_temperature(lm_data):
+    from split_learning_tpu.runtime.generate import sample_generate
+    plan = get_plan(model="transformer_lm")
+    prompt = lm_data.train.x[:2, :8]
+    params = plan.init(jax.random.PRNGKey(2), prompt)
+    with pytest.raises(ValueError, match="temperature"):
+        sample_generate(plan, params, prompt, 4, jax.random.PRNGKey(0),
+                        temperature=0.0)
