@@ -609,6 +609,8 @@ def cmd_eval(args) -> int:
     print(json.dumps({"checkpoint_step": step, "dataset": dataset,
                       "accuracy": round(res["accuracy"], 4),
                       "loss": round(res["loss"], 4),
+                      "perplexity": (None if res["perplexity"] is None
+                                     else round(res["perplexity"], 4)),
                       "examples": res["examples"],
                       "predictions": res["predictions"]}))
     return 0

@@ -54,6 +54,13 @@ def evaluate(plan: SplitPlan, params: Sequence[Any], split: Split,
         loss_sum += float(loss) * n
     if total == 0:
         return {"accuracy": float("nan"), "loss": float("nan"),
-                "examples": 0, "predictions": 0}
-    return {"accuracy": correct_sum / total, "loss": loss_sum / total,
+                "perplexity": float("nan"), "examples": 0, "predictions": 0}
+    mean_loss = loss_sum / total
+    # exp(mean CE): the standard LM report; harmless for classifiers
+    # (exp of their CE). A diverged checkpoint's CE can overflow exp —
+    # keep the JSON strict-parseable (inf/nan are not JSON tokens)
+    with np.errstate(over="ignore"):
+        ppl = float(np.exp(mean_loss))
+    return {"accuracy": correct_sum / total, "loss": mean_loss,
+            "perplexity": ppl if np.isfinite(ppl) else None,
             "examples": rows, "predictions": total}

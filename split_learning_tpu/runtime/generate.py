@@ -90,7 +90,7 @@ def sample_generate(plan: SplitPlan, params: Sequence[Any],
     into inf/NaN and ``categorical`` over ties does NOT reduce to
     argmax — use :func:`greedy_generate` for deterministic decode.
     """
-    if temperature <= 0.0:
+    if not temperature > 0.0:  # also rejects NaN, which `<= 0` lets past
         raise ValueError(
             f"temperature must be > 0 (got {temperature}); use "
             "greedy_generate for deterministic decoding")
