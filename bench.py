@@ -279,6 +279,11 @@ def measure_fused(quick: bool) -> dict:
     leg = {
         "model": model,
         "mode": mode,
+        # steps executed per device dispatch (lax.scan in train_epoch):
+        # host dispatch is amortized K-fold — the residual utilization
+        # gap at small batch is the on-device critical path of a tiny
+        # sequential-SGD step, not host overhead
+        "steps_per_dispatch": 1 if platform == "cpu" else chunk,
         "kernels": kernels,
         "attn": attn,
         "batch": batch,
@@ -487,6 +492,76 @@ def measure_pipelined(quick: bool) -> dict:
     return out
 
 
+def measure_decode(quick: bool) -> dict:
+    """Autoregressive decode throughput (tokens/s) of the KV-cache path
+    vs the O(T^2) re-forward path, same LM plan (runtime/generate.py).
+
+    The timed window is data-dependent (np.asarray of the generated
+    tokens — the host transfer cannot complete until the scan executed)
+    and cross-checked by a 2x-new-tokens window: KV decode cost is
+    ~linear in generated tokens, so linearity_2x must land near 2; the
+    re-forward path is quadratic-ish, reported for the speedup ratio
+    only. Env overrides: SLT_DECODE_PROMPT / SLT_DECODE_NEW /
+    SLT_DECODE_BATCH."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from split_learning_tpu.models.transformer import transformer_plan
+    from split_learning_tpu.runtime.generate import greedy_generate
+
+    prompt_len = int(os.environ.get("SLT_DECODE_PROMPT",
+                                    "128" if quick else "1024"))
+    n_new = int(os.environ.get("SLT_DECODE_NEW", "32" if quick else "256"))
+    batch = int(os.environ.get("SLT_DECODE_BATCH", "8"))
+    rs = np.random.RandomState(0)
+    prompt = rs.randint(0, 256, (batch, prompt_len)).astype(np.int32)
+    plan = transformer_plan(lm=True, dtype=np.dtype("bfloat16"),
+                            d_model=256, num_heads=2,
+                            max_len=max(2048, prompt_len + 2 * n_new))
+    params = plan.init(jax.random.PRNGKey(0), jnp.asarray(prompt))
+    device = jax.devices()[0]
+
+    def window(n: int, kv: bool) -> float:
+        t0 = time.perf_counter()
+        out = greedy_generate(plan, params, prompt, n, kv_cache=kv)
+        np.asarray(out)  # host transfer: data-dependent close
+        return time.perf_counter() - t0
+
+    window(n_new, kv=True)  # compile + warm
+    times = sorted(window(n_new, kv=True) for _ in range(3))
+    t_med = times[1]
+    t_2x = window(2 * n_new, kv=True)  # includes its own compile once
+    t_2x = min(t_2x, window(2 * n_new, kv=True))
+    leg = {
+        "leg": "decode",
+        "prompt_len": prompt_len,
+        "n_new": n_new,
+        "batch": batch,
+        "dtype": "bfloat16",
+        "platform": device.platform,
+        "device_kind": getattr(device, "device_kind", "") or "",
+        "kv_tokens_per_sec": batch * n_new / t_med,
+        "kv_ms_per_token": t_med / n_new * 1e3,
+        "window_s": {"best": times[0], "median": t_med, "worst": times[-1]},
+        # prefill is inside the window both times, so the ratio of the
+        # 2x window reflects per-token linearity plus that fixed cost:
+        # accept the same [1.5, 2.6] band as the training legs
+        "linearity_2x": t_2x / t_med,
+    }
+    if not quick:
+        window(n_new, kv=False)  # compile
+        t_ref = min(window(n_new, kv=False) for _ in range(2))
+        leg["reforward_tokens_per_sec"] = batch * n_new / t_ref
+        leg["kv_speedup_vs_reforward"] = t_ref / t_med
+    lin = leg["linearity_2x"]
+    leg["valid"] = 1.5 <= lin <= 2.6
+    leg["invalid_reason"] = None if leg["valid"] else (
+        f"linearity_2x={lin:.2f} outside [1.5, 2.6]: the timed window "
+        "does not scale with generated tokens")
+    return leg
+
+
 def _run_subprocess(role: str, quick: bool, env_overrides: dict,
                     timeout: float, capture: bool = False):
     """Run one measurement role in a fresh process and parse its JSON
@@ -651,7 +726,8 @@ def _probe_device(budget_s: float) -> bool:
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--role",
-                    choices=["baseline", "fused", "dp", "wire", "pipelined"],
+                    choices=["baseline", "fused", "dp", "wire", "pipelined",
+                             "decode"],
                     default=None)
     ap.add_argument("--quick", action="store_true")
     args = ap.parse_args()
@@ -660,7 +736,8 @@ def main() -> None:
         _drop_axon_if_cpu()
         fn = {"baseline": measure_baseline, "fused": measure_fused,
               "dp": measure_dp, "wire": measure_wire,
-              "pipelined": measure_pipelined}[args.role]
+              "pipelined": measure_pipelined,
+              "decode": measure_decode}[args.role]
         print(json.dumps(fn(args.quick)))
         return
 
@@ -707,14 +784,14 @@ def main() -> None:
         # CPU-fallback headline must not be paired with device side legs
         side_fails = {"n": 0}
 
-        def side_leg(env_overrides, timeout=900):
+        def side_leg(env_overrides, timeout=900, role="fused"):
             """Device side legs run after a good headline, but the
             headline JSON prints only after ALL of them — on a degraded
             tunnel every dead leg costs its full timeout, so after two
             consecutive failures stop probing and ship the headline."""
             if side_fails["n"] >= 2:
                 return None
-            rec = _run_subprocess("fused", args.quick, env_overrides,
+            rec = _run_subprocess(role, args.quick, env_overrides,
                                   timeout=timeout)
             side_fails["n"] = 0 if rec is not None else side_fails["n"] + 1
             if rec is None and side_fails["n"] == 2:
@@ -756,6 +833,18 @@ def main() -> None:
         elif usplit is not None:
             print(f"[bench] u_split leg INVALID: "
                   f"{usplit.get('invalid_reason')}", file=sys.stderr)
+        # large-batch leg: same split CNN at batch 1024 — the workload
+        # whose per-step work is big enough to fill the chip. Shows
+        # where the batch-64 headline's utilization gap comes from
+        # (on-device critical path of a tiny step, not dispatch: the
+        # headline already scans ~469 steps per dispatch)
+        b1024 = side_leg({"SLT_BENCH_BATCH": "1024",
+                          "SLT_BENCH_DTYPE": "bfloat16"})
+        if b1024 is not None and b1024.get("valid"):
+            detail["split_cnn_b1024_bf16"] = b1024
+        elif b1024 is not None:
+            print(f"[bench] b1024 leg INVALID: "
+                  f"{b1024.get('invalid_reason')}", file=sys.stderr)
         # the hand-written Pallas kernels (ops/) vs plain XLA on the same
         # step — the kernels' first on-device perf evidence
         pallas = side_leg({"SLT_BENCH_KERNELS": "pallas"})
@@ -777,6 +866,14 @@ def main() -> None:
             elif tfm is not None:
                 print(f"[bench] {leg_name} leg INVALID: "
                       f"{tfm.get('invalid_reason')}", file=sys.stderr)
+        # KV-cache decode throughput (runtime/generate.py): tokens/s at
+        # a 1024-token prompt, vs the O(T^2) re-forward path
+        dec = side_leg({}, role="decode")
+        if dec is not None and dec.get("valid"):
+            detail["decode_kv_cache"] = dec
+        elif dec is not None:
+            print(f"[bench] decode leg INVALID: "
+                  f"{dec.get('invalid_reason')}", file=sys.stderr)
 
     if not args.quick and fused is not None and fused.get("valid"):
         # CPU side legs — skipped when the headline is doomed to exit(1)

@@ -79,11 +79,16 @@ def remat_plan(plan: "SplitPlan") -> "SplitPlan":
 
 
 def from_flax(name: str, module: Any) -> Stage:
-    """Wrap a flax.linen Module as a Stage."""
+    """Wrap a flax.linen Module as a Stage.
+
+    Extra keyword arguments pass through to ``module.apply`` — the
+    transformer stages use this for their KV-cache decode modes
+    (``cache_len=``/``decode_cache=``/``pos=``, models/transformer.py);
+    plain ``apply(params, x)`` is unchanged for every other caller."""
     return Stage(
         name=name,
         init=lambda rng, sample: module.init(rng, sample),
-        apply=lambda params, x: module.apply(params, x),
+        apply=lambda params, x, **kw: module.apply(params, x, **kw),
     )
 
 

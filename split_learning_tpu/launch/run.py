@@ -234,16 +234,20 @@ def cmd_train(args) -> int:
                 mesh = global_mesh(num_clients=cfg.num_clients, num_stages=1,
                                    model_parallel=cfg.model_parallel,
                                    seq_parallel=cfg.seq_parallel)
-            if transformer_family and cfg.attn in ("ring", "ulysses") and (
+            if transformer_family and cfg.attn in ("ring", "ring_flash",
+                                                  "ulysses") and (
                     mesh is None or "seq" not in mesh.axis_names
                     or mesh.shape["seq"] == 1):
-                # ring_attention's shard_map falls back to dense math
-                # when there is no seq axis to rotate over — say so
-                # instead of silently training with full attention
-                print(f"[warn] --attn {cfg.attn!r} runs as dense "
-                      "attention: no 'seq' mesh axis (pass "
-                      "--seq-parallel > 1 to shard the sequence)",
-                      file=sys.stderr)
+                # ring_attention falls back to single-device math when
+                # there is no seq axis to rotate over (dense for ring,
+                # the flash kernel for ring_flash) — say so instead of
+                # silently training without context parallelism
+                fallback = ("the single-device flash kernel"
+                            if cfg.attn == "ring_flash"
+                            else "dense attention")
+                print(f"[warn] --attn {cfg.attn!r} runs as {fallback}: "
+                      "no 'seq' mesh axis (pass --seq-parallel > 1 to "
+                      "shard the sequence)", file=sys.stderr)
             if transformer_family and (cfg.seq_parallel > 1
                                        or cfg.attn != "full"):
                 # the seq-parallel attention forms need the mesh at plan
@@ -661,7 +665,8 @@ def main(argv: Optional[list] = None) -> int:
                          "transport, transformer family — ring/Ulysses "
                          "attention over ICI)")
     pt.add_argument("--attn",
-                    choices=["full", "flash", "ring", "ulysses"],
+                    choices=["full", "flash", "auto", "ring", "ring_flash",
+                             "ulysses"],
                     default=None,
                     help="transformer attention math (flash = Pallas "
                          "blockwise kernels; ring/ulysses shard the "

@@ -27,19 +27,40 @@ from __future__ import annotations
 from typing import Any, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from split_learning_tpu.core.stage import SplitPlan, from_flax
-from split_learning_tpu.ops.flash_attention import flash_attention
+from split_learning_tpu.ops.flash_attention import (
+    flash_attention, select_attention)
 from split_learning_tpu.ops.ring_attention import (
     full_attention, ring_attention, ulysses_attention)
 
-_ATTN_IMPLS = ("full", "flash", "ring", "ulysses")
+_ATTN_IMPLS = ("full", "flash", "auto", "ring", "ring_flash", "ulysses")
+
+
+def _decode_attention(q, ck, cv, pos, scale):
+    """Single-position attention against a KV cache: ``q`` is
+    ``[B, 1, H, D]``, ``ck``/``cv`` are ``[B, L, H, D]`` with positions
+    ``> pos`` holding garbage the mask keeps out. Dense math — a decode
+    step is one row of scores, bandwidth-bound, nothing to block."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   ck.astype(jnp.float32)) * scale
+    keys = jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
+    s = jnp.where(keys <= pos, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p,
+                      cv.astype(jnp.float32)).astype(cv.dtype)
 
 
 class MultiHeadAttention(nn.Module):
     """Projections + attention; the attention math itself is selectable
-    between dense and the two sequence-parallel forms."""
+    between dense and the two sequence-parallel forms.
+
+    KV-cache decode modes (runtime/generate.py): ``cache_len=L``
+    (prefill) additionally returns ``{"k", "v"}`` buffers of length
+    ``L``; ``decode_cache=``/``pos=`` runs one token against the cache
+    and returns the updated cache. Same parameter tree in every mode."""
 
     num_heads: int
     mesh: Any = None          # jax.sharding.Mesh (hashable) or None
@@ -48,7 +69,8 @@ class MultiHeadAttention(nn.Module):
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, *, cache_len: int = 0, decode_cache=None,
+                 pos=None):
         b, t, e = x.shape
         if e % self.num_heads != 0:
             raise ValueError(f"d_model {e} % heads {self.num_heads} != 0")
@@ -57,20 +79,59 @@ class MultiHeadAttention(nn.Module):
         q = nn.Dense(e, dtype=self.dtype, name="q")(x).reshape(heads_shape)
         k = nn.Dense(e, dtype=self.dtype, name="k")(x).reshape(heads_shape)
         v = nn.Dense(e, dtype=self.dtype, name="v")(x).reshape(heads_shape)
-        if self.attn == "ring":
+        if decode_cache is not None:
+            ck = jax.lax.dynamic_update_slice(
+                decode_cache["k"], k, (0, pos, 0, 0))
+            cv = jax.lax.dynamic_update_slice(
+                decode_cache["v"], v, (0, pos, 0, 0))
+            o = _decode_attention(q, ck, cv, pos, d ** -0.5)
+            out = nn.Dense(e, dtype=self.dtype, name="out")(
+                o.reshape((b, t, e)))
+            return out, {"k": ck, "v": cv}
+        impl = self.attn
+        if impl == "auto":
+            # resolve per shape at trace time: dense until its [T,T]
+            # residency threatens HBM, flash beyond (the measured
+            # crossover — ops/flash_attention.py:select_attention)
+            impl = select_attention(b, t, self.num_heads,
+                                    jnp.dtype(self.dtype).itemsize)
+        if impl == "ring":
             o = ring_attention(q, k, v, mesh=self.mesh, causal=self.causal)
-        elif self.attn == "ulysses":
+        elif impl == "ring_flash":
+            o = ring_attention(q, k, v, mesh=self.mesh, causal=self.causal,
+                               block_impl="flash")
+        elif impl == "ulysses":
             o = ulysses_attention(q, k, v, mesh=self.mesh,
                                   causal=self.causal)
-        elif self.attn == "flash":
+        elif impl == "flash":
             o = flash_attention(q, k, v, causal=self.causal)
-        elif self.attn == "full":
+        elif impl == "full":
             o = full_attention(q, k, v, causal=self.causal)
         else:
             raise ValueError(
                 f"Unknown attn impl: {self.attn!r} (expected {_ATTN_IMPLS})")
         o = o.reshape((b, t, e))
-        return nn.Dense(e, dtype=self.dtype, name="out")(o)
+        out = nn.Dense(e, dtype=self.dtype, name="out")(o)
+        if cache_len:
+            pad = ((0, 0), (0, cache_len - t), (0, 0), (0, 0))
+            return out, {"k": jnp.pad(k, pad), "v": jnp.pad(v, pad)}
+        return out
+
+
+def _thread_blocks(blocks, x, cache_len, decode_cache, pos):
+    """Run ``x`` through ``blocks``, threading per-block KV caches when
+    a cache mode is active (shared by EmbedStage and TrunkStage)."""
+    caching = cache_len or decode_cache is not None
+    caches = []
+    for i, blk in enumerate(blocks):
+        if caching:
+            x, c = blk(x, cache_len=cache_len, pos=pos,
+                       decode_cache=(decode_cache[i]
+                                     if decode_cache is not None else None))
+            caches.append(c)
+        else:
+            x = blk(x)
+    return (x, tuple(caches)) if caching else x
 
 
 class Block(nn.Module):
@@ -84,18 +145,26 @@ class Block(nn.Module):
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, *, cache_len: int = 0, decode_cache=None,
+                 pos=None):
         e = x.shape[-1]
-        h = MultiHeadAttention(self.num_heads, mesh=self.mesh,
-                               attn=self.attn, causal=self.causal,
-                               dtype=self.dtype, name="mha")(
-            nn.LayerNorm(dtype=self.dtype, name="ln1")(x))
+        mha = MultiHeadAttention(self.num_heads, mesh=self.mesh,
+                                 attn=self.attn, causal=self.causal,
+                                 dtype=self.dtype, name="mha")
+        ln1 = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
+        caching = cache_len or decode_cache is not None
+        if caching:
+            h, cache = mha(ln1, cache_len=cache_len,
+                           decode_cache=decode_cache, pos=pos)
+        else:
+            h = mha(ln1)
         x = x + h
         y = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
         y = nn.Dense(self.mlp_ratio * e, dtype=self.dtype, name="up")(y)
         y = nn.gelu(y)
         y = nn.Dense(e, dtype=self.dtype, name="down")(y)
-        return x + y
+        out = x + y
+        return (out, cache) if caching else out
 
 
 class EmbedStage(nn.Module):
@@ -113,20 +182,28 @@ class EmbedStage(nn.Module):
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, *, cache_len: int = 0, decode_cache=None,
+                 pos=None):
         t = tokens.shape[1]
         if t > self.max_len:
             raise ValueError(f"sequence length {t} > max_len {self.max_len}")
+        if cache_len > self.max_len:
+            raise ValueError(f"cache_len {cache_len} > max_len "
+                             f"{self.max_len}")
         x = nn.Embed(self.vocab, self.d_model, dtype=self.dtype,
                      name="tok")(tokens)
-        pos = self.param("pos", nn.initializers.normal(0.02),
-                         (self.max_len, self.d_model), self.dtype)
-        x = x + pos[None, :t]
-        for i in range(self.depth):
-            x = Block(self.num_heads, mesh=self.mesh, attn=self.attn,
-                      causal=self.causal, dtype=self.dtype,
-                      name=f"block{i}")(x)
-        return x
+        pos_emb = self.param("pos", nn.initializers.normal(0.02),
+                             (self.max_len, self.d_model), self.dtype)
+        if decode_cache is not None:
+            # one token at (traced) position pos
+            x = x + jax.lax.dynamic_slice(
+                pos_emb, (pos, 0), (1, self.d_model))[None]
+        else:
+            x = x + pos_emb[None, :t]
+        blocks = [Block(self.num_heads, mesh=self.mesh, attn=self.attn,
+                        causal=self.causal, dtype=self.dtype,
+                        name=f"block{i}") for i in range(self.depth)]
+        return _thread_blocks(blocks, x, cache_len, decode_cache, pos)
 
 
 class TrunkStage(nn.Module):
@@ -140,12 +217,12 @@ class TrunkStage(nn.Module):
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
-        for i in range(self.depth):
-            x = Block(self.num_heads, mesh=self.mesh, attn=self.attn,
-                      causal=self.causal, dtype=self.dtype,
-                      name=f"block{i}")(x)
-        return x
+    def __call__(self, x, *, cache_len: int = 0, decode_cache=None,
+                 pos=None):
+        blocks = [Block(self.num_heads, mesh=self.mesh, attn=self.attn,
+                        causal=self.causal, dtype=self.dtype,
+                        name=f"block{i}") for i in range(self.depth)]
+        return _thread_blocks(blocks, x, cache_len, decode_cache, pos)
 
 
 class HeadStage(nn.Module):
@@ -172,9 +249,13 @@ class LMHeadStage(nn.Module):
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, *, cache_len: int = 0, decode_cache=None,
+                 pos=None):
+        # stateless per-token head: the cache kwargs exist so the decode
+        # driver can thread every stage uniformly (empty cache)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
-        return nn.Dense(self.vocab, dtype=self.dtype, name="lm_head")(x)
+        y = nn.Dense(self.vocab, dtype=self.dtype, name="lm_head")(x)
+        return (y, ()) if (cache_len or decode_cache is not None) else y
 
 
 class TrunkAndHead(nn.Module):
@@ -191,10 +272,22 @@ class TrunkAndHead(nn.Module):
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
-        x = TrunkStage(self.num_heads, self.depth, mesh=self.mesh,
-                       attn=self.attn, causal=self.causal,
-                       dtype=self.dtype, name="trunk")(x)
+    def __call__(self, x, *, cache_len: int = 0, decode_cache=None,
+                 pos=None):
+        caching = cache_len or decode_cache is not None
+        trunk = TrunkStage(self.num_heads, self.depth, mesh=self.mesh,
+                           attn=self.attn, causal=self.causal,
+                           dtype=self.dtype, name="trunk")
+        if caching:
+            if not self.lm_vocab:
+                raise ValueError("KV-cache decode requires the causal-LM "
+                                 "head (lm=True plans)")
+            x, caches = trunk(x, cache_len=cache_len,
+                              decode_cache=decode_cache, pos=pos)
+            y = LMHeadStage(self.lm_vocab, dtype=self.dtype,
+                            name="head")(x)
+            return y, caches
+        x = trunk(x)
         if self.lm_vocab:
             return LMHeadStage(self.lm_vocab, dtype=self.dtype,
                                name="head")(x)

@@ -36,9 +36,10 @@ validated compiled on a real v5e chip). Head dim pads to the 128-lane
 tile and T to the block size, with masks keeping ragged shapes exact.
 
 Composition note: flash is the *single-device* attention math; the ring
-form (ops/ring_attention.py) shards T across chips and could use these
-kernels for its per-block compute — today its block math is plain jnp,
-so ``attn="flash"`` and ``attn="ring"`` are separate choices.
+form (ops/ring_attention.py) shards T across chips and composes with
+these kernels via :func:`flash_attention_with_lse`
+(``attn="ring_flash"``): each rank runs the kernel per K/V block and
+merges normalized ``(o, lse)`` partials in log space.
 """
 
 from __future__ import annotations
@@ -53,8 +54,68 @@ from jax.experimental.pallas import tpu as pltpu
 from split_learning_tpu.ops.common import (
     LANE, NEG_BIG as _NEG_BIG, pad_axis, round_up, use_interpret)
 
-_BLOCK = 128   # both block axes; tp = round_up(t, _BLOCK) divides evenly
+_BLOCK = 128   # minimum block edge (the MXU tile); see _pick_block
 _ROWW = 8      # lane width of the LSE/delta row vectors (tile-masked)
+
+
+def _pick_block(t: int) -> int:
+    """Square block edge for both grid axes. 128x128 blocks drown in
+    per-grid-step overhead (DMA setup + semaphores): at T=4096 the
+    3-D grid is bh*32*32 steps and the round-3 measurement put flash at
+    2.8x slower than dense — worse than the ~1.8x recompute-FLOP ratio
+    explains. 512-row blocks cut the step count 16x and keep every
+    matmul MXU-shaped ([512,128]x[128,512]); VMEM stays ~2 MiB/kernel.
+    SLT_FLASH_BLOCK overrides for tuning."""
+    import os
+    env = os.environ.get("SLT_FLASH_BLOCK")
+    if env:
+        return int(env)
+    tp128 = round_up(t, 128)
+    b = 512
+    while b > 128 and tp128 % b:   # largest edge that adds no extra padding
+        b //= 2
+    return b
+
+
+def select_attention(b: int, t: int, h: int, itemsize: int,
+                     hbm_bytes: int | None = None) -> str:
+    """``attn="auto"`` resolution: pick ``"full"`` (XLA dense) or
+    ``"flash"`` per shape. Round-3 measurements on the v5e chip
+    (artifacts/bench_tpu_transformer_*.json) put dense ahead at every
+    shape where it can train — its fused [T,T] softmax runs at higher
+    MFU than the blockwise recompute — and flash ahead exactly where
+    dense hits the HBM wall (b16/h2/T=16384 bf16 fails to compile at
+    16G). So the rule is memory-based: dense until its quadratic
+    residency threatens HBM, flash beyond. The residency estimate is
+    3 buffers of [B,H,T,T] (forward scores, saved softmax for the
+    backward, dP) against half the chip's HBM — half, because the model
+    activations/params/optimizer need the rest and a borderline compile
+    that OOMs mid-run is worse than the slower kernel.
+
+    ``SLT_FLASH_AUTO_T`` overrides: at or above that T, flash — the
+    knob for re-pinning the crossover when the kernels change."""
+    import os
+    env = os.environ.get("SLT_FLASH_AUTO_T")
+    if env:
+        return "flash" if t >= int(env) else "full"
+    if hbm_bytes is None:
+        hbm_bytes = _device_hbm_bytes()
+    dense_resident = 3 * b * h * t * t * itemsize
+    return "flash" if dense_resident > hbm_bytes // 2 else "full"
+
+
+def _device_hbm_bytes() -> int:
+    """Default-backend memory budget; 16 GiB (the v5e figure) when the
+    runtime doesn't say (CPU test meshes: keeps selection deterministic
+    across hosts)."""
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = stats.get("bytes_limit")
+        if limit:
+            return int(limit)
+    except Exception:
+        pass
+    return 16 * 1024 ** 3
 
 
 def _scores(qb, kb, t, k0, q0, scale, causal):
@@ -73,15 +134,15 @@ def _scores(qb, kb, t, k0, q0, scale, causal):
     return jnp.where(ok, s, _NEG_BIG), ok
 
 
-def _fwd_kernel(t: int, scale: float, causal: bool, n_k: int,
+def _fwd_kernel(blk: int, t: int, scale: float, causal: bool, n_k: int,
                 q_ref, k_ref, v_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref):
     """Grid (bh, q block, k block), k fastest. Scratch accumulators carry
     the online softmax across the k dimension."""
     qb_i = pl.program_id(1)
     kb_i = pl.program_id(2)
-    q0 = qb_i * _BLOCK
-    k0 = kb_i * _BLOCK
+    q0 = qb_i * blk
+    k0 = kb_i * blk
 
     @pl.when(kb_i == 0)
     def _init():
@@ -119,20 +180,20 @@ def _fwd_kernel(t: int, scale: float, causal: bool, n_k: int,
         l = l_ref[:, 0]
         # padded query rows are row-masked in _scores: l == 0 there
         l_safe = jnp.where(l > 0.0, l, 1.0)
-        o_ref[0] = acc_ref[:] / l_safe[:, None]
+        o_ref[0] = (acc_ref[:] / l_safe[:, None]).astype(o_ref.dtype)
         lse = jnp.where(l > 0.0, m_ref[:, 0] + jnp.log(l_safe), _NEG_BIG)
         lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
 
 
-def _dq_kernel(t: int, scale: float, causal: bool, n_k: int,
+def _dq_kernel(blk: int, t: int, scale: float, causal: bool, n_k: int,
                q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                dq_ref, acc_ref):
     """Grid (bh, q block, k block): dQ = scale * sum_k dS_k @ K_k,
     dS = P * (dO @ V^T - delta)."""
     qb_i = pl.program_id(1)
     kb_i = pl.program_id(2)
-    q0 = qb_i * _BLOCK
-    k0 = kb_i * _BLOCK
+    q0 = qb_i * blk
+    k0 = kb_i * blk
 
     @pl.when(kb_i == 0)
     def _init():
@@ -159,18 +220,18 @@ def _dq_kernel(t: int, scale: float, causal: bool, n_k: int,
 
     @pl.when(kb_i == n_k - 1)
     def _finish():
-        dq_ref[0] = acc_ref[:] * scale
+        dq_ref[0] = (acc_ref[:] * scale).astype(dq_ref.dtype)
 
 
-def _dkv_kernel(t: int, scale: float, causal: bool, n_q: int,
+def _dkv_kernel(blk: int, t: int, scale: float, causal: bool, n_q: int,
                 k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_acc, dv_acc):
     """Grid (bh, k block, q block): dV = sum_q P^T @ dO,
     dK = scale * sum_q dS^T @ Q."""
     kb_i = pl.program_id(1)
     qb_i = pl.program_id(2)
-    k0 = kb_i * _BLOCK
-    q0 = qb_i * _BLOCK
+    k0 = kb_i * blk
+    q0 = qb_i * blk
 
     @pl.when(qb_i == 0)
     def _init():
@@ -203,19 +264,26 @@ def _dkv_kernel(t: int, scale: float, causal: bool, n_q: int,
 
     @pl.when(qb_i == n_q - 1)
     def _finish():
-        dk_ref[0] = dk_acc[:] * scale
-        dv_ref[0] = dv_acc[:]
+        dk_ref[0] = (dk_acc[:] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
 # --------------------------------------------------------------------- #
 @functools.lru_cache(maxsize=None)
-def _make_flash(bh: int, t: int, d: int, causal: bool, dtype_name: str):
-    """Custom-VJP flash attention for one static ([BH, T, D], causal)."""
+def _make_flash(bh: int, t: int, d: int, causal: bool, dtype_name: str,
+                block: int, with_lse: bool = False):
+    """Custom-VJP flash attention for one static ([BH, T, D], causal).
+
+    ``with_lse=True`` additionally returns the per-row logsumexp as a
+    differentiable output — the hook ring attention composes on
+    (partial results merge exactly via (o, lse) pairs). The backward
+    absorbs the lse cotangent into the ``delta`` row vector:
+    ``dS = P * (dP - (delta - g_lse))`` since ``d lse / d s = P``."""
     in_dtype = jnp.dtype(dtype_name)
     scale = d ** -0.5
-    tp = round_up(t, _BLOCK)
+    tp = round_up(t, block)
     dp = round_up(d, LANE)
-    n_blk = tp // _BLOCK
+    n_blk = tp // block
     grid = (bh, n_blk, n_blk)
 
     def pad_qkv(x):
@@ -227,19 +295,19 @@ def _make_flash(bh: int, t: int, d: int, causal: bool, dtype_name: str):
     def inner(b, i, k):   # block of the inner (grid dim 2) axis
         return (b, k, 0)
 
-    blk = lambda idx: pl.BlockSpec((1, _BLOCK, dp), idx,
+    blk = lambda idx: pl.BlockSpec((1, block, dp), idx,
                                    memory_space=pltpu.VMEM)
-    row = lambda idx: pl.BlockSpec((1, _BLOCK, _ROWW), idx,
+    row = lambda idx: pl.BlockSpec((1, block, _ROWW), idx,
                                    memory_space=pltpu.VMEM)
-    acc_scratch = pltpu.VMEM((_BLOCK, dp), jnp.float32)
-    row_scratch = pltpu.VMEM((_BLOCK, _ROWW), jnp.float32)
+    acc_scratch = pltpu.VMEM((block, dp), jnp.float32)
+    row_scratch = pltpu.VMEM((block, _ROWW), jnp.float32)
 
     def fwd_call(q, k, v):
         qp, kp, vp = pad_qkv(q), pad_qkv(k), pad_qkv(v)
         o, lse = pl.pallas_call(
-            functools.partial(_fwd_kernel, t, scale, causal, n_blk),
+            functools.partial(_fwd_kernel, block, t, scale, causal, n_blk),
             out_shape=(
-                jax.ShapeDtypeStruct((bh, tp, dp), jnp.float32),
+                jax.ShapeDtypeStruct((bh, tp, dp), in_dtype),
                 jax.ShapeDtypeStruct((bh, tp, _ROWW), jnp.float32),
             ),
             grid=grid,
@@ -250,25 +318,39 @@ def _make_flash(bh: int, t: int, d: int, causal: bool, dtype_name: str):
         )(qp, kp, vp)
         return o, lse, (qp, kp, vp)
 
+    def out_of(o, lse):
+        if with_lse:
+            return o[:, :t, :d], lse[:, :t, 0]
+        return o[:, :t, :d]
+
     @jax.custom_vjp
     def attn(q, k, v):
-        o, _, _ = fwd_call(q, k, v)
-        return o[:, :t, :d].astype(in_dtype)
+        o, lse, _ = fwd_call(q, k, v)
+        return out_of(o, lse)
 
     def vjp_fwd(q, k, v):
         o, lse, (qp, kp, vp) = fwd_call(q, k, v)
-        return o[:, :t, :d].astype(in_dtype), (qp, kp, vp, o, lse)
+        return out_of(o, lse), (qp, kp, vp, o, lse)
 
     def vjp_bwd(res, g):
         qp, kp, vp, o, lse = res
+        g_lse = None
+        if with_lse:
+            g, g_lse = g
         # dO stays in the storage dtype so the backward matmuls run the
         # MXU at native rate; delta accumulates in f32
         dop = pad_axis(pad_axis(g.astype(in_dtype), 1, tp), 2, dp)
-        delta = jnp.sum(dop.astype(jnp.float32) * o, axis=2, keepdims=True)
+        delta = jnp.sum(dop.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=2, keepdims=True)
+        if g_lse is not None:
+            # d lse / d s = P: the lse cotangent rides the same P-weighted
+            # row reduction, so it folds into delta with a minus sign
+            delta = delta - pad_axis(
+                g_lse.astype(jnp.float32), 1, tp)[..., None]
         delta = jnp.broadcast_to(delta, (bh, tp, _ROWW))
         dq = pl.pallas_call(
-            functools.partial(_dq_kernel, t, scale, causal, n_blk),
-            out_shape=jax.ShapeDtypeStruct((bh, tp, dp), jnp.float32),
+            functools.partial(_dq_kernel, block, t, scale, causal, n_blk),
+            out_shape=jax.ShapeDtypeStruct((bh, tp, dp), in_dtype),
             grid=grid,
             in_specs=[blk(outer), blk(inner), blk(inner), blk(outer),
                       row(outer), row(outer)],
@@ -277,10 +359,10 @@ def _make_flash(bh: int, t: int, d: int, causal: bool, dtype_name: str):
             interpret=use_interpret(),
         )(qp, kp, vp, dop, lse, delta)
         dk, dv = pl.pallas_call(
-            functools.partial(_dkv_kernel, t, scale, causal, n_blk),
+            functools.partial(_dkv_kernel, block, t, scale, causal, n_blk),
             out_shape=(
-                jax.ShapeDtypeStruct((bh, tp, dp), jnp.float32),
-                jax.ShapeDtypeStruct((bh, tp, dp), jnp.float32),
+                jax.ShapeDtypeStruct((bh, tp, dp), in_dtype),
+                jax.ShapeDtypeStruct((bh, tp, dp), in_dtype),
             ),
             grid=grid,
             in_specs=[blk(outer), blk(outer), blk(inner), blk(inner),
@@ -289,7 +371,7 @@ def _make_flash(bh: int, t: int, d: int, causal: bool, dtype_name: str):
             scratch_shapes=[acc_scratch, acc_scratch],
             interpret=use_interpret(),
         )(kp, vp, qp, dop, lse, delta)
-        trim = lambda x: x[:, :t, :d].astype(in_dtype)
+        trim = lambda x: x[:, :t, :d]
         return trim(dq), trim(dk), trim(dv)
 
     attn.defvjp(vjp_fwd, vjp_bwd)
@@ -306,10 +388,32 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     elsewhere).
     """
     b, t, h, d = q.shape
-    fn = _make_flash(b * h, t, d, causal, str(q.dtype))
+    fn = _make_flash(b * h, t, d, causal, str(q.dtype), _pick_block(t))
 
     def fold(x):  # [B, T, H, D] -> [B*H, T, D]
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
 
     o = fn(fold(q), fold(k), fold(v))
     return jnp.transpose(o.reshape(b, h, t, d), (0, 2, 1, 3))
+
+
+def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
+                             causal: bool = False
+                             ) -> tuple[jax.Array, jax.Array]:
+    """:func:`flash_attention` that also returns the per-row logsumexp.
+
+    ``[B, T, H, D] -> ([B, T, H, D], [B, T, H])``. Both outputs are
+    differentiable (the lse cotangent folds into the backward's delta
+    row). ``(o, lse)`` pairs from disjoint key sets merge exactly —
+    ring attention (ops/ring_attention.py) uses this as its per-block
+    compute so no rank ever materializes O(T_local^2) scores."""
+    b, t, h, d = q.shape
+    fn = _make_flash(b * h, t, d, causal, str(q.dtype), _pick_block(t),
+                     with_lse=True)
+
+    def fold(x):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
+
+    o, lse = fn(fold(q), fold(k), fold(v))
+    o = jnp.transpose(o.reshape(b, h, t, d), (0, 2, 1, 3))
+    return o, jnp.transpose(lse.reshape(b, h, t), (0, 2, 1))

@@ -128,6 +128,72 @@ def _ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B,Tq,H,D]
 
 
+def _ring_flash_local(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str, causal: bool) -> jax.Array:
+    """Per-rank body with the Pallas flash kernel as the block compute:
+    q stays resident, K/V rotate, and each (q block, K/V block) pair
+    runs :func:`flash_attention_with_lse` — so nothing O(T_local^2)
+    ever materializes on any rank and the multi-chip path inherits the
+    single-chip flash memory ceiling (per-rank attention memory is
+    O(T_local * D)). Partial results are *normalized* (o, lse) pairs
+    that merge exactly in log space; both the merge and the kernel are
+    differentiable, so ``jax.grad`` flows through the whole ring."""
+    from split_learning_tpu.ops.flash_attention import (
+        flash_attention_with_lse)
+
+    n = lax.psum(1, axis_name)
+    rank = lax.axis_index(axis_name)
+    b, t_local, h, d = q.shape
+    o0 = jnp.zeros((b, t_local, h, d), jnp.float32)
+    lse0 = jnp.full((b, t_local, h), _NEG_BIG, jnp.float32)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def merge(o1, lse1, o2, lse2):
+        m = jnp.maximum(lse1, lse2)
+        a1 = jnp.exp(lse1 - m)
+        a2 = jnp.exp(lse2 - m)
+        denom = a1 + a2
+        o = (o1 * a1[..., None]
+             + o2.astype(jnp.float32) * a2[..., None]) / denom[..., None]
+        return o, m + jnp.log(denom)
+
+    def block_attn(kb, vb, i):
+        if not causal:
+            return flash_attention_with_lse(q, kb, vb, causal=False)
+        # causal relation of the whole block decides the kernel: blocks
+        # from strictly-past ranks attend unmasked, the diagonal block
+        # masks elementwise, strictly-future blocks contribute nothing
+        # (lax.switch executes one branch — future hops cost no FLOPs)
+        src = (rank - i) % n
+
+        def past(args):
+            return flash_attention_with_lse(*args, causal=False)
+
+        def diag(args):
+            return flash_attention_with_lse(*args, causal=True)
+
+        def future(args):
+            return (jnp.zeros((b, t_local, h, d), q.dtype),
+                    jnp.full((b, t_local, h), _NEG_BIG, jnp.float32))
+
+        idx = jnp.where(src < rank, 0, jnp.where(src == rank, 1, 2))
+        return lax.switch(idx, [past, diag, future], (q, kb, vb))
+
+    def step(carry, i):
+        o, lse, kb, vb = carry
+        ob, lseb = block_attn(kb, vb, i)
+        o, lse = merge(o, lse, ob, lseb)
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return (o, lse, kb, vb), None
+
+    (o, lse, kb, vb), _ = lax.scan(
+        step, (o0, lse0, k, v), jnp.arange(n - 1))
+    ob, lseb = block_attn(kb, vb, n - 1)
+    o, _ = merge(o, lse, ob, lseb)
+    return o.astype(q.dtype)                       # already [B, Tq, H, D]
+
+
 def _ulysses_local(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis_name: str, causal: bool) -> jax.Array:
     """Per-rank body: all-to-all seq->heads, dense attention, heads->seq."""
@@ -159,17 +225,34 @@ def _sharded(mesh: Mesh, body, causal: bool, axis_name: str):
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    mesh: Optional[Mesh] = None, causal: bool = False,
-                   axis_name: str = SEQ_AXIS) -> jax.Array:
+                   axis_name: str = SEQ_AXIS,
+                   block_impl: str = "dense") -> jax.Array:
     """Sequence-parallel attention over ``mesh``'s ``seq`` axis.
 
     ``q/k/v``: global ``[B, T, H, D]`` (call from inside ``jit`` — the
     shard_map partitions them; T must divide by the seq axis size).
     Falls back to :func:`full_attention` when ``mesh`` is None or has no
     ``seq`` axis, so model code can call it unconditionally.
+
+    ``block_impl`` picks the per-block math between the ``ppermute``
+    hops: ``"dense"`` materializes each rank's O(T_local^2) score block
+    in plain XLA; ``"flash"`` streams it through the Pallas kernels
+    (:func:`...flash_attention.flash_attention_with_lse`), dropping
+    per-rank attention memory to O(T_local * D) so the multi-chip path
+    keeps the single-chip flash memory ceiling.
     """
+    if block_impl not in ("dense", "flash"):
+        raise ValueError(f"Unknown ring block_impl: {block_impl!r} "
+                         "(expected 'dense' or 'flash')")
     if mesh is None or axis_name not in mesh.axis_names:
+        if block_impl == "flash":
+            from split_learning_tpu.ops.flash_attention import (
+                flash_attention)
+            return flash_attention(q, k, v, causal=causal)
         return full_attention(q, k, v, causal=causal)
-    return _sharded(mesh, _ring_attention_local, causal, axis_name)(q, k, v)
+    body = (_ring_flash_local if block_impl == "flash"
+            else _ring_attention_local)
+    return _sharded(mesh, body, causal, axis_name)(q, k, v)
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,

@@ -1,14 +1,19 @@
 """Autoregressive generation for the causal-LM plans
 (models/transformer.py ``lm=True``).
 
-Greedy decode as one jitted program: a fixed-size token buffer and a
-``lax.scan`` over decode positions — static shapes, no Python loop over
-tokens, so XLA compiles one step function reused for every position.
-Each step re-runs the full forward on the buffer (no KV cache); causal
-masking makes the not-yet-written positions invisible to the decoded
-one, so the zero padding is inert. At the framework's model sizes the
-full re-forward is cheap; a KV cache is a later optimization, not a
-correctness need.
+Two decode programs, both single jitted scans with static shapes:
+
+- **KV-cache decode (the default)**: prefill runs the prompt once
+  through the plan with ``cache_len=total`` so every attention layer
+  returns its K/V buffers, then each generated token is one
+  single-position step against the caches (``decode_cache=``/``pos=``,
+  ``lax.dynamic_update_slice`` into the static-size cache). Per-token
+  cost is O(T·D) instead of a full O(T²·D) re-forward.
+- **Re-forward decode** (``kv_cache=False``): each step re-runs the
+  full forward on a fixed-size token buffer; causal masking makes the
+  not-yet-written positions inert. Kept as the reference
+  implementation the cache path is parity-tested against
+  (tests/test_transformer_lm.py).
 
 Works with every attention implementation the plan was built with, and
 with split ownership: generation needs the full composition
@@ -66,23 +71,78 @@ def _decode_fn(plan: SplitPlan, b: int, p: int, n_new: int,
     return run
 
 
+@functools.lru_cache(maxsize=32)
+def _kv_decode_fn(plan: SplitPlan, b: int, p: int, n_new: int,
+                  dtype_name: str, sample: bool):
+    """KV-cache decode program: prefill once, then scan single-token
+    steps over the per-layer caches. Same cache keying as
+    :func:`_decode_fn`."""
+    total = p + n_new
+    dtype = jnp.dtype(dtype_name)
+
+    @jax.jit
+    def run(params, prompt, rng, temperature):
+        def pick(row, pos):
+            if sample:
+                return jax.random.categorical(
+                    jax.random.fold_in(rng, pos), row / temperature,
+                    axis=-1).astype(dtype)
+            return jnp.argmax(row, axis=-1).astype(dtype)
+
+        # prefill: one full forward over the prompt; caches sized for
+        # the whole decode up front (static shapes under the scan)
+        x = prompt
+        caches = []
+        for st, pr in zip(plan.stages, params):
+            x, c = st.apply(pr, x, cache_len=total)
+            caches.append(c)
+        first = pick(x[:, p - 1, :], p - 1)             # token at index p
+
+        def step(carry, pos):
+            caches, tok = carry
+            x = tok[:, None]                            # [B, 1]
+            new_caches = []
+            for st, pr, c in zip(plan.stages, params, caches):
+                x, c = st.apply(pr, x, decode_cache=c, pos=pos)
+                new_caches.append(c)
+            nxt = pick(x[:, 0, :], pos)
+            return (tuple(new_caches), nxt), nxt
+
+        # step at pos writes token `tok` into the caches at index pos
+        # and emits the token for index pos + 1
+        (_, _), rest = jax.lax.scan(step, (tuple(caches), first),
+                                    p + jnp.arange(n_new - 1))
+        return jnp.concatenate(
+            [prompt, first[:, None], jnp.swapaxes(rest, 0, 1)], axis=1)
+
+    return run
+
+
 def greedy_generate(plan: SplitPlan, params: Sequence[Any],
-                    prompt: np.ndarray, n_new: int) -> jax.Array:
+                    prompt: np.ndarray, n_new: int, *,
+                    kv_cache: bool = True) -> jax.Array:
     """Extend ``prompt`` ``[B, P] int`` by ``n_new`` greedy tokens.
 
     Returns ``[B, P + n_new]``. The plan must produce per-token logits
-    ``[B, T, V]`` (an ``lm=True`` transformer plan).
+    ``[B, T, V]`` (an ``lm=True`` transformer plan). ``kv_cache=False``
+    selects the O(T²) re-forward reference path.
     """
     prompt = jnp.asarray(prompt)
+    if n_new <= 0:
+        if n_new < 0:
+            raise ValueError(f"n_new must be >= 0 (got {n_new})")
+        return prompt
     b, p = prompt.shape
     params = jax.tree_util.tree_map(jnp.asarray, list(params))
-    run = _decode_fn(plan, b, p, n_new, str(prompt.dtype), sample=False)
+    make = _kv_decode_fn if kv_cache else _decode_fn
+    run = make(plan, b, p, n_new, str(prompt.dtype), sample=False)
     return run(params, prompt, jax.random.PRNGKey(0), jnp.float32(1.0))
 
 
 def sample_generate(plan: SplitPlan, params: Sequence[Any],
                     prompt: np.ndarray, n_new: int, rng: jax.Array,
-                    temperature: float = 1.0) -> jax.Array:
+                    temperature: float = 1.0, *,
+                    kv_cache: bool = True) -> jax.Array:
     """Like :func:`greedy_generate` but samples from the softmax at
     ``temperature`` (a runtime scalar — changing it never recompiles).
 
@@ -95,7 +155,12 @@ def sample_generate(plan: SplitPlan, params: Sequence[Any],
             f"temperature must be > 0 (got {temperature}); use "
             "greedy_generate for deterministic decoding")
     prompt = jnp.asarray(prompt)
+    if n_new <= 0:
+        if n_new < 0:
+            raise ValueError(f"n_new must be >= 0 (got {n_new})")
+        return prompt
     b, p = prompt.shape
     params = jax.tree_util.tree_map(jnp.asarray, list(params))
-    run = _decode_fn(plan, b, p, n_new, str(prompt.dtype), sample=True)
+    make = _kv_decode_fn if kv_cache else _decode_fn
+    run = make(plan, b, p, n_new, str(prompt.dtype), sample=True)
     return run(params, prompt, rng, jnp.float32(temperature))

@@ -75,7 +75,7 @@ class Config:
     num_stages: int = 2       # pipeline stages (mesh "pipe" axis)
     model_parallel: int = 1   # tensor-parallel shards (mesh "model" axis)
     seq_parallel: int = 1     # context-parallel shards (mesh "seq" axis)
-    attn: str = "full"        # "full"|"flash"|"ring"|"ulysses" (transformer)
+    attn: str = "full"        # "full"|"flash"|"auto"|"ring"|"ring_flash"|"ulysses" (transformer)
     microbatches: int = 1     # GPipe microbatches per step
     remat: bool = False       # jax.checkpoint stage forwards (FLOPs for HBM)
 
@@ -139,7 +139,9 @@ class Config:
                 "(expected 'xla' or 'pallas')")
         if self.seq_parallel <= 0:
             raise ValueError("seq_parallel must be positive")
-        if self.attn not in ("full", "flash", "ring", "ulysses"):
+        if self.attn not in ("full", "flash", "auto", "ring",
+                             "ring_flash", "ulysses"):
             raise ValueError(
                 f"Unknown attn impl: {self.attn!r} "
-                "(expected 'full', 'flash', 'ring' or 'ulysses')")
+                "(expected 'full', 'flash', 'auto', 'ring', "
+                "'ring_flash' or 'ulysses')")

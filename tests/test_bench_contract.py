@@ -7,10 +7,12 @@ import json
 import os
 import subprocess
 import sys
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.mark.slow
 def test_bench_quick_prints_contract_json():
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--quick"],
@@ -36,6 +38,7 @@ def test_bench_quick_prints_contract_json():
     assert 1.5 <= fused["linearity_2x"] <= 2.6
 
 
+@pytest.mark.slow
 def test_bench_wire_and_pipelined_roles_quick():
     """The side legs the orchestrator adds in non-quick runs must at
     least produce their contract fields (run here in quick mode,

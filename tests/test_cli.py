@@ -83,6 +83,7 @@ def test_train_cli_pipelined_client_depth(tmp_path, capsys):
     assert "[done]" in out and "steps=8" in out
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("mode", ["split", "u_split"])
 def test_train_cli_pipeline(tmp_path, capsys, mode):
     """Pipeline transport over the ppermute mesh — including the U-shaped
@@ -117,6 +118,7 @@ def _stdout_losses(capsys):
             if line.startswith("[step ") and " loss:" in line}
 
 
+@pytest.mark.slow
 def test_train_cli_scan_steps_matches_stepwise(tmp_path, capsys):
     """--scan-steps chunks dispatch but must reproduce the stepwise loss
     series (incl. the stepwise tail for the final partial chunk)."""

@@ -23,6 +23,7 @@ def _train(tmp_path, ckdir, *extra):
     ("u_split", "local"),
     ("federated", "local"),
 ])
+@pytest.mark.slow
 def test_checkpoint_resume_eval(tmp_path, capsys, mode, transport):
     ck = tmp_path / "ckpt"
     assert _train(tmp_path, ck, "--mode", mode,
@@ -113,6 +114,7 @@ def test_http_resume_guard_rejects_fresh_server(tmp_path, capsys):
         server2.stop()
 
 
+@pytest.mark.slow
 def test_http_resume_both_halves(tmp_path, capsys):
     """Server checkpoints via on_step; a restarted resumed pair trains on."""
     ck_c = tmp_path / "ck_client"
@@ -147,6 +149,7 @@ def test_resume_rearms_server_handshake(tmp_path, capsys):
     assert out.count("[done]") >= 1
 
 
+@pytest.mark.slow
 def test_checkpoint_resume_eval_transformer(tmp_path, capsys):
     """The long-context family checkpoints/resumes/evals through the same
     machinery (token dataset, fused transport)."""

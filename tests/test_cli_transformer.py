@@ -6,6 +6,7 @@ import pytest
 from split_learning_tpu.launch.run import main
 
 
+@pytest.mark.slow
 def test_train_cli_transformer_dense(tmp_path, capsys):
     rc = main(["train", "--mode", "split", "--transport", "fused",
                "--model", "transformer", "--dataset", "tokens",
@@ -15,6 +16,7 @@ def test_train_cli_transformer_dense(tmp_path, capsys):
     assert "[done]" in capsys.readouterr().out
 
 
+@pytest.mark.slow
 def test_train_cli_transformer_ring_seq_parallel(tmp_path, capsys):
     """--seq-parallel 4 --attn ring: the fused trainer shards the token
     sequence over the mesh's seq axis (8 virtual devices: 2 data x 4 seq)."""
@@ -39,6 +41,7 @@ def test_train_cli_attn_warns_on_non_transformer(tmp_path, capsys):
     assert "ignored" in err and "attn" in err
 
 
+@pytest.mark.slow
 def test_train_cli_seq_parallel_warns_on_mpmd_transport(tmp_path, capsys):
     rc = main(["train", "--mode", "split", "--transport", "local",
                "--model", "transformer", "--dataset", "tokens",

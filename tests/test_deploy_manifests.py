@@ -185,3 +185,91 @@ def test_store_from_config_uses_the_same_env_surface():
     else:  # boto3 present: it must be a real S3Store on that endpoint
         from split_learning_tpu.data import S3Store
         assert isinstance(store, S3Store)
+
+
+def test_probe_ports_match_container_ports():
+    """kubeconform-style check (round-3 VERDICT next #8): every
+    liveness/readiness/startup probe must target a port the same
+    container actually declares — a probe on a dead port passes schema
+    validation and then CrashLoops in-cluster."""
+    checked = 0
+    for name, doc in _by_kind("Deployment") + _by_kind("StatefulSet"):
+        for c in _pod_spec(doc)["containers"]:
+            declared = set()
+            for p in c.get("ports", []):
+                declared.add(p["containerPort"])
+                if "name" in p:
+                    declared.add(p["name"])
+            for kind in ("livenessProbe", "readinessProbe", "startupProbe"):
+                probe = c.get(kind)
+                if not probe:
+                    continue
+                target = (probe.get("httpGet") or probe.get("tcpSocket")
+                          or {}).get("port")
+                if target is None:
+                    continue  # exec probe: no port to check
+                checked += 1
+                assert target in declared, (
+                    f"{name}: {doc['metadata']['name']}/{c['name']} "
+                    f"{kind} targets port {target!r} but the container "
+                    f"declares {sorted(map(str, declared))}")
+    assert checked >= 3
+
+
+def test_pod_env_names_are_consumed_by_config():
+    """Every app-config env var the training pods set (including the
+    commented-out S3 block, which users are told to uncomment) must be a
+    name Config.from_env actually reads — a typo'd SLT_* var silently
+    configures nothing."""
+    from split_learning_tpu.utils.config import _ENV_MAP
+
+    consumed = set(_ENV_MAP.values())
+    # read by the MLflow client library, not by Config
+    library_env = {"MLFLOW_S3_ENDPOINT_URL"}
+    path = os.path.join(DEPLOY, "split-learning.yaml")
+    with open(path) as f:
+        text = f.read()
+    # commented env entries are part of the documented surface too
+    names = set(re.findall(
+        r"^\s*#?\s*- name:\s*([A-Z][A-Z0-9_]+)\s*$", text, re.M))
+    app_names = {n for n in names
+                 if n.startswith("SLT_") or n in ("LEARNING_MODE",
+                                                  "MLFLOW_TRACKING_URI",
+                                                  "S3_ENDPOINT_URL",
+                                                  "AWS_ACCESS_KEY_ID",
+                                                  "AWS_SECRET_ACCESS_KEY")
+                 or n in library_env}
+    assert len(app_names) >= 5
+    for n in app_names - library_env:
+        assert n in consumed, (
+            f"split-learning.yaml sets env {n} which Config.from_env "
+            f"never reads (known names: {sorted(consumed)})")
+
+
+def test_pvc_references_resolve():
+    """Every persistentVolumeClaim.claimName in a pod spec must resolve
+    to a PVC document or a StatefulSet volumeClaimTemplate in the same
+    namespace."""
+    defined = set()
+    for _, d in DOCS:
+        if d.get("kind") == "PersistentVolumeClaim":
+            defined.add((d["metadata"].get("namespace"),
+                         d["metadata"]["name"]))
+    for _, d in _by_kind("StatefulSet"):
+        ns = d["metadata"].get("namespace")
+        for tmpl in d["spec"].get("volumeClaimTemplates", []):
+            # pods see <template-name>-<sts-name>-<ordinal>; record the
+            # template prefix for the sts's own volumes
+            defined.add((ns, tmpl["metadata"]["name"]))
+    checked = 0
+    for name, doc in _by_kind("Deployment") + _by_kind("StatefulSet") + \
+            _by_kind("Job"):
+        ns = doc["metadata"].get("namespace")
+        for vol in _pod_spec(doc).get("volumes", []):
+            claim = vol.get("persistentVolumeClaim", {}).get("claimName")
+            if claim:
+                checked += 1
+                assert (ns, claim) in defined, (
+                    f"{name}: {doc['metadata']['name']} mounts PVC "
+                    f"{claim} which is not defined in namespace {ns}")
+    assert checked >= 1

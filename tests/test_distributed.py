@@ -92,6 +92,7 @@ def test_global_mesh_runs_a_step(devices):
     assert np.isfinite(trainer.train_step(x, y))
 
 
+@pytest.mark.slow
 def test_two_process_dp_step_over_gloo():
     """The multi-host path, actually multi-process: two OS processes (2
     virtual CPU devices each) join via jax.distributed through the same

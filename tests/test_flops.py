@@ -69,6 +69,7 @@ def test_scan_multiplies_by_trip_count(cnn):
     assert got == T * _fwd_flops_by_hand(B)
 
 
+@pytest.mark.slow
 def test_resnet_flops_positive_and_batch_linear():
     plan = get_plan(model="resnet18", mode="split")
     x1 = jnp.zeros((2, 32, 32, 3), jnp.float32)

@@ -12,6 +12,7 @@ from split_learning_tpu.runtime import ServerRuntime, SplitClientTrainer
 from split_learning_tpu.runtime.fused import FusedSplitTrainer
 from split_learning_tpu.transport import LocalTransport
 from split_learning_tpu.utils import Config
+import pytest
 
 SEED = 3
 BATCH = 32
@@ -43,6 +44,7 @@ def test_fused_equals_transport_path():
     np.testing.assert_allclose(fused_losses, mpmd_losses, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_train_epoch_scan_matches_stepwise():
     """T steps under one lax.scan dispatch == T individual train_step
     dispatches (the jit-once/scan-many throughput path)."""
@@ -70,6 +72,7 @@ def test_train_epoch_scan_matches_stepwise():
         jax.device_get(scanned.state.params))
 
 
+@pytest.mark.slow
 def test_train_epoch_scan_on_dp_mesh(devices):
     """Scanned steps with the batch axis sharded over 4 clients."""
     cfg = Config(mode="split", batch_size=BATCH, num_clients=4)

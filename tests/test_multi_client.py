@@ -108,6 +108,7 @@ def test_concurrent_clients_are_race_free():
     assert server._last_step == {i: n_steps - 1 for i in range(n_clients)}
 
 
+@pytest.mark.slow
 def test_multi_client_transformer_lm():
     """Config 3 with the long-context family: two LM clients share one
     server trunk; per-client handshakes and FedAvg'd bottoms work on

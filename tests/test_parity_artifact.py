@@ -43,6 +43,11 @@ def test_artifact_covers_reference_workload(artifact):
     assert meta["batch"] == 64 and meta["lr"] == 0.01 and meta["epochs"] == 3
     assert meta["n_train"] == 60_000
     assert meta["total_steps"] == 2_814
+    if meta["dataset"] != "mnist":
+        # synthetic must be provably forced: the artifact carries the
+        # real-data download attempt and its error (VERDICT r3 missing #1)
+        attempt = meta["attempted_real_data"]
+        assert attempt["attempted"] is True and attempt["error"]
     for name in ("monolithic", "fused", "http"):
         assert name in curves, f"variant {name} missing"
         assert len(curves[name]["losses"]) == meta["total_steps"]

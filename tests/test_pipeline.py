@@ -24,6 +24,7 @@ def batches():
             for _ in range(N_STEPS)]
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("microbatches", [1, 4])
 def test_two_stage_pipeline_matches_fused(devices, microbatches):
     """Config 2: split CNN as a 2-stage ppermute pipeline == fused single
@@ -48,6 +49,7 @@ def test_two_stage_pipeline_matches_fused(devices, microbatches):
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_three_stage_u_pipeline(devices):
     """Config 5 on the mesh: the U-shaped plan as a 3-stage pipeline."""
     cfg = Config(mode="u_split", batch_size=BATCH, microbatches=2)
@@ -64,6 +66,7 @@ def test_three_stage_u_pipeline(devices):
     np.testing.assert_allclose(losses, ref_losses, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_with_data_parallel(devices):
     """Configs 2+3 composed: 2 data rows x 2 pipe stages on 4 devices."""
     cfg = Config(mode="split", batch_size=BATCH, num_clients=2, microbatches=2)

@@ -97,6 +97,7 @@ def test_artifact_throughput_tracks_bubble(pipeline_artifact):
                 config["model"], rec["microbatches_M"])
 
 
+@pytest.mark.slow
 def test_artifact_hop_padding_matches_plan(pipeline_artifact):
     """Re-derive the flat-buffer padding from a live PipelinedTrainer and
     require the committed artifact to agree (the artifact must never

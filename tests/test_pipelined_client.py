@@ -66,6 +66,7 @@ def test_depth1_equals_synchronous_loop():
                                    rtol=1e-6, atol=1e-7)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("depth", [2, 4])
 def test_bounded_staleness_converges(depth):
     """Async SGD with delay < depth still learns the learnable task, and
@@ -89,6 +90,7 @@ def test_bounded_staleness_converges(depth):
         losses[:10], losses[-10:])
 
 
+@pytest.mark.slow
 def test_http_lanes_run_concurrently():
     """W HttpTransport lanes against one strict_steps=False HTTP server:
     all steps complete, loss finite, and the server saw every step."""
@@ -138,6 +140,7 @@ def test_fault_mid_window_raises_and_quiesces():
     piped.close()  # must join lanes without hanging
 
 
+@pytest.mark.slow
 def test_checkpoint_cli_resume_with_depth(tmp_path, capsys):
     """--pipeline-depth composes with checkpoint/resume: the window
     drains at each epoch boundary, so the saved joint state is quiesced

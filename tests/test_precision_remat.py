@@ -16,6 +16,7 @@ from split_learning_tpu.parallel import make_mesh
 from split_learning_tpu.parallel.pipeline import PipelinedTrainer
 from split_learning_tpu.runtime.fused import FusedSplitTrainer
 from split_learning_tpu.utils import Config
+import pytest
 
 SEED = 11
 BATCH = 32
@@ -44,6 +45,7 @@ def test_remat_fused_matches_exact():
                                atol=1e-6)
 
 
+@pytest.mark.slow
 def test_remat_pipeline_matches_exact(devices):
     """Remat through the GPipe scan + ppermute pipeline (config 2 mesh)."""
     plan = get_plan(mode="split")
@@ -81,6 +83,7 @@ def test_bf16_compute_keeps_f32_params_and_learns():
     assert last < first * 0.7, (first, last)
 
 
+@pytest.mark.slow
 def test_bf16_pipeline_trains(devices):
     """bf16 compute through the GPipe ppermute pipeline: the cut-layer
     buffer rides in bf16 (half the ICI bytes) and the loss still falls.

@@ -57,6 +57,7 @@ def test_resnet18_stage_variants(rng):
         get_plan(model="resnet18_4stage", mode="federated")
 
 
+@pytest.mark.slow
 def test_resnet18_4stage_pipeline_matches_fused(devices):
     """Config 4: 4-stage GPipe over a 4-device pipe mesh == monolithic."""
     cfg = Config(mode="split", batch_size=BATCH, microbatches=2)

@@ -65,6 +65,7 @@ def test_u_split_composition_matches_2party():
                                atol=1e-6)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("attn", ["ring", "ulysses"])
 def test_seq_parallel_training_matches_dense(devices, attn):
     """The flagship long-context property: a (2 data x 4 seq) mesh with
@@ -105,6 +106,7 @@ def test_split_transport_loop_runs():
     np.testing.assert_allclose(l_split, l_fused, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_long_sequence_sharded_memory_shape(devices):
     """Ring attention never materializes the T x T score matrix: per-rank
     peak attention buffer is [B, H, T_local, T_local]. Check it compiles
@@ -128,6 +130,7 @@ def test_bad_attn_impl_raises():
         transformer_plan(attn="blocksparse")
 
 
+@pytest.mark.slow
 def test_u_split_transformer_gpipe_pipeline(devices):
     """The GPipe ppermute pipeline carries the transformer plan: integer
     tokens ride the float cut buffer and are restored for nn.Embed. A
@@ -149,6 +152,7 @@ def test_u_split_transformer_gpipe_pipeline(devices):
         np.testing.assert_allclose(lp, lf, atol=5e-5, rtol=5e-5)
 
 
+@pytest.mark.slow
 def test_bf16_pipeline_preserves_large_token_ids(devices):
     """bf16 represents integers exactly only up to 256. Token ids ride
     the raw injection stream (never the cut buffer), so vocab > 256 ids
@@ -175,6 +179,7 @@ def test_bf16_pipeline_preserves_large_token_ids(devices):
     np.testing.assert_allclose(lp, lf, atol=5e-3, rtol=5e-3)
 
 
+@pytest.mark.slow
 def test_split_transformer_over_http_wire():
     """The [B, T, E] cut tensor and int32 token labels ride the msgpack
     wire unchanged — the HTTP transport is family-agnostic too."""

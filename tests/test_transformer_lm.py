@@ -48,6 +48,7 @@ def test_lm_head_shapes_and_causality():
     assert np.abs(logits[:, t0 + 1:] - logits2[:, t0 + 1:]).max() > 1e-3
 
 
+@pytest.mark.slow
 def test_lm_trains_below_unigram_entropy(lm_data):
     """The model must learn to USE context: its next-token loss must end
     below the empirical unigram cross-entropy — the best any
@@ -69,6 +70,7 @@ def test_lm_trains_below_unigram_entropy(lm_data):
     assert min(losses[-5:]) < unigram_ce - 0.2
 
 
+@pytest.mark.slow
 def test_lm_ring_seq_parallel_matches_dense(devices, lm_data):
     """Causal ring attention under (2 data x 4 seq) reproduces the
     single-device LM loss series — the long-context training config."""
@@ -88,6 +90,7 @@ def test_lm_ring_seq_parallel_matches_dense(devices, lm_data):
                                    atol=5e-5, rtol=5e-5)
 
 
+@pytest.mark.slow
 def test_lm_u_split_pipeline_matches_fused(devices, lm_data):
     """The GPipe pipeline carries per-token [T, V] logits in its logits
     slot (generalized from the classifier's [C])."""
@@ -105,6 +108,7 @@ def test_lm_u_split_pipeline_matches_fused(devices, lm_data):
                                atol=5e-5, rtol=5e-5)
 
 
+@pytest.mark.slow
 def test_lm_cli_end_to_end(tmp_path, capsys):
     from split_learning_tpu.launch.run import main
     rc = main(["train", "--mode", "split", "--transport", "fused",
@@ -117,6 +121,7 @@ def test_lm_cli_end_to_end(tmp_path, capsys):
     assert "[done]" in out and "accuracy" in out
 
 
+@pytest.mark.slow
 def test_greedy_generate_self_consistent(lm_data):
     """Greedy decode invariants: the prompt is preserved verbatim, and
     re-running the forward on the finished sequence reproduces every
@@ -137,6 +142,53 @@ def test_greedy_generate_self_consistent(lm_data):
             np.argmax(logits[:, pos - 1], axis=-1), out[:, pos])
 
 
+def test_kv_cache_decode_matches_reforward_tiny(lm_data):
+    """Core-tier KV sanity: tiny model, greedy only, one plan shape —
+    the full cross-mode/sampled matrix lives in the slow tier below."""
+    from split_learning_tpu.runtime.generate import greedy_generate
+
+    plan = transformer_plan(lm=True, vocab=V, d_model=16, num_heads=1,
+                            client_depth=1, server_depth=1, max_len=64)
+    prompt = lm_data.train.x[:2, :5]
+    params = plan.init(jax.random.PRNGKey(4), prompt)
+    ref = np.asarray(greedy_generate(plan, params, prompt, 4,
+                                     kv_cache=False))
+    kv = np.asarray(greedy_generate(plan, params, prompt, 4,
+                                    kv_cache=True))
+    np.testing.assert_array_equal(kv, ref)
+
+
+@pytest.mark.slow
+def test_kv_cache_decode_matches_reforward(lm_data):
+    """The KV-cache decode program (prefill + per-token cached steps) is
+    token-exact against the O(T^2) re-forward reference path, greedy and
+    sampled, on both plan shapes."""
+    from split_learning_tpu.runtime.generate import (greedy_generate,
+                                                     sample_generate)
+
+    prompt = lm_data.train.x[:3, :9]
+    for mode in ("split", "u_split"):
+        plan = transformer_plan(mode=mode, lm=True)
+        params = plan.init(jax.random.PRNGKey(2), prompt)
+        ref = np.asarray(greedy_generate(plan, params, prompt, 7,
+                                         kv_cache=False))
+        kv = np.asarray(greedy_generate(plan, params, prompt, 7,
+                                        kv_cache=True))
+        np.testing.assert_array_equal(kv, ref)
+        rs = np.asarray(sample_generate(plan, params, prompt, 7,
+                                        jax.random.PRNGKey(5), 0.7,
+                                        kv_cache=False))
+        ks = np.asarray(sample_generate(plan, params, prompt, 7,
+                                        jax.random.PRNGKey(5), 0.7,
+                                        kv_cache=True))
+        np.testing.assert_array_equal(ks, rs)
+        # n_new=1: the scan body runs zero times
+        one = np.asarray(greedy_generate(plan, params, prompt, 1))
+        np.testing.assert_array_equal(one[:, :-1], prompt)
+        np.testing.assert_array_equal(one, ref[:, :prompt.shape[1] + 1])
+
+
+@pytest.mark.slow
 def test_greedy_generate_learns_chain_transitions(lm_data):
     """After training, generation follows the chain: a decent fraction
     of generated tokens are the true modal successor of their
