@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from split_learning_tpu.core.stage import SplitPlan, from_flax
+from split_learning_tpu.ops.common import NEG_BIG as _NEG_BIG
 from split_learning_tpu.ops.flash_attention import (
     flash_attention, select_attention)
 from split_learning_tpu.ops.ring_attention import (
@@ -47,7 +48,7 @@ def _decode_attention(q, ck, cv, pos, scale):
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    ck.astype(jnp.float32)) * scale
     keys = jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
-    s = jnp.where(keys <= pos, s, -jnp.inf)
+    s = jnp.where(keys <= pos, s, _NEG_BIG)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p,
                       cv.astype(jnp.float32)).astype(cv.dtype)

@@ -177,6 +177,26 @@ def make_logger(cfg: Config, run_name: Optional[str] = None) -> MetricLogger:
             return MlflowLogger(cfg.mode, tracking_uri=cfg.tracking_uri,
                                 run_name=run_name)
         except ImportError:
+            if cfg.tracking_uri and cfg.tracking_uri.startswith(
+                    ("http://", "https://")):
+                # the package is absent but a server URI is configured:
+                # speak the MLflow REST protocol directly (mlflow_rest.py)
+                from split_learning_tpu.tracking.mlflow_rest import (
+                    MlflowRestLogger)
+                try:
+                    logger = MlflowRestLogger(
+                        cfg.mode, tracking_uri=cfg.tracking_uri,
+                        run_name=run_name)
+                    print("[tracking] mlflow package unavailable; using "
+                          "the REST protocol directly", file=sys.stderr)
+                    return logger
+                except OSError as e:
+                    # unreachable server must not abort training — same
+                    # graceful degradation the package path always had
+                    print(f"[tracking] MLflow server {cfg.tracking_uri} "
+                          f"unreachable ({e}); falling back to stdout",
+                          file=sys.stderr)
+                    return StdoutLogger()
             # graceful off-cluster degradation, loudly
             print("[tracking] mlflow unavailable; falling back to stdout",
                   file=sys.stderr)
