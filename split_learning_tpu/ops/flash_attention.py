@@ -19,10 +19,19 @@ Design (the canonical TPU flash schedule):
 - Forward saves only O and the per-row logsumexp (LSE).
 - Backward is the two-kernel flash split: dQ grids over (query, key)
   blocks, dK/dV over (key, query) blocks, each recomputing P blockwise
-  from (Q, K, LSE) — the FLOPs-for-HBM trade. This costs ~1.8x the
-  dense backward's matmul FLOPs, so at compute-bound shapes (large B,
-  modest T) the dense path is faster; flash's win is the memory
-  ceiling and the long-T regime (see BASELINE.md long-context rows).
+  from (Q, K, LSE) — the FLOPs-for-HBM trade. Total backward matmul
+  work is 14 units of T^2*D vs dense's 8 (1.75x): each kernel re-does
+  scores (2) and dO*V^T (2) plus its own products. A fused single-pass
+  backward (10 units) was analyzed and rejected for the regime flash
+  actually serves (long T, via ``attn="auto"``): with a (key, query)
+  grid, dK/dV accumulate fine in VMEM scratch but dQ blocks are
+  revisited *non-consecutively*, which Pallas TPU output revisiting
+  does not support; dQ-partials with a leading key-block axis (the
+  splash-attention fused form) cost O(n_k * T * D) HBM — ~17 GiB at
+  T=16384/bh=32, over the chip; and carrying whole dK/dV per bh in
+  scratch needs 2*T*D*4 bytes = 16 MiB at T=16k, the entire VMEM. So
+  the 1.75x recompute is a deliberate floor, and ``attn="auto"`` keeps
+  dense (which is faster while it fits) the default below the HBM wall.
 - Causal masking uses global block coordinates; block pairs with no
   causal overlap skip their matmuls entirely (``pl.when`` around the
   accumulate — the grid stays static, ~2x fewer FLOPs at large T), and

@@ -195,8 +195,10 @@ def _ring_flash_local(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 def _ulysses_local(q: jax.Array, k: jax.Array, v: jax.Array,
-                   axis_name: str, causal: bool) -> jax.Array:
-    """Per-rank body: all-to-all seq->heads, dense attention, heads->seq."""
+                   axis_name: str, causal: bool,
+                   use_flash: bool = False) -> jax.Array:
+    """Per-rank body: all-to-all seq->heads, per-head attention over the
+    full sequence (dense or the flash kernel), heads->seq."""
     n = lax.psum(1, axis_name)
     if q.shape[2] % n != 0:
         raise ValueError(
@@ -206,59 +208,115 @@ def _ulysses_local(q: jax.Array, k: jax.Array, v: jax.Array,
     gather = functools.partial(lax.all_to_all, axis_name=axis_name,
                                split_axis=2, concat_axis=1, tiled=True)
     qg, kg, vg = gather(q), gather(k), gather(v)
-    og = full_attention(qg, kg, vg, causal=causal)
+    if use_flash:
+        from split_learning_tpu.ops.flash_attention import flash_attention
+        og = flash_attention(qg, kg, vg, causal=causal)
+    else:
+        og = full_attention(qg, kg, vg, causal=causal)
     # [B, T, H/n, D] -> [B, T/n, H, D]
     return lax.all_to_all(og, axis_name=axis_name, split_axis=1,
                           concat_axis=2, tiled=True)
 
 
-def _sharded(mesh: Mesh, body, causal: bool, axis_name: str):
+def _sharded(mesh: Mesh, body, causal: bool, axis_name: str, **body_kw):
     spec_axes = [None, axis_name, None, None]
     if DATA_AXIS in mesh.axis_names:
         spec_axes[0] = DATA_AXIS
     spec = P(*spec_axes)
     return shard_map(
-        functools.partial(body, axis_name=axis_name, causal=causal),
+        functools.partial(body, axis_name=axis_name, causal=causal,
+                          **body_kw),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_rep=False)
+
+
+def _resolve_block_impl(block_impl: str, b: int, t_q: int, t_kv: int,
+                        h: int, itemsize: int) -> str:
+    """``"auto"`` resolution for the parallel forms: the HBM-residency
+    rule of single-device ``attn="auto"``, applied to what one rank's
+    *backward* actually retains. For the dense ring body that is the
+    scan residuals over every hop — f32 scores + probabilities per hop,
+    i.e. O(B_local * H * T_local * T_global) total (``t_kv`` = global
+    T); for ulysses it is the gathered [B_local, H/n, T, T] block.
+    ``b`` must already be the per-rank batch."""
+    if block_impl != "auto":
+        return block_impl
+    import os
+    env = os.environ.get("SLT_FLASH_AUTO_T")
+    if env:
+        return "flash" if max(t_q, t_kv) >= int(env) else "dense"
+    from split_learning_tpu.ops.flash_attention import _device_hbm_bytes
+    resident = 3 * b * h * t_q * t_kv * itemsize
+    return "flash" if resident > _device_hbm_bytes() // 2 else "dense"
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    mesh: Optional[Mesh] = None, causal: bool = False,
                    axis_name: str = SEQ_AXIS,
-                   block_impl: str = "dense") -> jax.Array:
+                   block_impl: str = "auto") -> jax.Array:
     """Sequence-parallel attention over ``mesh``'s ``seq`` axis.
 
     ``q/k/v``: global ``[B, T, H, D]`` (call from inside ``jit`` — the
     shard_map partitions them; T must divide by the seq axis size).
-    Falls back to :func:`full_attention` when ``mesh`` is None or has no
-    ``seq`` axis, so model code can call it unconditionally.
+    Falls back to single-device attention when ``mesh`` is None or has
+    no ``seq`` axis, so model code can call it unconditionally.
 
     ``block_impl`` picks the per-block math between the ``ppermute``
     hops: ``"dense"`` materializes each rank's O(T_local^2) score block
     in plain XLA; ``"flash"`` streams it through the Pallas kernels
     (:func:`...flash_attention.flash_attention_with_lse`), dropping
     per-rank attention memory to O(T_local * D) so the multi-chip path
-    keeps the single-chip flash memory ceiling.
+    keeps the single-chip flash memory ceiling; ``"auto"`` (default)
+    picks per shape — dense while a rank's score block fits comfortably
+    in HBM, flash beyond.
     """
-    if block_impl not in ("dense", "flash"):
+    if block_impl not in ("dense", "flash", "auto"):
         raise ValueError(f"Unknown ring block_impl: {block_impl!r} "
-                         "(expected 'dense' or 'flash')")
+                         "(expected 'dense', 'flash' or 'auto')")
+    b, t, h, _ = q.shape
+    itemsize = jnp.dtype(q.dtype).itemsize
     if mesh is None or axis_name not in mesh.axis_names:
-        if block_impl == "flash":
+        impl = _resolve_block_impl(block_impl, b, t, t, h, itemsize)
+        if impl == "flash":
             from split_learning_tpu.ops.flash_attention import (
                 flash_attention)
             return flash_attention(q, k, v, causal=causal)
         return full_attention(q, k, v, causal=causal)
-    body = (_ring_flash_local if block_impl == "flash"
+    t_local = t // mesh.shape[axis_name]
+    b_local = b // mesh.shape.get(DATA_AXIS, 1) or 1
+    # the dense body's scan residuals are f32 regardless of input dtype
+    impl = _resolve_block_impl(block_impl, b_local, t_local, t, h, 4)
+    body = (_ring_flash_local if impl == "flash"
             else _ring_attention_local)
     return _sharded(mesh, body, causal, axis_name)(q, k, v)
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       mesh: Optional[Mesh] = None, causal: bool = False,
-                      axis_name: str = SEQ_AXIS) -> jax.Array:
-    """All-to-all (DeepSpeed-Ulysses form) sequence-parallel attention."""
+                      axis_name: str = SEQ_AXIS,
+                      block_impl: str = "auto") -> jax.Array:
+    """All-to-all (DeepSpeed-Ulysses form) sequence-parallel attention.
+
+    After the seq->heads transpose each rank runs full-sequence
+    attention over H/n heads; ``block_impl`` picks that math (dense /
+    flash kernels / ``"auto"`` per shape — without flash the per-rank
+    score block is O(B * H/n * T^2), so long-context ulysses needs it).
+    """
+    if block_impl not in ("dense", "flash", "auto"):
+        raise ValueError(f"Unknown ulysses block_impl: {block_impl!r} "
+                         "(expected 'dense', 'flash' or 'auto')")
+    b, t, h, _ = q.shape
+    itemsize = jnp.dtype(q.dtype).itemsize
     if mesh is None or axis_name not in mesh.axis_names:
+        impl = _resolve_block_impl(block_impl, b, t, t, h, itemsize)
+        if impl == "flash":
+            from split_learning_tpu.ops.flash_attention import (
+                flash_attention)
+            return flash_attention(q, k, v, causal=causal)
         return full_attention(q, k, v, causal=causal)
-    return _sharded(mesh, _ulysses_local, causal, axis_name)(q, k, v)
+    n = mesh.shape[axis_name]
+    b_local = b // mesh.shape.get(DATA_AXIS, 1) or 1
+    impl = _resolve_block_impl(block_impl, b_local, t, t,
+                               max(h // n, 1), itemsize)
+    return _sharded(mesh, _ulysses_local, causal, axis_name,
+                    use_flash=impl == "flash")(q, k, v)

@@ -37,6 +37,14 @@ def experiment_name(mode: str) -> str:
     return f"{base.capitalize()}_Learning_Sim"
 
 
+def default_run_name(mode: str) -> str:
+    """≡ f"{mode.capitalize()}_Training" (src/server_part.py:23), with
+    the same u_split aliasing as :func:`experiment_name` — the single
+    home of the reference's run-naming rule for every MLflow backend."""
+    base = "split" if mode == "u_split" else mode
+    return f"{base.capitalize()}_Training"
+
+
 class MetricLogger:
     def log_metric(self, key: str, value: float, step: int) -> None:
         raise NotImplementedError
@@ -116,11 +124,10 @@ class MlflowLogger(MetricLogger):
         if tracking_uri:
             mlflow.set_tracking_uri(tracking_uri)
         mlflow.set_experiment(experiment_name(mode))
-        base = "split" if mode == "u_split" else mode
         # run per training lifetime ≡ src/server_part.py:23, but closed
         # properly by close()
         self._run = mlflow.start_run(
-            run_name=run_name or f"{base.capitalize()}_Training")
+            run_name=run_name or default_run_name(mode))
 
     def log_metric(self, key: str, value: float, step: int) -> None:
         self._mlflow.log_metric(key, value, step=step)
@@ -190,12 +197,14 @@ def make_logger(cfg: Config, run_name: Optional[str] = None) -> MetricLogger:
                     print("[tracking] mlflow package unavailable; using "
                           "the REST protocol directly", file=sys.stderr)
                     return logger
-                except OSError as e:
-                    # unreachable server must not abort training — same
-                    # graceful degradation the package path always had
+                except (OSError, ValueError, KeyError) as e:
+                    # an unreachable OR misbehaving server (non-JSON
+                    # body, unexpected response shape) must not abort
+                    # training — same graceful degradation the package
+                    # path always had
                     print(f"[tracking] MLflow server {cfg.tracking_uri} "
-                          f"unreachable ({e}); falling back to stdout",
-                          file=sys.stderr)
+                          f"unusable ({type(e).__name__}: {e}); falling "
+                          f"back to stdout", file=sys.stderr)
                     return StdoutLogger()
             # graceful off-cluster degradation, loudly
             print("[tracking] mlflow unavailable; falling back to stdout",

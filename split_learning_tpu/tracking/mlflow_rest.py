@@ -28,7 +28,8 @@ import urllib.error
 import urllib.request
 from typing import Any, Dict, Optional
 
-from split_learning_tpu.tracking.logger import MetricLogger, experiment_name
+from split_learning_tpu.tracking.logger import (
+    MetricLogger, default_run_name, experiment_name)
 
 
 class MlflowRestLogger(MetricLogger):
@@ -51,10 +52,9 @@ class MlflowRestLogger(MetricLogger):
         self._send_failures = 0
         exp_name = experiment_name(mode)
         exp_id = self._experiment_id(exp_name)
-        base = "split" if mode == "u_split" else mode
         run = self._post("runs/create", {
             "experiment_id": exp_id,
-            "run_name": run_name or f"{base.capitalize()}_Training",
+            "run_name": run_name or default_run_name(mode),
             "start_time": int(time.time() * 1000),
         })
         self._run_id = run["run"]["info"]["run_id"]
@@ -76,8 +76,16 @@ class MlflowRestLogger(MetricLogger):
         except urllib.error.HTTPError as e:
             if e.code not in (400, 404):  # 404: not found; 400: older servers
                 raise
-        return self._post("experiments/create", {"name": name})[
-            "experiment_id"]
+        try:
+            return self._post("experiments/create", {"name": name})[
+                "experiment_id"]
+        except urllib.error.HTTPError:
+            # get-or-create race: another client created it between our
+            # two calls (RESOURCE_ALREADY_EXISTS) — re-read, it must
+            # exist now
+            got = self._post("experiments/get-by-name",
+                             {"experiment_name": name})
+            return got["experiment"]["experiment_id"]
 
     def _post_safe(self, path: str, body: Dict[str, Any]) -> None:
         """Per-step sends must not kill a training run on a transient
@@ -87,7 +95,9 @@ class MlflowRestLogger(MetricLogger):
         try:
             self._post(path, body)
             self._send_failures = 0
-        except OSError as e:
+        except (OSError, ValueError, KeyError) as e:
+            # OSError: network/HTTP; ValueError: non-JSON body from a
+            # misbehaving endpoint; KeyError: unexpected response shape
             self._send_failures += 1
             if self._send_failures <= self._WARN_LIMIT:
                 more = (" (suppressing further warnings)"

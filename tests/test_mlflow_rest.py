@@ -154,4 +154,4 @@ def test_unreachable_server_degrades_to_stdout(capsys):
                  tracking_uri="http://127.0.0.1:9")  # discard port: refused
     lg = make_logger(cfg)
     assert isinstance(lg, StdoutLogger)
-    assert "unreachable" in capsys.readouterr().err
+    assert "unusable" in capsys.readouterr().err

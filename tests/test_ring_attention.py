@@ -137,3 +137,34 @@ def test_ring_block_impl_validated():
     with pytest.raises(ValueError, match="block_impl"):
         ring_attention(jnp.zeros((1, 8, 1, 8)), jnp.zeros((1, 8, 1, 8)),
                        jnp.zeros((1, 8, 1, 8)), block_impl="bogus")
+
+
+def test_ulysses_flash_matches_dense(devices, qkv):
+    """Ulysses with the flash kernel as the per-head full-sequence math:
+    exact vs dense (the long-context ulysses path)."""
+    q, k, v = qkv
+    mesh = seq_mesh(devices)
+    got = jax.jit(lambda a, b, c: ulysses_attention(
+        a, b, c, mesh=mesh, causal=True, block_impl="flash"))(q, k, v)
+    want = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_parallel_auto_block_impl_resolution(monkeypatch):
+    """block_impl='auto' maps the same HBM rule onto the shapes a rank
+    actually materializes: tiny test shards stay dense; a long-context
+    shard (via the SLT_FLASH_AUTO_T override) selects flash."""
+    from split_learning_tpu.ops.ring_attention import _resolve_block_impl
+
+    assert _resolve_block_impl("dense", 4, 1 << 20, 1 << 20, 4, 4) == "dense"
+    assert _resolve_block_impl("flash", 4, 8, 8, 4, 4) == "flash"
+    assert _resolve_block_impl("auto", 4, 32, 32, 4, 4) == "dense"
+    big = 1 << 20  # 3*4*4*T_q*T_kv*4 bytes >> any HBM
+    assert _resolve_block_impl("auto", 4, big, big, 4, 4) == "flash"
+    # the ring backward retains residuals over ALL hops: T_kv is global,
+    # so a modest per-rank T still trips the wall when T_global is huge
+    assert _resolve_block_impl("auto", 16, 4096, 1 << 22, 2, 4) == "flash"
+    monkeypatch.setenv("SLT_FLASH_AUTO_T", "256")
+    assert _resolve_block_impl("auto", 4, 256, 256, 4, 4) == "flash"
+    assert _resolve_block_impl("auto", 4, 128, 128, 4, 4) == "dense"
