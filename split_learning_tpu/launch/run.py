@@ -619,14 +619,33 @@ def cmd_eval(args) -> int:
     params = _assemble_full_params(meta["layout"], raw)
     from split_learning_tpu.data import store_from_config as _sfc
     ds = load_dataset(dataset, cfg.data_dir, store=_sfc(cfg))
-    res = evaluate(plan, params, ds.test, batch_size=cfg.batch_size)
-    print(json.dumps({"checkpoint_step": step, "dataset": dataset,
-                      "accuracy": round(res["accuracy"], 4),
-                      "loss": round(res["loss"], 4),
-                      "perplexity": (None if res["perplexity"] is None
-                                     else round(res["perplexity"], 4)),
-                      "examples": res["examples"],
-                      "predictions": res["predictions"]}))
+    record = {"checkpoint_step": step, "dataset": dataset}
+    if getattr(args, "server_url", None):
+        # split-party inference: client stages local, server compute
+        # behind /predict (the serving peer's weights, not the
+        # checkpoint's server half)
+        from split_learning_tpu.runtime.evaluate import evaluate_remote
+        from split_learning_tpu.transport.http import HttpTransport
+        transport = HttpTransport(args.server_url)
+        try:
+            transport.wait_ready(timeout=60.0)
+            client_params = [params[i] for i in plan.stages_of("client")]
+            res = evaluate_remote(plan, client_params, transport, ds.test,
+                                  batch_size=cfg.batch_size)
+        finally:
+            transport.close()
+        record["remote_server"] = args.server_url
+    else:
+        res = evaluate(plan, params, ds.test, batch_size=cfg.batch_size)
+    record.update({
+        "accuracy": round(res["accuracy"], 4),
+        "loss": round(res["loss"], 4),
+        "perplexity": (None if res["perplexity"] is None
+                       else round(res["perplexity"], 4)),
+        "examples": res["examples"],
+        "predictions": res["predictions"],
+    })
+    print(json.dumps(record))
     return 0
 
 
@@ -724,6 +743,10 @@ def main(argv: Optional[list] = None) -> int:
     _add_common(pe)
     pe.add_argument("--step", type=int, default=None,
                     help="checkpoint step (default: latest)")
+    pe.add_argument("--server-url", dest="server_url", default=None,
+                    help="split-party inference: run only the client-"
+                         "owned stages locally and the server-owned "
+                         "compute behind this serving server's /predict")
     pe.set_defaults(fn=cmd_eval)
 
     args = ap.parse_args(argv)

@@ -87,7 +87,8 @@ def _pick_block(t: int) -> int:
 
 
 def select_attention(b: int, t: int, h: int, itemsize: int,
-                     hbm_bytes: int | None = None) -> str:
+                     hbm_bytes: int | None = None,
+                     t_kv: int | None = None) -> str:
     """``attn="auto"`` resolution: pick ``"full"`` (XLA dense) or
     ``"flash"`` per shape. Round-3 measurements on the v5e chip
     (artifacts/bench_tpu_transformer_*.json) put dense ahead at every
@@ -102,14 +103,20 @@ def select_attention(b: int, t: int, h: int, itemsize: int,
     that OOMs mid-run is worse than the slower kernel.
 
     ``SLT_FLASH_AUTO_T`` overrides: at or above that T, flash — the
-    knob for re-pinning the crossover when the kernels change."""
+    knob for re-pinning the crossover when the kernels change.
+
+    ``t_kv`` generalizes the rule to asymmetric query/key extents (the
+    sharded parallel forms — ops/ring_attention.py — resolve their
+    per-rank shapes through here so the crossover has one home)."""
     import os
+    if t_kv is None:
+        t_kv = t
     env = os.environ.get("SLT_FLASH_AUTO_T")
     if env:
-        return "flash" if t >= int(env) else "full"
+        return "flash" if max(t, t_kv) >= int(env) else "full"
     if hbm_bytes is None:
         hbm_bytes = _device_hbm_bytes()
-    dense_resident = 3 * b * h * t * t * itemsize
+    dense_resident = 3 * b * h * t * t_kv * itemsize
     return "flash" if dense_resident > hbm_bytes // 2 else "full"
 
 

@@ -238,16 +238,14 @@ def _resolve_block_impl(block_impl: str, b: int, t_q: int, t_kv: int,
     scan residuals over every hop — f32 scores + probabilities per hop,
     i.e. O(B_local * H * T_local * T_global) total (``t_kv`` = global
     T); for ulysses it is the gathered [B_local, H/n, T, T] block.
-    ``b`` must already be the per-rank batch."""
+    ``b`` must already be the per-rank batch. Delegates to
+    :func:`...flash_attention.select_attention` so the crossover rule
+    (and its SLT_FLASH_AUTO_T override) has exactly one home."""
     if block_impl != "auto":
         return block_impl
-    import os
-    env = os.environ.get("SLT_FLASH_AUTO_T")
-    if env:
-        return "flash" if max(t_q, t_kv) >= int(env) else "dense"
-    from split_learning_tpu.ops.flash_attention import _device_hbm_bytes
-    resident = 3 * b * h * t_q * t_kv * itemsize
-    return "flash" if resident > _device_hbm_bytes() // 2 else "dense"
+    from split_learning_tpu.ops.flash_attention import select_attention
+    choice = select_attention(b, t_q, h, itemsize, t_kv=t_kv)
+    return "flash" if choice == "flash" else "dense"
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,

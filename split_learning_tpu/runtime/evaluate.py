@@ -21,6 +21,34 @@ from split_learning_tpu.core.stage import SplitPlan
 from split_learning_tpu.data.datasets import Split, batches
 
 
+def _accumulate_metrics(split: Split, batch_size: int,
+                        score_batch) -> Dict[str, float]:
+    """The one home of the metric accounting rules: ``score_batch(x, y)
+    -> (loss, correct)`` per batch; predictions count label *elements*
+    (B for classifiers, B*T for the causal LM), ``examples`` counts
+    rows, perplexity is exp(mean CE) nulled on overflow (inf/nan are
+    not JSON tokens)."""
+    total = rows = correct_sum = 0
+    loss_sum = 0.0
+    # fixed order, keep the partial tail batch: every example counts once
+    for x, y in batches(split, batch_size, shuffle=False):
+        loss, correct = score_batch(x, y)
+        n = int(np.prod(np.shape(y)))
+        total += n
+        rows += len(y)
+        correct_sum += int(correct)
+        loss_sum += float(loss) * n
+    if total == 0:
+        return {"accuracy": float("nan"), "loss": float("nan"),
+                "perplexity": float("nan"), "examples": 0, "predictions": 0}
+    mean_loss = loss_sum / total
+    with np.errstate(over="ignore"):
+        ppl = float(np.exp(mean_loss))
+    return {"accuracy": correct_sum / total, "loss": mean_loss,
+            "perplexity": ppl if np.isfinite(ppl) else None,
+            "examples": rows, "predictions": total}
+
+
 def evaluate(plan: SplitPlan, params: Sequence[Any], split: Split,
              batch_size: int = 512) -> Dict[str, float]:
     """Accuracy and mean CE loss of ``plan.apply(params, .)`` on a split.
@@ -37,30 +65,53 @@ def evaluate(plan: SplitPlan, params: Sequence[Any], split: Split,
         correct = jnp.sum(jnp.argmax(logits, axis=-1) == y)
         return loss, correct
 
-    total = 0
-    rows = 0
-    correct_sum = 0
-    loss_sum = 0.0
-    # fixed order, keep the partial tail batch: every example counts once
-    for x, y in batches(split, batch_size, shuffle=False):
-        loss, correct = fwd(params, jnp.asarray(x), jnp.asarray(y))
-        # one prediction per label element: B for classifiers, B*T for
-        # the causal LM's per-token labels — accuracy/loss weight by
-        # predictions; "examples" stays the row count
-        n = int(np.prod(np.shape(y)))
-        total += n
-        rows += len(y)
-        correct_sum += int(correct)
-        loss_sum += float(loss) * n
-    if total == 0:
-        return {"accuracy": float("nan"), "loss": float("nan"),
-                "perplexity": float("nan"), "examples": 0, "predictions": 0}
-    mean_loss = loss_sum / total
-    # exp(mean CE): the standard LM report; harmless for classifiers
-    # (exp of their CE). A diverged checkpoint's CE can overflow exp —
-    # keep the JSON strict-parseable (inf/nan are not JSON tokens)
-    with np.errstate(over="ignore"):
-        ppl = float(np.exp(mean_loss))
-    return {"accuracy": correct_sum / total, "loss": mean_loss,
-            "perplexity": ppl if np.isfinite(ppl) else None,
-            "examples": rows, "predictions": total}
+    return _accumulate_metrics(
+        split, batch_size,
+        lambda x, y: fwd(params, jnp.asarray(x), jnp.asarray(y)))
+
+
+def evaluate_remote(plan: SplitPlan, client_params: Sequence[Any],
+                    transport: Any, split: Split,
+                    batch_size: int = 512) -> Dict[str, float]:
+    """Split-party inference: the client holds ONLY its own stages and
+    the server-owned compute happens behind ``transport.predict``.
+
+    ``client_params`` is the parameter sequence for the client-owned
+    stages in plan order (one stage for the classic split, two for the
+    U-shape). Labels never leave the client either way; metrics match
+    :func:`evaluate` of the full composition to float tolerance
+    (tests/test_split_inference.py)."""
+    client_idx = plan.stages_of("client")
+    if len(client_params) != len(client_idx):
+        raise ValueError(
+            f"expected params for {len(client_idx)} client-owned stages, "
+            f"got {len(client_params)}")
+    client_params = jax.tree_util.tree_map(jnp.asarray, list(client_params))
+    first_server = min(plan.stages_of("server"))
+    pre_stages = [plan.stages[i] for i in client_idx if i < first_server]
+    post_stages = [plan.stages[i] for i in client_idx if i > first_server]
+    pre_params = client_params[:len(pre_stages)]
+    post_params = client_params[len(pre_stages):]
+
+    @jax.jit
+    def pre(params, x):
+        for st, p in zip(pre_stages, params):
+            x = st.apply(p, x)
+        return x
+
+    @jax.jit
+    def post_and_score(params, feats, y):
+        logits = feats
+        for st, p in zip(post_stages, params):
+            logits = st.apply(p, logits)
+        loss = cross_entropy(logits, y)
+        correct = jnp.sum(jnp.argmax(logits, axis=-1) == y)
+        return loss, correct
+
+    def score_batch(x, y):
+        acts = pre(pre_params, jnp.asarray(x))
+        out = transport.predict(np.asarray(acts))
+        return post_and_score(post_params, jnp.asarray(out),
+                              jnp.asarray(y))
+
+    return _accumulate_metrics(split, batch_size, score_batch)

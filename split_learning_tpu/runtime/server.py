@@ -122,6 +122,11 @@ class ServerRuntime:
             self._u_fwd = jax.jit(fwd_fn)
             self._u_bwd = jax.jit(bwd_fn, donate_argnums=(0,))
 
+        # inference: the server-owned forward with no loss, no optimizer
+        # and no residuals — the serving half of split-party prediction
+        # (runtime/evaluate.py evaluate_remote)
+        self._predict = jax.jit(stage.apply)
+
     # ------------------------------------------------------------------ #
     def _check_step(self, step: int, client_id: int = 0) -> None:
         last = max(self._last_step.get(client_id, -1), self._step_floor)
@@ -150,6 +155,20 @@ class ServerRuntime:
             if self.on_step is not None:
                 self.on_step(acked)
             return np.asarray(g_acts), float(loss)
+
+    def predict(self, activations: np.ndarray,
+                client_id: int = 0) -> np.ndarray:
+        """Forward-only through the server-owned stage: logits for the
+        classic split (server holds the head), features for the U-shape
+        (the client applies its own head). No step handshake — inference
+        is stateless and never desyncs training."""
+        if self.mode == "federated":
+            raise ProtocolError(
+                "predict called in mode 'federated' (the client holds "
+                "the full model; evaluate locally)", status=400)
+        with self._lock:
+            params = self.state.params
+        return np.asarray(self._predict(params, jnp.asarray(activations)))
 
     # bounds on residuals awaiting their hop-2 u_backward. Per-client FIFO
     # cap: one client's backlog can never evict another's live residual.

@@ -122,6 +122,16 @@ class Transport(abc.ABC):
                    client_id: int = 0) -> np.ndarray:
         """Hop 2: d(loss)/d(features) -> d(loss)/d(activations)."""
 
+    # -- split-party inference: one forward-only round trip --------------
+    def predict(self, activations: np.ndarray,
+                client_id: int = 0) -> np.ndarray:
+        """Forward-only through the server party (no loss, no update, no
+        step handshake): logits for the classic split, trunk features
+        for the U-shape. Beyond the reference's training-only surface —
+        transports without a serving peer may leave it unimplemented."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not serve split-party inference")
+
     # -- federated mode: one round trip per epoch ------------------------
     @abc.abstractmethod
     def aggregate(self, params: Params, epoch: int, loss: float,
@@ -178,6 +188,12 @@ class FaultyTransport(Transport):
     def u_forward(self, activations, step, client_id=0):
         self.injector.maybe_fail("u_forward", step)
         return self.inner.u_forward(activations, step, client_id)
+
+    def predict(self, activations, client_id=0):
+        # -1: inference has no training step; a step-keyed injector
+        # targeting real steps must not misfire on every predict
+        self.injector.maybe_fail("predict", -1)
+        return self.inner.predict(activations, client_id)
 
     def u_backward(self, feat_grads, step, client_id=0):
         self.injector.maybe_fail("u_backward", step)

@@ -99,6 +99,9 @@ class SplitHTTPServer:
                         g = outer.runtime.u_backward(
                             req["feat_grads"], int(req["step"]), cid)
                         body = codec.encode({"grads": pack(g)})
+                    elif self.path == "/predict":
+                        out = outer.runtime.predict(req["activations"], cid)
+                        body = codec.encode({"outputs": pack(out)})
                     elif self.path == "/aggregate_weights":
                         agg = outer.runtime.aggregate(
                             req["model_state"], int(req["epoch"]),
@@ -211,6 +214,14 @@ class HttpTransport(Transport):
                 "feat_grads": self._pack(feat_grads), "step": step,
                 "client_id": client_id,
             })["grads"]
+
+    def predict(self, activations: np.ndarray,
+                client_id: int = 0) -> np.ndarray:
+        with timed(self.stats):
+            return self._post("/predict", {
+                "activations": self._pack(activations),
+                "client_id": client_id,
+            })["outputs"]
 
     def aggregate(self, params: Any, epoch: int, loss: float, step: int) -> Any:
         with timed(self.stats):

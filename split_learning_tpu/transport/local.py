@@ -58,6 +58,14 @@ class LocalTransport(Transport):
                 self._roundtrip(np.asarray(activations)), step, client_id)
             return self._roundtrip(feats)
 
+    def predict(self, activations: np.ndarray,
+                client_id: int = 0) -> np.ndarray:
+        with timed(self.stats):
+            out = self._call(self.server.predict,
+                             self._roundtrip(np.asarray(activations)),
+                             client_id)
+            return self._roundtrip(out)
+
     def u_backward(self, feat_grads: np.ndarray, step: int,
                    client_id: int = 0) -> np.ndarray:
         with timed(self.stats):

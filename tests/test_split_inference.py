@@ -1,0 +1,96 @@
+"""Split-party inference (runtime/evaluate.py evaluate_remote +
+ServerRuntime.predict + the /predict route).
+
+The reference's capability is training-only; serving is the natural
+counterpart: the client holds only its own stages (and the labels), the
+server answers forward-only /predict with ITS weights — no loss, no
+optimizer step, no step handshake, so inference can interleave with
+training without desyncing the handshake.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from split_learning_tpu.models import get_plan
+from split_learning_tpu.runtime import ServerRuntime
+from split_learning_tpu.runtime.evaluate import evaluate, evaluate_remote
+from split_learning_tpu.transport import LocalTransport
+from split_learning_tpu.utils import Config
+
+
+def _setup(mode):
+    plan = get_plan(mode=mode)
+    rs = np.random.RandomState(0)
+    x = rs.randn(48, 28, 28, 1).astype(np.float32)
+    y = rs.randint(0, 10, (48,)).astype(np.int64)
+    cfg = Config(mode=mode, batch_size=16)
+    runtime = ServerRuntime(plan, cfg, jax.random.PRNGKey(0), x[:16])
+    # same seed => the "client checkpoint" params equal the runtime's init
+    all_params = plan.init(jax.random.PRNGKey(0), jnp.asarray(x[:16]))
+    client_params = [all_params[i] for i in plan.stages_of("client")]
+    from split_learning_tpu.data.datasets import Split
+    return plan, runtime, all_params, client_params, Split(x=x, y=y)
+
+
+@pytest.mark.parametrize("mode", ["split", "u_split"])
+def test_remote_matches_full_composition(mode):
+    """Client-side stages + /predict must reproduce evaluate() of the
+    full composition (same params both sides by construction)."""
+    plan, runtime, all_params, client_params, split = _setup(mode)
+    transport = LocalTransport(runtime, through_codec=True)
+    want = evaluate(plan, all_params, split, batch_size=16)
+    got = evaluate_remote(plan, client_params, transport, split,
+                          batch_size=16)
+    assert got["examples"] == want["examples"] == 48
+    np.testing.assert_allclose(got["loss"], want["loss"], rtol=1e-5)
+    assert got["accuracy"] == want["accuracy"]
+
+
+def test_predict_does_not_advance_the_handshake(mode="split"):
+    """Inference between training steps must not move the step handshake
+    or mutate server weights."""
+    plan, runtime, all_params, client_params, split = _setup(mode)
+    transport = LocalTransport(runtime)
+    acts = transport.predict(np.asarray(
+        plan.stages[0].apply(client_params[0], jnp.asarray(split.x[:4]))))
+    assert acts.shape[0] == 4
+    assert runtime.health()["step"] == -1  # untouched
+    before = jax.tree_util.tree_leaves(runtime.state.params)[0]
+    transport.predict(np.asarray(
+        plan.stages[0].apply(client_params[0], jnp.asarray(split.x[:4]))))
+    after = jax.tree_util.tree_leaves(runtime.state.params)[0]
+    np.testing.assert_array_equal(np.asarray(before), np.asarray(after))
+
+
+def test_predict_rejected_in_federated_mode():
+    from split_learning_tpu.runtime.server import ProtocolError
+
+    plan = get_plan(mode="federated")
+    rs = np.random.RandomState(0)
+    x = rs.randn(8, 28, 28, 1).astype(np.float32)
+    cfg = Config(mode="federated", batch_size=8)
+    runtime = ServerRuntime(plan, cfg, jax.random.PRNGKey(0), x)
+    with pytest.raises(ProtocolError):
+        runtime.predict(x)
+
+
+def test_remote_over_http_wire():
+    """The /predict route end to end: stdlib HTTP server, msgpack+CRC
+    codec, metrics parity vs the composed plan."""
+    from split_learning_tpu.transport.http import (HttpTransport,
+                                                   SplitHTTPServer)
+
+    plan, runtime, all_params, client_params, split = _setup("split")
+    server = SplitHTTPServer(runtime).start()
+    transport = HttpTransport(server.url)
+    try:
+        want = evaluate(plan, all_params, split, batch_size=24)
+        got = evaluate_remote(plan, client_params, transport, split,
+                              batch_size=24)
+        np.testing.assert_allclose(got["loss"], want["loss"], rtol=1e-5)
+        assert got["accuracy"] == want["accuracy"]
+    finally:
+        transport.close()
+        server.stop()
