@@ -559,14 +559,64 @@ def cmd_serve(args) -> int:
     # handshake re-armed, so a restarted pair picks up in sync
     ckptr = None
     if cfg.checkpoint_dir:
-        ckptr = Checkpointer(cfg.checkpoint_dir)
-        _write_ckpt_meta(cfg.checkpoint_dir, "server_only", cfg)
+        # a joint checkpoint dir (written by local/fused training) holds
+        # both halves under a different layout: resume the server half
+        # from it, but never overwrite its meta or mix server-only step
+        # trees into it — periodic saves go to a server_party/ subdir,
+        # and on restart the NEWER of (joint root, server_party) wins
+        try:
+            existing = _read_ckpt_meta(cfg.checkpoint_dir)
+        except FileNotFoundError:
+            existing = None
+        except (json.JSONDecodeError, OSError) as e:
+            print(f"[ckpt] meta.json unreadable ({e}); treating "
+                  f"{cfg.checkpoint_dir} as a server-only dir",
+                  file=sys.stderr)
+            existing = None
+        joint = existing is not None and existing.get(
+            "layout", "server_only") != "server_only"
+        if existing is not None:
+            for key, got in (("mode", cfg.mode), ("model", cfg.model)):
+                want = existing.get(key)
+                if want is not None and want != got:
+                    print(f"[ckpt] checkpoint dir was written with "
+                          f"{key}={want!r} but serve was started with "
+                          f"{key}={got!r}; refusing to resume a "
+                          "mismatched server half", file=sys.stderr)
+                    return 2
+        if joint:
+            save_dir = os.path.join(cfg.checkpoint_dir, "server_party")
+            ckptr = Checkpointer(save_dir)
+            _write_ckpt_meta(save_dir, "server_only", cfg)
+            print(f"[ckpt] joint-layout dir: periodic server saves go to "
+                  f"{save_dir}", file=sys.stderr)
+        else:
+            ckptr = Checkpointer(cfg.checkpoint_dir)
+            _write_ckpt_meta(cfg.checkpoint_dir, "server_only", cfg)
         latest = ckptr.latest_step()
+        if args.resume and joint:
+            # a prior serve on this joint dir may have saved newer
+            # server-only state under server_party/ — prefer it; else
+            # partial-restore the typed server subtree of the joint tree
+            root = Checkpointer(cfg.checkpoint_dir)
+            try:
+                root_latest = root.latest_step()
+                if root_latest is not None and (latest is None
+                                                or root_latest > latest):
+                    tree = root.restore_partial({"server": runtime.state},
+                                                root_latest)
+                    runtime.resume_from(tree["server"], root_latest)
+                    print(f"[ckpt] server resumed at step {root_latest} "
+                          f"from joint {cfg.checkpoint_dir}",
+                          file=sys.stderr)
+                    latest = None  # handled; skip the server_party branch
+            finally:
+                root.close()
         if args.resume and latest is not None:
             tree = ckptr.restore({"server": runtime.state})
             runtime.resume_from(tree["server"], latest)
             print(f"[ckpt] server resumed at step {latest} from "
-                  f"{cfg.checkpoint_dir}", file=sys.stderr)
+                  f"{ckptr.directory}", file=sys.stderr)
 
         every = max(args.checkpoint_every, 1)
 

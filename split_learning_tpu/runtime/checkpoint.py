@@ -79,6 +79,38 @@ class Checkpointer:
                     f"no checkpoints under {self.directory}")
         return self._mgr.restore(step, args=ocp.args.StandardRestore(template))
 
+    def restore_partial(self, template: Any,
+                        step: Optional[int] = None) -> Any:
+        """Typed restore of a SUBTREE of the on-disk checkpoint: the
+        top-level keys present in ``template`` come back with their
+        template's types preserved — e.g. the ``server`` half of a joint
+        cross-party checkpoint, including its optax opt_state
+        namedtuples (``restore_raw`` alone would decay those to dicts,
+        which a live optimizer cannot update). Keys absent from
+        ``template`` are restored raw and returned as-is.
+
+        Implemented as structure discovery (raw restore) + one full
+        typed restore with the caller's template grafted in — orbax's
+        native partial restore depends on which handler the manager
+        registered, which varies with save history."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoints under {self.directory}")
+        raw = self.restore_raw(step)
+        if not isinstance(raw, dict):
+            raise ValueError(
+                f"restore_partial expects a dict-shaped checkpoint, got "
+                f"{type(raw).__name__}")
+        missing = set(template) - set(raw)
+        if missing:
+            raise KeyError(
+                f"checkpoint under {self.directory} has no {sorted(missing)}"
+                f" subtree(s); present: {sorted(raw)}")
+        full = {k: template.get(k, raw[k]) for k in raw}
+        return self.restore(full, step)
+
     def restore_raw(self, step: Optional[int] = None) -> Any:
         """Restore without a template: TrainStates come back as plain dicts
         ({'params': [...], 'opt_state': ..., 'step': ...}) — enough for

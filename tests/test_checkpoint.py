@@ -138,3 +138,48 @@ def test_save_is_async_and_reads_barrier(tmp_path, monkeypatch):
     calls.clear()
     ckpt.close()
     assert "wait" in calls, "close() must drain outstanding writes"
+
+
+def test_restore_partial_preserves_optimizer_types(tmp_path):
+    """The server half of a JOINT checkpoint must restore TYPED (optax
+    TraceState namedtuples intact): a raw restore decays opt_state to
+    dicts that a live momentum optimizer cannot update."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from split_learning_tpu.models import get_plan
+    from split_learning_tpu.runtime import ServerRuntime
+    from split_learning_tpu.runtime.checkpoint import (Checkpointer,
+                                                       joint_state)
+    from split_learning_tpu.utils import Config
+
+    cfg = Config(mode="split", batch_size=8, momentum=0.9)
+    plan = get_plan(mode="split")
+    rs = np.random.RandomState(0)
+    x = rs.randn(8, 28, 28, 1).astype(np.float32)
+    y = rs.randint(0, 10, (8,)).astype(np.int64)
+    rt = ServerRuntime(plan, cfg, jax.random.PRNGKey(0), x)
+    acts = np.asarray(plan.stages[0].apply(
+        plan.init(jax.random.PRNGKey(0), jnp.asarray(x))[0],
+        jnp.asarray(x)))
+    rt.split_step(acts, y, 0)
+    ref = np.array(jax.tree_util.tree_leaves(rt.state.params)[0])
+    ck = Checkpointer(str(tmp_path / "joint"))
+    ck.save(1, joint_state(client={"params": 0}, server=rt.state))
+    ck.close()
+
+    ck2 = Checkpointer(str(tmp_path / "joint"))  # fresh manager (restart)
+    rt2 = ServerRuntime(plan, cfg, jax.random.PRNGKey(1), x)
+    tree = ck2.restore_partial({"server": rt2.state})
+    got = np.array(jax.tree_util.tree_leaves(tree["server"].params)[0])
+    np.testing.assert_array_equal(ref, got)
+    rt2.resume_from(tree["server"], 1)
+    # the real assertion: a momentum update over the restored opt_state
+    _, loss = rt2.split_step(acts, y, 2)
+    assert np.isfinite(loss)
+    # missing subtree is a loud error, not a silent fresh init
+    import pytest as _pytest
+    with _pytest.raises(KeyError):
+        ck2.restore_partial({"nonexistent": rt2.state})
+    ck2.close()

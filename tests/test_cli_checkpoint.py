@@ -166,3 +166,48 @@ def test_checkpoint_resume_eval_transformer(tmp_path, capsys):
                       if l.startswith("{")][-1])
     assert res["checkpoint_step"] == 8
     assert 0.0 <= res["accuracy"] <= 1.0
+
+
+@pytest.mark.slow
+def test_serve_resume_from_joint_checkpoint(tmp_path, capsys):
+    """`serve --resume` on a JOINT checkpoint dir (written by local/fused
+    training) must restore the server subtree, leave the joint meta.json
+    untouched (periodic saves go to a server_party/ subdir), and yield
+    remote-eval metrics identical to local full-composition eval."""
+    import subprocess
+    import sys as _sys
+
+    ck = tmp_path / "joint"
+    assert _train(tmp_path, ck, "--mode", "split",
+                  "--transport", "local") == 0
+    assert main(["eval", "--checkpoint-dir", str(ck),
+                 "--data-dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    local = json.loads([l for l in out.splitlines()
+                        if l.startswith("{")][-1])
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    port = "18791"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    srv = subprocess.Popen(
+        [_sys.executable, "-m", "split_learning_tpu.launch.run", "serve",
+         "--mode", "split", "--host", "127.0.0.1", "--port", port,
+         "--checkpoint-dir", str(ck), "--resume",
+         "--data-dir", str(tmp_path)],
+        env=env, cwd=repo, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    try:
+        assert main(["eval", "--checkpoint-dir", str(ck),
+                     "--data-dir", str(tmp_path),
+                     "--server-url", f"http://127.0.0.1:{port}"]) == 0
+        out = capsys.readouterr().out
+        remote = json.loads([l for l in out.splitlines()
+                             if l.startswith("{")][-1])
+    finally:
+        srv.terminate()
+        srv.wait(timeout=30)
+
+    assert remote["accuracy"] == local["accuracy"]
+    assert abs(remote["loss"] - local["loss"]) < 1e-3
+    meta = json.loads((ck / "meta.json").read_text())
+    assert meta["layout"] == "split_local"  # not clobbered to server_only
