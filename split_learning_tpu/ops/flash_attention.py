@@ -134,11 +134,13 @@ def _device_hbm_bytes() -> int:
     return 16 * 1024 ** 3
 
 
-def _scores(qb, kb, t, k0, q0, scale, causal):
+def _scores(qb, kb, t, k0, q0, scale, causal, strict=False):
     """Masked scaled scores for one (q block, k block) pair. Operands
     stay in their storage dtype (bf16 runs the MXU at full rate) and
     accumulate in f32. Both padded key cols and padded query rows are
-    masked, so fully-padded rows carry l == 0 / lse == _NEG_BIG."""
+    masked, so fully-padded rows carry l == 0 / lse == _NEG_BIG.
+    ``strict`` excludes the diagonal (row > col) — the mask a striped
+    ring hop from a future-rank shard needs (ops/ring_attention.py)."""
     s = jax.lax.dot_general(
         qb, kb, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
@@ -146,11 +148,12 @@ def _scores(qb, kb, t, k0, q0, scale, causal):
     cols = k0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     ok = (rows < t) & (cols < t)
     if causal:
-        ok &= rows >= cols
+        ok &= (rows > cols) if strict else (rows >= cols)
     return jnp.where(ok, s, _NEG_BIG), ok
 
 
-def _fwd_kernel(blk: int, t: int, scale: float, causal: bool, n_k: int,
+def _fwd_kernel(blk: int, t: int, scale: float, causal: bool,
+                strict: bool, n_k: int,
                 q_ref, k_ref, v_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref):
     """Grid (bh, q block, k block), k fastest. Scratch accumulators carry
@@ -173,7 +176,7 @@ def _fwd_kernel(blk: int, t: int, scale: float, causal: bool, n_k: int,
     def _accumulate():
         qb = q_ref[0]
         vb = v_ref[0]
-        s, ok = _scores(qb, k_ref[0], t, k0, q0, scale, causal)
+        s, ok = _scores(qb, k_ref[0], t, k0, q0, scale, causal, strict)
         m = m_ref[:, 0]
         m_new = jnp.maximum(m, jnp.max(s, axis=1))
         # rebase then re-mask: exp(_NEG_BIG - _NEG_BIG) would be 1
@@ -201,7 +204,8 @@ def _fwd_kernel(blk: int, t: int, scale: float, causal: bool, n_k: int,
         lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
 
 
-def _dq_kernel(blk: int, t: int, scale: float, causal: bool, n_k: int,
+def _dq_kernel(blk: int, t: int, scale: float, causal: bool,
+               strict: bool, n_k: int,
                q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                dq_ref, acc_ref):
     """Grid (bh, q block, k block): dQ = scale * sum_k dS_k @ K_k,
@@ -218,7 +222,7 @@ def _dq_kernel(blk: int, t: int, scale: float, causal: bool, n_k: int,
     def _accumulate():
         qb = q_ref[0]
         kb = k_ref[0]
-        s, ok = _scores(qb, kb, t, k0, q0, scale, causal)
+        s, ok = _scores(qb, kb, t, k0, q0, scale, causal, strict)
         p = jnp.where(ok, jnp.exp(s - lse_ref[0][:, :1]), 0.0)
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0],
@@ -239,7 +243,8 @@ def _dq_kernel(blk: int, t: int, scale: float, causal: bool, n_k: int,
         dq_ref[0] = (acc_ref[:] * scale).astype(dq_ref.dtype)
 
 
-def _dkv_kernel(blk: int, t: int, scale: float, causal: bool, n_q: int,
+def _dkv_kernel(blk: int, t: int, scale: float, causal: bool,
+                strict: bool, n_q: int,
                 k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_acc, dv_acc):
     """Grid (bh, k block, q block): dV = sum_q P^T @ dO,
@@ -260,7 +265,7 @@ def _dkv_kernel(blk: int, t: int, scale: float, causal: bool, n_q: int,
         qb = q_ref[0]
         kb = k_ref[0]
         dob = do_ref[0]
-        s, ok = _scores(qb, kb, t, k0, q0, scale, causal)
+        s, ok = _scores(qb, kb, t, k0, q0, scale, causal, strict)
         p = jnp.where(ok, jnp.exp(s - lse_ref[0][:, :1]), 0.0)
         dv_acc[:] += jax.lax.dot_general(
             p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
@@ -287,7 +292,7 @@ def _dkv_kernel(blk: int, t: int, scale: float, causal: bool, n_q: int,
 # --------------------------------------------------------------------- #
 @functools.lru_cache(maxsize=None)
 def _make_flash(bh: int, t: int, d: int, causal: bool, dtype_name: str,
-                block: int, with_lse: bool = False):
+                block: int, with_lse: bool = False, strict: bool = False):
     """Custom-VJP flash attention for one static ([BH, T, D], causal).
 
     ``with_lse=True`` additionally returns the per-row logsumexp as a
@@ -321,7 +326,8 @@ def _make_flash(bh: int, t: int, d: int, causal: bool, dtype_name: str,
     def fwd_call(q, k, v):
         qp, kp, vp = pad_qkv(q), pad_qkv(k), pad_qkv(v)
         o, lse = pl.pallas_call(
-            functools.partial(_fwd_kernel, block, t, scale, causal, n_blk),
+            functools.partial(_fwd_kernel, block, t, scale, causal,
+                              strict, n_blk),
             out_shape=(
                 jax.ShapeDtypeStruct((bh, tp, dp), in_dtype),
                 jax.ShapeDtypeStruct((bh, tp, _ROWW), jnp.float32),
@@ -365,7 +371,8 @@ def _make_flash(bh: int, t: int, d: int, causal: bool, dtype_name: str,
                 g_lse.astype(jnp.float32), 1, tp)[..., None]
         delta = jnp.broadcast_to(delta, (bh, tp, _ROWW))
         dq = pl.pallas_call(
-            functools.partial(_dq_kernel, block, t, scale, causal, n_blk),
+            functools.partial(_dq_kernel, block, t, scale, causal,
+                              strict, n_blk),
             out_shape=jax.ShapeDtypeStruct((bh, tp, dp), in_dtype),
             grid=grid,
             in_specs=[blk(outer), blk(inner), blk(inner), blk(outer),
@@ -375,7 +382,8 @@ def _make_flash(bh: int, t: int, d: int, causal: bool, dtype_name: str,
             interpret=use_interpret(),
         )(qp, kp, vp, dop, lse, delta)
         dk, dv = pl.pallas_call(
-            functools.partial(_dkv_kernel, block, t, scale, causal, n_blk),
+            functools.partial(_dkv_kernel, block, t, scale, causal,
+                              strict, n_blk),
             out_shape=(
                 jax.ShapeDtypeStruct((bh, tp, dp), in_dtype),
                 jax.ShapeDtypeStruct((bh, tp, dp), in_dtype),
@@ -414,7 +422,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
-                             causal: bool = False
+                             causal: bool = False, strict: bool = False
                              ) -> tuple[jax.Array, jax.Array]:
     """:func:`flash_attention` that also returns the per-row logsumexp.
 
@@ -422,10 +430,15 @@ def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
     differentiable (the lse cotangent folds into the backward's delta
     row). ``(o, lse)`` pairs from disjoint key sets merge exactly —
     ring attention (ops/ring_attention.py) uses this as its per-block
-    compute so no rank ever materializes O(T_local^2) scores."""
+    compute so no rank ever materializes O(T_local^2) scores.
+
+    ``strict`` masks the diagonal too (row > col) — the mask a striped
+    ring hop from a future-rank shard needs; a fully-masked first row
+    comes back as ``o = 0, lse = NEG_BIG``, the identity of the
+    log-space merge."""
     b, t, h, d = q.shape
     fn = _make_flash(b * h, t, d, causal, str(q.dtype), _pick_block(t),
-                     with_lse=True)
+                     with_lse=True, strict=strict)
 
     def fold(x):
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)

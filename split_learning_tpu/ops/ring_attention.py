@@ -38,6 +38,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -73,13 +74,24 @@ def full_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 def _ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
-                          axis_name: str, causal: bool) -> jax.Array:
-    """Per-rank body (inside shard_map): q stays, k/v rotate n times."""
+                          axis_name: str, causal: bool,
+                          striped: bool = False) -> jax.Array:
+    """Per-rank body (inside shard_map): q stays, k/v rotate n times.
+
+    ``striped``: the caller laid tokens out round-robin (global position
+    of local row j on rank r is ``j*n + r`` instead of ``r*t_local + j``
+    — :func:`stripe_permutation`); only the position formulas change,
+    the online-softmax recurrence is identical."""
     n = lax.psum(1, axis_name)          # ring size (static under shard_map)
     rank = lax.axis_index(axis_name)
     b, t_local, h, d = q.shape
     scale = d ** -0.5
-    q_pos = rank * t_local + jnp.arange(t_local)
+
+    def positions(r):
+        idx = jnp.arange(t_local)
+        return idx * n + r if striped else r * t_local + idx
+
+    q_pos = positions(rank)
 
     # accumulators in [B, H, Tq] / [B, H, Tq, D] layout so the softmax
     # reductions run over the trailing (lane) dim
@@ -94,7 +106,7 @@ def _ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
         src = (rank - i) % n
         s = jnp.einsum("bqhd,bkhd->bhqk", q, kb,
                        preferred_element_type=jnp.float32) * scale
-        k_pos = src * t_local + jnp.arange(t_local)
+        k_pos = positions(src)
         mask = None
         if causal:
             mask = q_pos[:, None] >= k_pos[None, :]       # [Tq, Tk]
@@ -129,7 +141,8 @@ def _ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 def _ring_flash_local(q: jax.Array, k: jax.Array, v: jax.Array,
-                      axis_name: str, causal: bool) -> jax.Array:
+                      axis_name: str, causal: bool,
+                      striped: bool = False) -> jax.Array:
     """Per-rank body with the Pallas flash kernel as the block compute:
     q stays resident, K/V rotate, and each (q block, K/V block) pair
     runs :func:`flash_attention_with_lse` — so nothing O(T_local^2)
@@ -160,10 +173,6 @@ def _ring_flash_local(q: jax.Array, k: jax.Array, v: jax.Array,
     def block_attn(kb, vb, i):
         if not causal:
             return flash_attention_with_lse(q, kb, vb, causal=False)
-        # causal relation of the whole block decides the kernel: blocks
-        # from strictly-past ranks attend unmasked, the diagonal block
-        # masks elementwise, strictly-future blocks contribute nothing
-        # (lax.switch executes one branch — future hops cost no FLOPs)
         src = (rank - i) % n
 
         def past(args):
@@ -172,10 +181,27 @@ def _ring_flash_local(q: jax.Array, k: jax.Array, v: jax.Array,
         def diag(args):
             return flash_attention_with_lse(*args, causal=True)
 
+        def strict(args):
+            return flash_attention_with_lse(*args, causal=True,
+                                            strict=True)
+
         def future(args):
             return (jnp.zeros((b, t_local, h, d), q.dtype),
                     jnp.full((b, t_local, h), _NEG_BIG, jnp.float32))
 
+        if striped:
+            # striped positions (j*n + r) collapse every hop's global
+            # mask to a LOCAL triangle: src <= rank -> causal,
+            # src > rank -> strict causal (diagonal excluded). Each hop
+            # is ~half-masked and the kernel's block skipping keeps the
+            # per-hop cost ~half, on every rank — the balance that makes
+            # striping worth its four permutes (contiguous causal idles
+            # rank 0 for n-1 of its n lockstep hops).
+            return lax.cond(src > rank, strict, diag, (q, kb, vb))
+        # contiguous: strictly-past ranks attend unmasked, the diagonal
+        # block masks elementwise, strictly-future blocks contribute
+        # nothing (lax.switch executes one branch — dead hops cost no
+        # FLOPs, but the lockstep ring still waits on the busiest rank)
         idx = jnp.where(src < rank, 0, jnp.where(src == rank, 1, 2))
         return lax.switch(idx, [past, diag, future], (q, kb, vb))
 
@@ -192,6 +218,14 @@ def _ring_flash_local(q: jax.Array, k: jax.Array, v: jax.Array,
     ob, lseb = block_attn(kb, vb, n - 1)
     o, _ = merge(o, lse, ob, lseb)
     return o.astype(q.dtype)                       # already [B, Tq, H, D]
+
+
+def stripe_permutation(t: int, n: int) -> np.ndarray:
+    """Index permutation mapping the natural token order to the striped
+    ring layout: shard r's contiguous slot holds tokens r, r+n, ...
+    ``x[:, stripe_permutation(T, n)]`` stripes; the inverse un-stripes
+    (``np.argsort`` of it)."""
+    return np.concatenate([np.arange(r, t, n) for r in range(n)])
 
 
 def _ulysses_local(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -251,7 +285,8 @@ def _resolve_block_impl(block_impl: str, b: int, t_q: int, t_kv: int,
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    mesh: Optional[Mesh] = None, causal: bool = False,
                    axis_name: str = SEQ_AXIS,
-                   block_impl: str = "auto") -> jax.Array:
+                   block_impl: str = "auto",
+                   layout: str = "auto") -> jax.Array:
     """Sequence-parallel attention over ``mesh``'s ``seq`` axis.
 
     ``q/k/v``: global ``[B, T, H, D]`` (call from inside ``jit`` — the
@@ -267,10 +302,29 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     keeps the single-chip flash memory ceiling; ``"auto"`` (default)
     picks per shape — dense while a rank's score block fits comfortably
     in HBM, flash beyond.
+
+    ``layout`` places tokens on ranks: ``"contiguous"`` blocks, or
+    ``"striped"`` (token ``g`` on rank ``g % n``) which makes every
+    hop's mask a ~half-live local triangle — causal for hops whose
+    source rank is at or before this one, strict-causal after — so no
+    rank idles at the lockstep ppermute. ``"auto"`` (default) stripes
+    exactly when the balance is real: causal with the flash block
+    kernels, whose block skipping turns the balanced masks into
+    actually-skipped work (~2x shorter critical path once t_local spans
+    multiple kernel blocks;
+    tests/test_ring_attention.py::test_striped_layout_balances_causal_work).
+    The dense body executes masked FLOPs regardless, so it stays
+    contiguous unless striping is requested explicitly (both bodies are
+    exact either way). Without ``causal`` there is no triangle to
+    balance, so an explicit ``"striped"`` request is coerced to
+    contiguous.
     """
     if block_impl not in ("dense", "flash", "auto"):
         raise ValueError(f"Unknown ring block_impl: {block_impl!r} "
                          "(expected 'dense', 'flash' or 'auto')")
+    if layout not in ("auto", "contiguous", "striped"):
+        raise ValueError(f"Unknown ring layout: {layout!r} "
+                         "(expected 'auto', 'contiguous' or 'striped')")
     b, t, h, _ = q.shape
     itemsize = jnp.dtype(q.dtype).itemsize
     if mesh is None or axis_name not in mesh.axis_names:
@@ -280,10 +334,37 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                 flash_attention)
             return flash_attention(q, k, v, causal=causal)
         return full_attention(q, k, v, causal=causal)
-    t_local = t // mesh.shape[axis_name]
+    n = mesh.shape[axis_name]
+    t_local = t // n
     b_local = b // mesh.shape.get(DATA_AXIS, 1) or 1
     # the dense body's scan residuals are f32 regardless of input dtype
     impl = _resolve_block_impl(block_impl, b_local, t_local, t, h, 4)
+    if layout == "auto":
+        # striping only pays when masked work is actually SKIPPED: the
+        # flash block kernels skip causally-dead block pairs, so
+        # balancing the triangle shortens the lockstep critical path
+        # (~2x at t_local >> kernel block). The dense body executes
+        # masked FLOPs anyway — striping there buys nothing and costs
+        # four permutes (q/k/v in, output back out) — so it stays
+        # contiguous.
+        layout = ("striped" if causal and impl == "flash"
+                  else "contiguous")
+    if layout == "striped" and not causal:
+        layout = "contiguous"  # nothing to balance without the mask
+    if layout == "striped":
+        # stripe the token axis (token g on rank g % n) so every
+        # (rank, hop) pair carries a ~half-masked local triangle —
+        # causal for hops from src <= rank, strict-causal for
+        # src > rank — instead of rank r idling for n-1-r of its hops
+        perm_np = stripe_permutation(t, n)
+        perm = jnp.asarray(perm_np)
+        inv = jnp.asarray(np.argsort(perm_np))
+        body = _sharded(mesh,
+                        (_ring_flash_local if impl == "flash"
+                         else _ring_attention_local),
+                        causal, axis_name, striped=True)
+        o = body(q[:, perm], k[:, perm], v[:, perm])
+        return o[:, inv]
     body = (_ring_flash_local if impl == "flash"
             else _ring_attention_local)
     return _sharded(mesh, body, causal, axis_name)(q, k, v)
