@@ -32,7 +32,8 @@ SURVEY.md §4 item 4). Select with ``Config.kernels = "xla" | "pallas"``.
 """
 
 from split_learning_tpu.ops.common import pallas_available, use_interpret
-from split_learning_tpu.ops.flash_attention import flash_attention
+from split_learning_tpu.ops.flash_attention import (
+    flash_attention, flash_attention_with_lse, select_attention)
 from split_learning_tpu.ops.ring_attention import (
     full_attention,
     ring_attention,
@@ -53,6 +54,8 @@ __all__ = [
     "pallas_available",
     "use_interpret",
     "flash_attention",
+    "flash_attention_with_lse",
+    "select_attention",
     "full_attention",
     "ring_attention",
     "ulysses_attention",

@@ -435,7 +435,11 @@ def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
     ``strict`` masks the diagonal too (row > col) — the mask a striped
     ring hop from a future-rank shard needs; a fully-masked first row
     comes back as ``o = 0, lse = NEG_BIG``, the identity of the
-    log-space merge."""
+    log-space merge. ``strict`` refines the causal mask, so it requires
+    ``causal=True``."""
+    if strict and not causal:
+        raise ValueError("strict=True refines the causal mask and "
+                         "requires causal=True")
     b, t, h, d = q.shape
     fn = _make_flash(b * h, t, d, causal, str(q.dtype), _pick_block(t),
                      with_lse=True, strict=strict)
