@@ -80,6 +80,7 @@ def test_auto_attention_selection(monkeypatch):
     assert select_attention(16, 512, 2, 2, hbm_bytes=hbm) == "full"
 
 
+@pytest.mark.slow
 def test_transformer_auto_matches_dense_at_small_t():
     """attn='auto' at T=32 resolves to dense: the trainer's loss series
     is bit-identical to attn='full'."""
@@ -121,3 +122,14 @@ def test_transformer_trains_with_flash_attn():
         ld = dense.train_step(xs[i], ys[i])
         lf = flash.train_step(xs[i], ys[i])
         np.testing.assert_allclose(lf, ld, atol=5e-5, rtol=5e-5)
+
+
+def test_with_lse_strict_requires_causal():
+    """strict refines the causal mask; without causal it must be a loud
+    error, never silently-unmasked attention."""
+    from split_learning_tpu.ops.flash_attention import (
+        flash_attention_with_lse)
+
+    q, k, v = qkv(t=8)
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention_with_lse(q, k, v, causal=False, strict=True)

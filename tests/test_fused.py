@@ -113,6 +113,7 @@ def test_fused_dp_mesh_matches_single_device(devices):
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_microbatched_matches_full_batch():
     """Config 4 groundwork: scan-accumulated microbatch gradients equal the
     full-batch gradient (mean-of-means with equal microbatch sizes)."""

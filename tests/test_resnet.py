@@ -25,6 +25,7 @@ def cifar_batch(seed=0):
             rs.randint(0, 10, (BATCH,)).astype(np.int64))
 
 
+@pytest.mark.slow
 def test_resnet18_shapes_and_params(rng):
     x, _ = cifar_batch()
     plan = get_plan(model="resnet18", mode="split")

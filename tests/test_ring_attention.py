@@ -95,6 +95,7 @@ def test_ulysses_rejects_indivisible_heads(devices):
         jax.jit(lambda a: ulysses_attention(a, a, a, mesh=mesh))(q)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_flash_matches_dense(devices, qkv, causal):
     """Ring with the Pallas flash kernel as block compute (interpret

@@ -88,6 +88,7 @@ def test_seq_parallel_training_matches_dense(devices, attn):
     np.testing.assert_allclose(losses_s, losses_d, atol=5e-5, rtol=5e-5)
 
 
+@pytest.mark.slow
 def test_split_transport_loop_runs():
     """The transformer plan drives the same MPMD client/server runtimes
     as the CNN — the split capability surface is family-agnostic."""
@@ -206,6 +207,7 @@ def test_split_transformer_over_http_wire():
         server.stop()
 
 
+@pytest.mark.slow
 def test_transformer_tensor_parallel_matches_unsharded(devices):
     """TP (mesh 'model' axis) composes with the transformer: Dense and
     Embed kernels shard their output-feature dim; the loss series must
@@ -227,6 +229,7 @@ def test_transformer_tensor_parallel_matches_unsharded(devices):
                                    atol=5e-5, rtol=5e-5)
 
 
+@pytest.mark.slow
 def test_split_transformer_http_int8_compression():
     """int8 wire compression quantizes the [B, T, E] cut tensor per the
     same symmetric-scale codec as images; training still converges on the

@@ -218,6 +218,7 @@ def test_greedy_generate_learns_chain_transitions(lm_data):
     assert hit > 0.25, f"modal-successor hit rate {hit} barely above chance"
 
 
+@pytest.mark.slow
 def test_sample_generate_determinism_and_range(lm_data):
     """Sampling decode: deterministic under a fixed key, different keys
     diverge, tokens stay in-vocab, and a near-zero temperature recovers
