@@ -55,6 +55,15 @@ def test_bench_wire_and_pipelined_roles_quick():
     assert piped["steps_per_sec_sync"] > 0
     assert piped["steps_per_sec_depth4"] > 0
     assert "note" in piped  # the shared-core caveat must ship with the leg
+    # the depth-W window's benefit, demonstrated: with wire latency
+    # injected (sleeps burn no CPU, so one core suffices) the window
+    # hides the wire behind compute, which the lock-step loop cannot.
+    # Loose bound: quick mode times only 6 steps and the image's CPU
+    # timing is load-sensitive; the full bench leg (20 steps) publishes
+    # the real figure (~1.5x).
+    syn_wire = piped["synthetic_wire"]
+    assert syn_wire["pipelining_speedup"] > 1.1, syn_wire
+    assert "synthetic" in syn_wire["note"]
 
 
 def test_degraded_headline_is_self_describing(monkeypatch, capsys):
