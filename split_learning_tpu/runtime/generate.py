@@ -30,11 +30,48 @@ import jax.numpy as jnp
 import numpy as np
 
 from split_learning_tpu.core.stage import SplitPlan
+from split_learning_tpu.ops.common import NEG_BIG as _NEG_BIG
+
+
+def _pick_fn(sample: bool, top_k: int, use_top_p: bool, dtype):
+    """Token chooser for one logits row ``[B, V]``: greedy argmax, or
+    temperature sampling with optional top-k (static: it sizes
+    ``lax.top_k``) and nucleus/top-p filtering (``use_top_p`` is the
+    static enable so the default sampling path never pays the
+    full-vocab sort; the p *value* stays a runtime scalar). Filters
+    apply to the temperature-scaled logits, largest first, per the
+    standard decode stack."""
+
+    def pick(row, pos, rng, temperature, top_p):
+        if not sample:
+            return jnp.argmax(row, axis=-1).astype(dtype)
+        if top_k > row.shape[-1]:
+            raise ValueError(f"top_k={top_k} exceeds the vocabulary "
+                             f"size {row.shape[-1]}")
+        scaled = row / temperature
+        if top_k > 0:
+            kth = jax.lax.top_k(scaled, top_k)[0][..., -1:]
+            scaled = jnp.where(scaled < kth, _NEG_BIG, scaled)
+        if use_top_p:
+            # nucleus: keep the smallest prefix of descending-prob
+            # tokens whose mass reaches top_p (the first always wins)
+            sorted_desc = -jnp.sort(-scaled, axis=-1)
+            probs = jax.nn.softmax(sorted_desc, axis=-1)
+            cum_before = jnp.cumsum(probs, axis=-1) - probs
+            keep = cum_before < top_p
+            cutoff = jnp.min(jnp.where(keep, sorted_desc, jnp.inf),
+                             axis=-1, keepdims=True)
+            scaled = jnp.where(scaled < cutoff, _NEG_BIG, scaled)
+        return jax.random.categorical(
+            jax.random.fold_in(rng, pos), scaled, axis=-1).astype(dtype)
+
+    return pick
 
 
 @functools.lru_cache(maxsize=32)
 def _decode_fn(plan: SplitPlan, b: int, p: int, n_new: int,
-               dtype_name: str, sample: bool):
+               dtype_name: str, sample: bool, top_k: int = 0,
+               use_top_p: bool = False):
     """One compiled decode program per (plan, shapes, mode) — SplitPlan
     is a frozen dataclass of functions, so it keys the cache directly
     and repeated generation never re-jits. Temperature and PRNG key are
@@ -42,8 +79,10 @@ def _decode_fn(plan: SplitPlan, b: int, p: int, n_new: int,
     total = p + n_new
     dtype = jnp.dtype(dtype_name)
 
+    pick = _pick_fn(sample, top_k, use_top_p, dtype)
+
     @jax.jit
-    def run(params, prompt, rng, temperature):
+    def run(params, prompt, rng, temperature, top_p):
         buf = jnp.zeros((b, total), dtype)
         buf = jax.lax.dynamic_update_slice(buf, prompt, (0, 0))
 
@@ -54,13 +93,7 @@ def _decode_fn(plan: SplitPlan, b: int, p: int, n_new: int,
             logits = plan.apply(params, buf)            # [B, total, V]
             row = jax.lax.dynamic_index_in_dim(logits, pos, axis=1,
                                                keepdims=False)
-            if sample:
-                nxt = jax.random.categorical(
-                    jax.random.fold_in(rng, pos), row / temperature,
-                    axis=-1)
-            else:
-                nxt = jnp.argmax(row, axis=-1)
-            nxt = nxt.astype(buf.dtype)                 # [B]
+            nxt = pick(row, pos, rng, temperature, top_p)       # [B]
             buf = jax.lax.dynamic_update_slice(
                 buf, nxt[:, None], (0, pos + 1))
             return buf, nxt
@@ -73,21 +106,20 @@ def _decode_fn(plan: SplitPlan, b: int, p: int, n_new: int,
 
 @functools.lru_cache(maxsize=32)
 def _kv_decode_fn(plan: SplitPlan, b: int, p: int, n_new: int,
-                  dtype_name: str, sample: bool):
+                  dtype_name: str, sample: bool, top_k: int = 0,
+                  use_top_p: bool = False):
     """KV-cache decode program: prefill once, then scan single-token
     steps over the per-layer caches. Same cache keying as
     :func:`_decode_fn`."""
     total = p + n_new
     dtype = jnp.dtype(dtype_name)
 
+    base_pick = _pick_fn(sample, top_k, use_top_p, dtype)
+
     @jax.jit
-    def run(params, prompt, rng, temperature):
+    def run(params, prompt, rng, temperature, top_p):
         def pick(row, pos):
-            if sample:
-                return jax.random.categorical(
-                    jax.random.fold_in(rng, pos), row / temperature,
-                    axis=-1).astype(dtype)
-            return jnp.argmax(row, axis=-1).astype(dtype)
+            return base_pick(row, pos, rng, temperature, top_p)
 
         # prefill: one full forward over the prompt; caches sized for
         # the whole decode up front (static shapes under the scan)
@@ -136,15 +168,23 @@ def greedy_generate(plan: SplitPlan, params: Sequence[Any],
     params = jax.tree_util.tree_map(jnp.asarray, list(params))
     make = _kv_decode_fn if kv_cache else _decode_fn
     run = make(plan, b, p, n_new, str(prompt.dtype), sample=False)
-    return run(params, prompt, jax.random.PRNGKey(0), jnp.float32(1.0))
+    return run(params, prompt, jax.random.PRNGKey(0), jnp.float32(1.0),
+               jnp.float32(1.0))
 
 
 def sample_generate(plan: SplitPlan, params: Sequence[Any],
                     prompt: np.ndarray, n_new: int, rng: jax.Array,
                     temperature: float = 1.0, *,
+                    top_k: int = 0, top_p: float = 1.0,
                     kv_cache: bool = True) -> jax.Array:
     """Like :func:`greedy_generate` but samples from the softmax at
     ``temperature`` (a runtime scalar — changing it never recompiles).
+
+    ``top_k`` (static: it sizes the kernel's ``lax.top_k``) keeps only
+    the k highest-probability tokens; ``top_p`` (runtime scalar) keeps
+    the smallest prefix of descending-probability tokens whose mass
+    reaches p (nucleus sampling). Both filter the temperature-scaled
+    logits; 0 / 1.0 disable them.
 
     ``temperature`` must be > 0: division by zero would turn the logits
     into inf/NaN and ``categorical`` over ties does NOT reduce to
@@ -154,6 +194,10 @@ def sample_generate(plan: SplitPlan, params: Sequence[Any],
         raise ValueError(
             f"temperature must be > 0 (got {temperature}); use "
             "greedy_generate for deterministic decoding")
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0 (got {top_k})")
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1] (got {top_p})")
     prompt = jnp.asarray(prompt)
     if n_new <= 0:
         if n_new < 0:
@@ -162,5 +206,7 @@ def sample_generate(plan: SplitPlan, params: Sequence[Any],
     b, p = prompt.shape
     params = jax.tree_util.tree_map(jnp.asarray, list(params))
     make = _kv_decode_fn if kv_cache else _decode_fn
-    run = make(plan, b, p, n_new, str(prompt.dtype), sample=True)
-    return run(params, prompt, rng, jnp.float32(temperature))
+    run = make(plan, b, p, n_new, str(prompt.dtype), sample=True,
+               top_k=top_k, use_top_p=top_p < 1.0)
+    return run(params, prompt, rng, jnp.float32(temperature),
+               jnp.float32(top_p))

@@ -250,3 +250,54 @@ def test_sample_generate_rejects_nonpositive_temperature(lm_data):
     with pytest.raises(ValueError, match="temperature"):
         sample_generate(plan, params, prompt, 4, jax.random.PRNGKey(0),
                         temperature=0.0)
+
+
+def test_topk_topp_sampling(lm_data):
+    """top-k / nucleus filtering invariants: top_k=1 and top_p→0 both
+    collapse to greedy at any temperature; top_k=k samples stay inside
+    the top-k set of the realized sequence's own logits; kv and
+    re-forward paths agree token-exactly under the same filters."""
+    from split_learning_tpu.runtime.generate import (greedy_generate,
+                                                     sample_generate)
+
+    plan = transformer_plan(lm=True, vocab=V, d_model=16, num_heads=1,
+                            client_depth=1, server_depth=1, max_len=64)
+    prompt = lm_data.train.x[:2, :6]
+    params = plan.init(jax.random.PRNGKey(6), prompt)
+    greedy = np.asarray(greedy_generate(plan, params, prompt, 5))
+
+    # top_k=1: sampling cannot deviate from argmax, whatever the rng/T
+    k1 = np.asarray(sample_generate(plan, params, prompt, 5,
+                                    jax.random.PRNGKey(9), 3.0, top_k=1))
+    np.testing.assert_array_equal(k1, greedy)
+    # top_p -> 0: only the single most-probable token survives
+    p0 = np.asarray(sample_generate(plan, params, prompt, 5,
+                                    jax.random.PRNGKey(9), 3.0,
+                                    top_p=1e-6))
+    np.testing.assert_array_equal(p0, greedy)
+
+    # top_k=3 at hot temperature: every generated token is in the top-3
+    # of the logits that produced it (teacher-forcing check)
+    out = np.asarray(sample_generate(plan, params, prompt, 5,
+                                     jax.random.PRNGKey(7), 2.0,
+                                     top_k=3))
+    logits = np.asarray(plan.apply(list(params), jnp.asarray(out)))
+    for pos in range(6, 11):
+        top3 = np.argsort(-logits[:, pos - 1], axis=-1)[:, :3]
+        for row in range(out.shape[0]):
+            assert out[row, pos] in top3[row], (pos, row)
+
+    # kv and re-forward paths agree under identical filters
+    a = np.asarray(sample_generate(plan, params, prompt, 5,
+                                   jax.random.PRNGKey(8), 0.9,
+                                   top_k=4, top_p=0.8, kv_cache=True))
+    b = np.asarray(sample_generate(plan, params, prompt, 5,
+                                   jax.random.PRNGKey(8), 0.9,
+                                   top_k=4, top_p=0.8, kv_cache=False))
+    np.testing.assert_array_equal(a, b)
+
+    # argument validation
+    for bad in ({"top_k": -1}, {"top_p": 0.0}, {"top_p": 1.5}):
+        with pytest.raises(ValueError):
+            sample_generate(plan, params, prompt, 2,
+                            jax.random.PRNGKey(0), **bad)
