@@ -289,16 +289,20 @@ class FederatedClientTrainer:
         step = start_step
         for epoch in range(epochs if epochs is not None else self.cfg.epochs):
             epoch_losses = []
+            n_examples = 0
             for x, y in data_iter():
                 self.ensure_init(x)
                 self.state, loss = self._step(
                     self.state, jnp.asarray(x), jnp.asarray(y))
                 epoch_losses.append(float(loss))
+                n_examples += len(y)
                 step += 1
             avg_loss = float(np.mean(epoch_losses)) if epoch_losses else float("nan")
-            # per-epoch sync ≡ src/client_part.py:171-194
+            # per-epoch sync ≡ src/client_part.py:171-194, weighted by
+            # this client's example count (canonical FedAvg)
             params_np = jax.tree_util.tree_map(np.asarray, self.state.params)
-            agg = self.transport.aggregate(params_np, epoch, avg_loss, step)
+            agg = self.transport.aggregate(params_np, epoch, avg_loss, step,
+                                           num_examples=n_examples or None)
             agg = jax.tree_util.tree_map(jnp.asarray, agg)
             self.state = TrainState(params=agg, opt_state=self.state.opt_state,
                                     step=self.state.step)

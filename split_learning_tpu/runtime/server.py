@@ -224,13 +224,19 @@ class ServerRuntime:
             return np.asarray(g_acts)
 
     def aggregate(self, params: Any, epoch: int, loss: float,
-                  step: int) -> Any:
+                  step: int, num_examples: Optional[int] = None) -> Any:
         if self.mode != "federated":
             raise ProtocolError(
                 f"aggregate called in mode {self.mode!r}", status=400)
+        if num_examples is not None and num_examples <= 0:
+            raise ProtocolError(
+                f"num_examples must be positive (got {num_examples})",
+                status=400)
         # submit() blocks until the FedAvg round is full — it must run
         # OUTSIDE the runtime lock or concurrent clients deadlock.
-        mean_params = self._agg.submit(params)
+        mean_params = self._agg.submit(
+            params,
+            weight=float(num_examples) if num_examples is not None else None)
         with self._lock:
             self.state = TrainState(
                 params=mean_params,
@@ -306,18 +312,38 @@ class FedAvgAggregator:
             del self._results[round_id]
         return slot[0]
 
-    def submit(self, params: Any, timeout: float = 120.0) -> Any:
+    def submit(self, params: Any, timeout: float = 120.0,
+               weight: Optional[float] = None) -> Any:
         """Blocks until the round is full, then returns the mean pytree of
         the round this submission joined (keyed by round id — late wakeups
-        never see a newer round's result)."""
-        entry = (object(), params)  # unique token: a retry after timeout
+        never see a newer round's result). ``weight`` is this client's
+        FedAvg weight (canonically its example count; None = uniform).
+        A round is weighted only when EVERY submission carries a weight —
+        mixing a raw example count against a defaulted 1.0 would silently
+        near-exclude the defaulting client, so mixed rounds fall back to
+        uniform with a warning."""
+        if weight is not None and not weight > 0:
+            # reject before touching shared state: a bad weight must 400
+            # its own client, never poison the round for everyone else
+            raise ValueError(f"FedAvg weight must be > 0 (got {weight})")
+        entry = (object(), params, weight)  # token: a retry after timeout
         with self._cond:            # must not leave a stale double-count
             round_id = self._round
             self._pending.append(entry)
             if len(self._pending) >= self.num_clients:
                 from split_learning_tpu.runtime.state import fedavg_mean
+                ws = [w for _, _, w in self._pending]
+                if any(w is None for w in ws):
+                    if any(w is not None for w in ws):
+                        import sys
+                        print("[fedavg] mixed weighted/unweighted round "
+                              "(some clients omitted num_examples); "
+                              "falling back to uniform averaging",
+                              file=sys.stderr)
+                    ws = None
                 self._results[round_id] = [
-                    fedavg_mean([p for _, p in self._pending]),
+                    fedavg_mean([p for _, p, _ in self._pending],
+                                weights=ws),
                     self.num_clients]
                 self._pending = []
                 self._round += 1

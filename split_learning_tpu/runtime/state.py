@@ -44,14 +44,37 @@ def apply_grads(tx: optax.GradientTransformation, state: TrainState,
     return TrainState(params=params, opt_state=opt_state, step=state.step + 1)
 
 
-def fedavg_mean(params_list) -> Params:
-    """Unweighted FedAvg: leafwise mean over client param pytrees — the
-    real aggregation the reference left as a TODO (src/server_part.py:81-82).
-    Shared by the server aggregator and client bottom-stage sync."""
+def fedavg_mean(params_list, weights=None) -> Params:
+    """FedAvg: leafwise mean over client param pytrees — the real
+    aggregation the reference left as a TODO (src/server_part.py:81-82).
+    ``weights`` (e.g. per-client example counts — the canonical FedAvg
+    weighting) makes it a weighted mean; None = uniform. Shared by the
+    server aggregator and client bottom-stage sync."""
     import jax
     import jax.numpy as jnp
+    import numpy as np
     if len(params_list) == 1:
         return params_list[0]
-    return jax.tree_util.tree_map(
-        lambda *xs: jnp.mean(jnp.stack([jnp.asarray(x) for x in xs]), axis=0),
-        *params_list)
+    if weights is None:
+        return jax.tree_util.tree_map(
+            lambda *xs: jnp.mean(jnp.stack([jnp.asarray(x) for x in xs]),
+                                 axis=0),
+            *params_list)
+    if len(weights) != len(params_list):
+        raise ValueError(f"{len(weights)} weights for "
+                         f"{len(params_list)} param trees")
+    w = np.asarray(weights, dtype=np.float64)
+    if not (w > 0).all():
+        raise ValueError(f"weights must be positive (got {weights})")
+    w = w / w.sum()
+
+    def wmean(*xs):
+        # accumulate in at least f32 but never below the leaves' own
+        # precision (x64 params stay x64, like the uniform path)
+        acc = jnp.result_type(*[jnp.asarray(x).dtype for x in xs],
+                              jnp.float32)
+        return jnp.tensordot(
+            jnp.asarray(w, acc),
+            jnp.stack([jnp.asarray(x, acc) for x in xs]), axes=1)
+
+    return jax.tree_util.tree_map(wmean, *params_list)

@@ -135,12 +135,14 @@ class Transport(abc.ABC):
     # -- federated mode: one round trip per epoch ------------------------
     @abc.abstractmethod
     def aggregate(self, params: Params, epoch: int, loss: float,
-                  step: int) -> Params:
+                  step: int, num_examples: int | None = None) -> Params:
         """Submit local weights; receive the aggregated (FedAvg) weights.
 
         Contract of ``POST /aggregate_weights`` (``src/server_part.py:60-93``)
         — except aggregation here is a real mean, not the reference's
-        single-client overwrite (``src/server_part.py:81-83``)."""
+        single-client overwrite (``src/server_part.py:81-83``).
+        ``num_examples`` is this client's epoch example count, the
+        canonical FedAvg weight (None = uniform)."""
 
     @abc.abstractmethod
     def health(self) -> Dict[str, Any]:
@@ -199,9 +201,10 @@ class FaultyTransport(Transport):
         self.injector.maybe_fail("u_backward", step)
         return self.inner.u_backward(feat_grads, step, client_id)
 
-    def aggregate(self, params, epoch, loss, step):
+    def aggregate(self, params, epoch, loss, step, num_examples=None):
         self.injector.maybe_fail("aggregate", step)
-        return self.inner.aggregate(params, epoch, loss, step)
+        return self.inner.aggregate(params, epoch, loss, step,
+                                    num_examples)
 
     def health(self):
         return self.inner.health()

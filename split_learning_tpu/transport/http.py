@@ -103,9 +103,11 @@ class SplitHTTPServer:
                         out = outer.runtime.predict(req["activations"], cid)
                         body = codec.encode({"outputs": pack(out)})
                     elif self.path == "/aggregate_weights":
+                        n_ex = req.get("num_examples")
                         agg = outer.runtime.aggregate(
                             req["model_state"], int(req["epoch"]),
-                            float(req["loss"]), int(req["step"]))
+                            float(req["loss"]), int(req["step"]),
+                            int(n_ex) if n_ex is not None else None)
                         body = codec.encode({"model_state": agg})
                     else:
                         self._reply(404, codec.encode({"error": "not found"}))
@@ -223,12 +225,14 @@ class HttpTransport(Transport):
                 "client_id": client_id,
             })["outputs"]
 
-    def aggregate(self, params: Any, epoch: int, loss: float, step: int) -> Any:
+    def aggregate(self, params: Any, epoch: int, loss: float, step: int,
+                  num_examples: int | None = None) -> Any:
         with timed(self.stats):
-            return self._post("/aggregate_weights", {
-                "model_state": params, "epoch": epoch,
-                "loss": loss, "step": step,
-            })["model_state"]
+            payload = {"model_state": params, "epoch": epoch,
+                       "loss": loss, "step": step}
+            if num_examples is not None:
+                payload["num_examples"] = int(num_examples)
+            return self._post("/aggregate_weights", payload)["model_state"]
 
     def health(self) -> Dict[str, Any]:
         try:

@@ -74,11 +74,12 @@ class LocalTransport(Transport):
                 self._roundtrip(np.asarray(feat_grads)), step, client_id)
             return self._roundtrip(g)
 
-    def aggregate(self, params: Any, epoch: int, loss: float, step: int) -> Any:
+    def aggregate(self, params: Any, epoch: int, loss: float, step: int,
+                  num_examples: int | None = None) -> Any:
         with timed(self.stats):
             return self._roundtrip(self._call(
                 self.server.aggregate,
-                self._roundtrip(params), epoch, loss, step))
+                self._roundtrip(params), epoch, loss, step, num_examples))
 
     def health(self) -> Dict[str, Any]:
         return self.server.health()

@@ -139,3 +139,79 @@ def test_multi_client_transformer_lm():
     flat1 = jax.tree_util.tree_leaves(runner.clients[1].state.params)
     for a, b in zip(flat0, flat1):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_weighted_fedavg_by_example_count():
+    """Canonical FedAvg weights client updates by example count: the
+    aggregated params are the weighted mean, end-to-end through the
+    server aggregate op (num_examples on the wire) and directly through
+    fedavg_mean; uniform and 1-client behavior are unchanged."""
+    import threading
+
+    from split_learning_tpu.models import get_plan
+    from split_learning_tpu.runtime import ServerRuntime
+    from split_learning_tpu.runtime.state import fedavg_mean
+    from split_learning_tpu.utils import Config
+
+    # unit: weighted mean math
+    a = {"w": np.ones((2, 2), np.float32)}
+    b = {"w": np.full((2, 2), 4.0, np.float32)}
+    got = fedavg_mean([a, b], weights=[3, 1])
+    np.testing.assert_allclose(np.asarray(got["w"]),
+                               np.full((2, 2), 1.75), rtol=1e-6)
+    # uniform default unchanged
+    got = fedavg_mean([a, b])
+    np.testing.assert_allclose(np.asarray(got["w"]),
+                               np.full((2, 2), 2.5), rtol=1e-6)
+    with pytest.raises(ValueError):
+        fedavg_mean([a, b], weights=[1])
+    with pytest.raises(ValueError):
+        fedavg_mean([a, b], weights=[1, 0])
+
+    # end-to-end: two clients submit with different example counts
+    cfg = Config(mode="federated", num_clients=2, batch_size=8)
+    plan = get_plan(mode="federated")
+    rs = np.random.RandomState(0)
+    x = rs.randn(8, 28, 28, 1).astype(np.float32)
+    runtime = ServerRuntime(plan, cfg, jax.random.PRNGKey(0), x)
+
+    p1 = jax.tree_util.tree_map(lambda l: np.ones_like(l),
+                                runtime.state.params)
+    p2 = jax.tree_util.tree_map(lambda l: np.full_like(l, 4.0),
+                                runtime.state.params)
+    results = {}
+
+    def client(name, params, n):
+        results[name] = runtime.aggregate(params, 0, 1.0,
+                                          {"c1": 1, "c2": 2}[name],
+                                          num_examples=n)
+
+    t = threading.Thread(target=client, args=("c1", p1, 300))
+    t.start()
+    client("c2", p2, 100)
+    t.join(timeout=30)
+    want = 0.75 * 1.0 + 0.25 * 4.0  # 300:100 weighting
+    for res in results.values():
+        leaf = jax.tree_util.tree_leaves(res)[0]
+        np.testing.assert_allclose(np.asarray(leaf),
+                                   np.full_like(np.asarray(leaf), want),
+                                   rtol=1e-6)
+
+    # mixed round (one client omits num_examples): uniform fallback —
+    # never a raw count averaged against a defaulted weight
+    results.clear()
+    t = threading.Thread(target=lambda: results.__setitem__(
+        "c1", runtime.aggregate(p1, 1, 1.0, 3, num_examples=300)))
+    t.start()
+    results["c2"] = runtime.aggregate(p2, 1, 1.0, 4)  # no count
+    t.join(timeout=30)
+    for res in results.values():
+        leaf = jax.tree_util.tree_leaves(res)[0]
+        np.testing.assert_allclose(np.asarray(leaf),
+                                   np.full_like(np.asarray(leaf), 2.5),
+                                   rtol=1e-6)
+
+    # invalid count 400s its own client without poisoning the round
+    from split_learning_tpu.runtime.server import ProtocolError
+    with pytest.raises(ProtocolError):
+        runtime.aggregate(p1, 2, 1.0, 5, num_examples=0)
