@@ -141,6 +141,7 @@ def test_multi_client_transformer_lm():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
 
 
+@pytest.mark.slow
 def test_weighted_fedavg_by_example_count():
     """Canonical FedAvg weights client updates by example count: the
     aggregated params are the weighted mean, end-to-end through the

@@ -252,6 +252,7 @@ def test_sample_generate_rejects_nonpositive_temperature(lm_data):
                         temperature=0.0)
 
 
+@pytest.mark.slow
 def test_topk_topp_sampling(lm_data):
     """top-k / nucleus filtering invariants: top_k=1 and top_p→0 both
     collapse to greedy at any temperature; top_k=k samples stay inside
