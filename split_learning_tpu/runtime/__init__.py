@@ -6,7 +6,8 @@ from split_learning_tpu.runtime.client import (
     USplitClientTrainer,
 )
 from split_learning_tpu.runtime.checkpoint import Checkpointer, joint_state
-from split_learning_tpu.runtime.generate import greedy_generate, sample_generate
+from split_learning_tpu.runtime.generate import (
+    generate_remote, greedy_generate, sample_generate)
 from split_learning_tpu.runtime.evaluate import evaluate, evaluate_remote
 from split_learning_tpu.runtime.multi_client import MultiClientSplitRunner
 from split_learning_tpu.runtime.pipelined_client import PipelinedSplitClientTrainer
@@ -23,5 +24,5 @@ __all__ = [
     "ProtocolError", "TrainState", "make_state", "apply_grads", "sgd",
     "Checkpointer", "joint_state", "MultiClientSplitRunner",
     "PipelinedSplitClientTrainer", "greedy_generate", "sample_generate",
-    "evaluate", "evaluate_remote",
+    "evaluate", "evaluate_remote", "generate_remote",
 ]

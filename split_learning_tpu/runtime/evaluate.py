@@ -70,6 +70,28 @@ def evaluate(plan: SplitPlan, params: Sequence[Any], split: Split,
         lambda x, y: fwd(params, jnp.asarray(x), jnp.asarray(y)))
 
 
+def split_client_stages(plan: SplitPlan, client_params: Sequence[Any]):
+    """Partition the client-owned stages (and their params) around the
+    server stage: ``(pre_stages, pre_params, post_stages, post_params)``
+    — the ownership protocol shared by split-party evaluation and
+    decoding. Raises on a params/ownership mismatch or a plan without a
+    server stage."""
+    client_idx = plan.stages_of("client")
+    if len(client_params) != len(client_idx):
+        raise ValueError(
+            f"expected params for {len(client_idx)} client-owned stages, "
+            f"got {len(client_params)}")
+    server_idx = plan.stages_of("server")
+    if not server_idx:
+        raise ValueError("plan has no server-owned stage to call remotely")
+    first_server = min(server_idx)
+    client_params = jax.tree_util.tree_map(jnp.asarray, list(client_params))
+    pre_stages = [plan.stages[i] for i in client_idx if i < first_server]
+    post_stages = [plan.stages[i] for i in client_idx if i > first_server]
+    return (pre_stages, client_params[:len(pre_stages)],
+            post_stages, client_params[len(pre_stages):])
+
+
 def evaluate_remote(plan: SplitPlan, client_params: Sequence[Any],
                     transport: Any, split: Split,
                     batch_size: int = 512) -> Dict[str, float]:
@@ -81,17 +103,8 @@ def evaluate_remote(plan: SplitPlan, client_params: Sequence[Any],
     U-shape). Labels never leave the client either way; metrics match
     :func:`evaluate` of the full composition to float tolerance
     (tests/test_split_inference.py)."""
-    client_idx = plan.stages_of("client")
-    if len(client_params) != len(client_idx):
-        raise ValueError(
-            f"expected params for {len(client_idx)} client-owned stages, "
-            f"got {len(client_params)}")
-    client_params = jax.tree_util.tree_map(jnp.asarray, list(client_params))
-    first_server = min(plan.stages_of("server"))
-    pre_stages = [plan.stages[i] for i in client_idx if i < first_server]
-    post_stages = [plan.stages[i] for i in client_idx if i > first_server]
-    pre_params = client_params[:len(pre_stages)]
-    post_params = client_params[len(pre_stages):]
+    pre_stages, pre_params, post_stages, post_params = \
+        split_client_stages(plan, client_params)
 
     @jax.jit
     def pre(params, x):

@@ -23,7 +23,7 @@ with split ownership: generation needs the full composition
 from __future__ import annotations
 
 import functools
-from typing import Any, Sequence
+from typing import Any, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -170,6 +170,99 @@ def greedy_generate(plan: SplitPlan, params: Sequence[Any],
     run = make(plan, b, p, n_new, str(prompt.dtype), sample=False)
     return run(params, prompt, jax.random.PRNGKey(0), jnp.float32(1.0),
                jnp.float32(1.0))
+
+
+@functools.lru_cache(maxsize=32)
+def _remote_decode_fns(plan: SplitPlan, sample: bool, top_k: int,
+                       use_top_p: bool, dtype_name: str):
+    """Compiled client-side halves of the remote decode, cached like
+    :func:`_decode_fn` so a serving loop never re-jits: ``pre`` runs the
+    client stages before the cut, ``choose`` runs the post-cut client
+    stages (the U-shape head) and picks the next token. The stage
+    partition derives from ``plan`` alone, which is in the cache key."""
+    dtype = jnp.dtype(dtype_name)
+    pick = _pick_fn(sample, top_k, use_top_p, dtype)
+    client_idx = plan.stages_of("client")
+    first_server = min(plan.stages_of("server"))
+    pre_stages = tuple(plan.stages[i] for i in client_idx
+                       if i < first_server)
+    post_stages = tuple(plan.stages[i] for i in client_idx
+                        if i > first_server)
+
+    @jax.jit
+    def pre_fn(params, buf):
+        x = buf
+        for st, pr in zip(pre_stages, params):
+            x = st.apply(pr, x)
+        return x
+
+    @jax.jit
+    def choose_fn(params, out, pos, rng, temperature, top_p):
+        logits = out
+        for st, pr in zip(post_stages, params):
+            logits = st.apply(pr, logits)
+        row = jax.lax.dynamic_index_in_dim(logits, pos, axis=1,
+                                           keepdims=False)
+        return pick(row, pos, rng, temperature, top_p)
+
+    return pre_fn, choose_fn
+
+
+def generate_remote(plan: SplitPlan, client_params: Sequence[Any],
+                    transport: Any, prompt: np.ndarray, n_new: int,
+                    rng: Optional[jax.Array] = None,
+                    temperature: float = 1.0, *,
+                    top_k: int = 0, top_p: float = 1.0) -> np.ndarray:
+    """Split-party autoregressive decode: the client holds ONLY its own
+    stages (and picks the tokens); the server-owned compute runs behind
+    ``transport.predict`` — one forward-only round trip per generated
+    token, the decode analog of
+    :func:`...evaluate.evaluate_remote`. Greedy when ``rng`` is None
+    (the sampling knobs must stay at their defaults — passing them
+    without an rng is an error, never a silent greedy decode), else
+    temperature/top-k/top-p sampling with the same semantics as
+    :func:`sample_generate`.
+
+    Uses the re-forward scheme over a fixed-size buffer (the causal
+    mask keeps unwritten positions inert), so the client stages compile
+    once per (plan, shape) and the wire carries ``[B, P+n_new, E]``
+    activations per hop; per-token KV caching across a wire is
+    deliberately out of scope (the cache lives server-side in a serving
+    system, a different protocol). Token-exact vs the local
+    composed-plan decode (tests/test_split_inference.py)."""
+    if not temperature > 0.0:
+        raise ValueError(f"temperature must be > 0 (got {temperature})")
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0 (got {top_k})")
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1] (got {top_p})")
+    if rng is None and (temperature != 1.0 or top_k or top_p != 1.0):
+        raise ValueError(
+            "sampling knobs (temperature/top_k/top_p) require rng; "
+            "omit them for greedy decoding")
+    prompt = np.asarray(prompt)
+    if n_new <= 0:
+        if n_new < 0:
+            raise ValueError(f"n_new must be >= 0 (got {n_new})")
+        return prompt
+    b, p = prompt.shape
+    total = p + n_new
+    from split_learning_tpu.runtime.evaluate import split_client_stages
+    _, pre_params, _, post_params = \
+        split_client_stages(plan, client_params)
+    pre_fn, choose_fn = _remote_decode_fns(
+        plan, rng is not None, top_k, top_p < 1.0, str(prompt.dtype))
+
+    buf = np.zeros((b, total), prompt.dtype)
+    buf[:, :p] = prompt
+    rng_in = rng if rng is not None else jax.random.PRNGKey(0)
+    for pos in range(p - 1, total - 1):
+        acts = pre_fn(pre_params, jnp.asarray(buf))
+        out = transport.predict(np.asarray(acts))
+        buf[:, pos + 1] = np.asarray(choose_fn(
+            post_params, jnp.asarray(out), pos, rng_in,
+            jnp.float32(temperature), jnp.float32(top_p)))
+    return buf
 
 
 def sample_generate(plan: SplitPlan, params: Sequence[Any],
