@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""Opportunistic TPU measurement runner for a flaky device tunnel.
+
+Round-3 lesson (BASELINE.md "measurement debt"): the axon tunnel serves
+short live windows and then wedges — a monolithic sweep that writes its
+artifact only at the end loses everything when the window closes
+mid-leg. This runner inverts that: probe cheaply in a fresh process,
+and while the tunnel answers, burn down a *prioritized* leg list,
+appending every result to ``artifacts/tpu_window_runs.jsonl`` the
+moment it lands. A wedged leg sends us back to probing; completed legs
+are never re-run (state in ``/tmp/tpu_runner_state.json``).
+
+Legs reuse bench.py's subprocess protocol (fresh PJRT client per leg,
+every number carries bench.py's own publication gate).
+
+Usage:  nohup python scripts/tpu_window_runner.py > /tmp/tpu_runner.log &
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+OUT = os.path.join(REPO, "artifacts", "tpu_window_runs.jsonl")
+STATE = "/tmp/tpu_runner_state.json"
+PROBE_INTERVAL = 300
+PROBE_TIMEOUT = 150
+
+TRANSFORMER = {"SLT_BENCH_MODEL": "transformer",
+               "SLT_BENCH_DTYPE": "bfloat16"}
+
+
+def _t_leg(seq, batch, attn, quick, timeout):
+    env = dict(TRANSFORMER)
+    env.update({"SLT_BENCH_SEQ": str(seq), "SLT_BENCH_BATCH": str(batch),
+                "SLT_BENCH_ATTN": attn})
+    return {"id": f"T{seq}.b{batch}.{attn}.{'q' if quick else 'full'}",
+            "role": "fused", "env": env, "quick": quick, "timeout": timeout,
+            "seq_len": seq, "batch": batch, "attn": attn}
+
+
+# Priority order: the numbers that decide round-4 design questions first
+# (does the reworked flash kernel beat dense at trainable T?), then the
+# crossover/ceiling probes, then decode, then the headline CNN legs,
+# then non-quick confirmations.
+LEGS = [
+    _t_leg(1024, 64, "flash", True, 900),
+    _t_leg(1024, 64, "full", True, 900),
+    _t_leg(4096, 16, "flash", True, 1200),
+    _t_leg(4096, 16, "full", True, 1200),
+    {"id": "decode.q", "role": "decode", "env": {}, "quick": True,
+     "timeout": 900},
+    _t_leg(8192, 16, "flash", True, 1500),
+    _t_leg(8192, 16, "full", True, 1500),
+    _t_leg(16384, 16, "flash", True, 1700),
+    _t_leg(16384, 16, "full", True, 1700),
+    {"id": "cnn_headline.q", "role": "fused", "env": {}, "quick": True,
+     "timeout": 900},
+    {"id": "cnn_b1024_bf16_scan.q", "role": "fused",
+     "env": {"SLT_BENCH_BATCH": "1024", "SLT_BENCH_DTYPE": "bfloat16"},
+     "quick": True, "timeout": 900},
+    {"id": "decode.full", "role": "decode", "env": {}, "quick": False,
+     "timeout": 1500},
+    _t_leg(1024, 64, "flash", False, 1200),
+    _t_leg(1024, 64, "full", False, 1200),
+    _t_leg(256, 64, "flash", False, 900),
+    _t_leg(256, 64, "full", False, 900),
+    {"id": "cnn_headline.full", "role": "fused", "env": {}, "quick": False,
+     "timeout": 1200},
+]
+
+MAX_ATTEMPTS = 3
+
+
+def log(msg):
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
+          flush=True)
+
+
+def load_state():
+    try:
+        with open(STATE) as f:
+            return json.load(f)
+    except Exception:
+        return {"done": [], "attempts": {}}
+
+
+def save_state(st):
+    with open(STATE, "w") as f:
+        json.dump(st, f)
+
+
+def append(rec):
+    rec["ts"] = time.time()
+    rec["date"] = time.strftime("%Y-%m-%d %H:%M:%S")
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def probe() -> bool:
+    code = ("import jax; d = jax.devices()[0]; "
+            "import jax.numpy as jnp; "
+            "x = jnp.ones((256, 256)); "
+            "print('PROBE_OK', d.platform, float((x @ x).sum()))")
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             timeout=PROBE_TIMEOUT)
+    except subprocess.TimeoutExpired:
+        return False
+    return "PROBE_OK tpu" in out.stdout
+
+
+def run_leg(leg) -> dict:
+    env = dict(os.environ)
+    env.update(leg["env"])
+    cmd = [sys.executable, os.path.join(REPO, "bench.py"),
+           "--role", leg["role"]]
+    if leg["quick"]:
+        cmd.append("--quick")
+    t0 = time.time()
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=leg["timeout"], env=env, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return {"leg": leg["id"], "status": "timeout",
+                "wall_s": round(time.time() - t0, 1)}
+    rec = {"leg": leg["id"], "wall_s": round(time.time() - t0, 1),
+           "returncode": out.returncode}
+    for line in out.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec["result"] = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                pass
+    if "result" in rec:
+        rec["status"] = "ok" if rec["result"].get("valid", True) else \
+            "invalid"
+    else:
+        err = (out.stderr + out.stdout)[-600:]
+        rec["status"] = ("oom" if "Ran out of memory in memory space hbm"
+                         in err else "error")
+        rec["detail"] = err
+    return rec
+
+
+def main():
+    st = load_state()
+    log(f"runner up; {len(st['done'])}/{len(LEGS)} legs already done")
+    while True:
+        remaining = [l for l in LEGS if l["id"] not in st["done"]
+                     and st["attempts"].get(l["id"], 0) < MAX_ATTEMPTS]
+        if not remaining:
+            log("all legs done or exhausted; exiting")
+            append({"leg": "__runner_done__", "status": "done",
+                    "done": st["done"]})
+            return
+        if not probe():
+            log(f"tunnel down ({len(remaining)} legs remain); "
+                f"sleeping {PROBE_INTERVAL}s")
+            time.sleep(PROBE_INTERVAL)
+            continue
+        log("tunnel LIVE")
+        for leg in remaining:
+            st["attempts"][leg["id"]] = st["attempts"].get(leg["id"], 0) + 1
+            save_state(st)
+            log(f"leg {leg['id']} (attempt {st['attempts'][leg['id']]})...")
+            rec = run_leg(leg)
+            append(rec)
+            log(f"  -> {rec['status']} "
+                f"{(rec.get('result') or {}).get('steps_per_sec', '')}")
+            if rec["status"] in ("ok", "invalid", "oom"):
+                st["done"].append(leg["id"])
+                save_state(st)
+            elif rec["status"] == "timeout":
+                break  # tunnel likely wedged again: back to probing
+        else:
+            continue
+
+
+if __name__ == "__main__":
+    main()
