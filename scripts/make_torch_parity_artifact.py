@@ -1,0 +1,238 @@
+#!/usr/bin/env python
+"""Cross-framework loss-curve parity: this repo's JAX split CNN vs a
+reference-style **torch** implementation of the same model.
+
+The reference's acceptance criterion is its MLflow loss curve (torch
+CNN, SGD lr=0.01, batch 64, 3 epochs — ``/root/reference/src/
+client_part.py:17,98,107``). The committed ``parity_mnist_split.jsonl``
+proves split ≡ monolithic *within this framework*; this artifact closes
+the remaining inferential gap by training the reference's own stack
+(torch CPU, re-implemented from the architecture spec at
+``src/model_def.py:5-28`` — not copied) on the SAME synthetic dataset,
+SAME seeded batch order, and the SAME initial weights (this repo's
+flax init, exported into torch layout), and recording both per-step
+loss curves side by side.
+
+Identical init + identical data order means the curves must agree to
+f32 cross-library conv-numerics drift — step-0 agreement is exact math
+(no updates yet), and early-step agreement bounds the framework
+difference before divergence compounds. Real MNIST is attempted first
+and the failure recorded, exactly like make_parity_artifact.py.
+
+Layout mapping (the only nontrivial part — NHWC flax -> NCHW torch):
+  conv kernel  HWIO (3,3,I,O)  -> torch OIHW: transpose(3,2,0,1)
+  fc kernel    (9216,10) consumes NHWC flatten (12,12,64); torch
+               flattens NCHW (64,12,12), so remap rows:
+               reshape(12,12,64,10).transpose(3,2,0,1).reshape(10,9216)
+
+Writes ``artifacts/parity_vs_torch.jsonl``; asserted by
+``tests/test_torch_parity.py``.
+
+Usage:
+    python scripts/make_torch_parity_artifact.py [--steps N]
+        [--rerun-jax] [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(1, os.path.dirname(os.path.abspath(__file__)))
+
+def _ensure_cpu():
+    """CPU-only, axon plugin disabled (PALLAS_AXON_POOL_IPS=""
+    short-circuits the sitecustomize register hook) — same re-exec shape
+    as scripts/measure_reference_gap.py. Called from __main__ only so
+    importing this module (tests/test_torch_parity.py) never replaces
+    the host process."""
+    if (os.environ.get("JAX_PLATFORMS", "").strip().lower() != "cpu"
+            or os.environ.get("PALLAS_AXON_POOL_IPS", None) != ""):
+        env = dict(os.environ)
+        env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""})
+        os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+
+from make_parity_artifact import (BATCH, EPOCHS, LR, epoch_batches,  # noqa: E402
+                                  get_data, run_monolithic)
+
+COMMITTED = os.path.join(REPO, "artifacts", "parity_mnist_split.jsonl")
+
+
+def jax_init_params():
+    """The flax init every parity variant shares (seed 42)."""
+    import jax
+    import jax.numpy as jnp
+
+    from split_learning_tpu.models import get_plan
+
+    plan = get_plan(mode="split")
+    x0 = jnp.zeros((BATCH, 28, 28, 1), jnp.float32)
+    return plan.init(jax.random.PRNGKey(42), x0)
+
+
+def build_torch_split(params):
+    """Reference-architecture torch halves carrying the flax init.
+
+    PartA ≡ src/model_def.py:5-12, PartB ≡ src/model_def.py:15-28,
+    re-implemented from the spec (Conv2d(1→32,k3)+ReLU;
+    Conv2d(32→64,k3)+ReLU → MaxPool2 → Flatten → Linear(9216,10)).
+    """
+    import numpy as np
+    import torch
+    from torch import nn
+
+    a_p, b_p = params[0]["params"], params[1]["params"]
+
+    part_a = nn.Sequential(nn.Conv2d(1, 32, 3), nn.ReLU())
+    part_b = nn.Sequential(nn.Conv2d(32, 64, 3), nn.ReLU(),
+                           nn.MaxPool2d(2), nn.Flatten(),
+                           nn.Linear(9216, 10))
+
+    def conv_w(k):  # HWIO -> OIHW
+        return torch.from_numpy(np.asarray(k).transpose(3, 2, 0, 1).copy())
+
+    def vec(v):
+        return torch.from_numpy(np.array(v, copy=True))
+
+    with torch.no_grad():
+        part_a[0].weight.copy_(conv_w(a_p["conv1"]["kernel"]))
+        part_a[0].bias.copy_(vec(a_p["conv1"]["bias"]))
+        part_b[0].weight.copy_(conv_w(b_p["conv2"]["kernel"]))
+        part_b[0].bias.copy_(vec(b_p["conv2"]["bias"]))
+        fc = np.asarray(b_p["fc"]["kernel"])  # (9216, 10), HWC rows
+        part_b[4].weight.copy_(torch.from_numpy(
+            fc.reshape(12, 12, 64, 10).transpose(3, 2, 0, 1)
+            .reshape(10, 9216).copy()))
+        part_b[4].bias.copy_(vec(b_p["fc"]["bias"]))
+    return part_a, part_b
+
+
+def run_torch(x, y, steps_limit=None):
+    """The reference's split training loop, in-process (the wire moves
+    no math: split fwd/bwd ≡ full fwd/bwd — SURVEY.md §3.1). Two SGD
+    optimizers at lr=0.01, one per party, like client_part.py:17 /
+    server_part.py:15."""
+    import torch
+    from torch import nn
+
+    part_a, part_b = build_torch_split(jax_init_params())
+    opt_a = torch.optim.SGD(part_a.parameters(), lr=LR)
+    opt_b = torch.optim.SGD(part_b.parameters(), lr=LR)
+    criterion = nn.CrossEntropyLoss()
+
+    losses = []
+    done = False
+    for epoch in range(EPOCHS):
+        for xb, yb in epoch_batches(x, y, epoch):
+            xt = torch.from_numpy(xb.transpose(0, 3, 1, 2).copy())
+            yt = torch.from_numpy(yb)
+            opt_a.zero_grad()
+            opt_b.zero_grad()
+            loss = criterion(part_b(part_a(xt)), yt)
+            loss.backward()
+            opt_a.step()
+            opt_b.step()
+            losses.append(float(loss.detach()))
+            if steps_limit and len(losses) >= steps_limit:
+                done = True
+                break
+        if done:
+            break
+    return losses
+
+
+def committed_jax_curve():
+    """The monolithic per-step curve from the committed parity artifact
+    (same synthetic data, same seeds, same init by construction)."""
+    try:
+        with open(COMMITTED) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec.get("kind") == "curve" and \
+                        rec.get("variant") == "monolithic":
+                    return rec["losses"]
+    except FileNotFoundError:
+        pass
+    return None
+
+
+def compare(jax_losses, torch_losses):
+    n = min(len(jax_losses), len(torch_losses))
+    diffs = [abs(a - b) for a, b in zip(jax_losses[:n], torch_losses[:n])]
+    k = min(100, n)
+    tail = diffs[-50:] if n >= 50 else diffs
+    return {
+        "steps_compared": n,
+        "step0_abs_diff": diffs[0],
+        "max_abs_diff_first_100": max(diffs[:k]),
+        "mean_abs_diff": sum(diffs) / n,
+        "mean_abs_diff_last_50": sum(tail) / len(tail),
+        "jax_final_loss": jax_losses[n - 1],
+        "torch_final_loss": torch_losses[n - 1],
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=None,
+                    help="limit steps (default: full 3-epoch workload)")
+    ap.add_argument("--rerun-jax", action="store_true",
+                    help="recompute the JAX curve instead of reading the "
+                         "committed parity artifact")
+    ap.add_argument("--out", default=os.path.join(
+        REPO, "artifacts", "parity_vs_torch.jsonl"))
+    args = ap.parse_args()
+
+    x, y, attempt = get_data(os.path.join(REPO, ".data", "mnist"))
+
+    jax_curve = None if args.rerun_jax else committed_jax_curve()
+    jax_src = "committed-artifact"
+    if jax_curve is None:
+        print("[torch-parity] computing JAX monolithic curve...",
+              file=sys.stderr, flush=True)
+        jax_curve, _ = run_monolithic(x, y)
+        jax_src = "recomputed"
+    if args.steps:
+        jax_curve = jax_curve[:args.steps]
+
+    print(f"[torch-parity] torch split loop "
+          f"({args.steps or 'full'} steps)...", file=sys.stderr, flush=True)
+    t0 = time.time()
+    torch_losses = run_torch(x, y, steps_limit=args.steps)
+    wall = time.time() - t0
+
+    import torch
+    summary = compare(jax_curve, torch_losses)
+    meta = {
+        "kind": "meta",
+        "dataset": "mnist" if attempt is None else "mnist-synthetic",
+        "jax_curve_source": jax_src,
+        "torch_version": torch.__version__,
+        "epochs": EPOCHS, "batch": BATCH, "lr": LR,
+        "init": "flax seed-42 init exported into torch layout",
+        "date": time.strftime("%Y-%m-%d"),
+    }
+    if attempt is not None:
+        meta["attempted_real_data"] = attempt
+    with open(args.out, "w") as f:
+        f.write(json.dumps(meta) + "\n")
+        f.write(json.dumps({"kind": "curve", "variant": "torch_reference",
+                            "wall_s": round(wall, 2),
+                            "losses": torch_losses}) + "\n")
+        f.write(json.dumps({"kind": "curve", "variant": "jax_monolithic",
+                            "source": jax_src,
+                            "losses": jax_curve}) + "\n")
+        f.write(json.dumps({"kind": "summary", **summary}) + "\n")
+    print(json.dumps(summary, indent=1))
+    print(args.out)
+
+
+if __name__ == "__main__":
+    _ensure_cpu()
+    main()

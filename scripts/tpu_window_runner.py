@@ -119,37 +119,23 @@ def probe() -> bool:
 
 
 def run_leg(leg) -> dict:
-    env = dict(os.environ)
-    env.update(leg["env"])
-    cmd = [sys.executable, os.path.join(REPO, "bench.py"),
-           "--role", leg["role"]]
-    if leg["quick"]:
-        cmd.append("--quick")
+    from bench import _run_subprocess  # the one subprocess protocol
     t0 = time.time()
-    try:
-        out = subprocess.run(cmd, capture_output=True, text=True,
-                             timeout=leg["timeout"], env=env, cwd=REPO)
-    except subprocess.TimeoutExpired:
-        return {"leg": leg["id"], "status": "timeout",
-                "wall_s": round(time.time() - t0, 1)}
-    rec = {"leg": leg["id"], "wall_s": round(time.time() - t0, 1),
-           "returncode": out.returncode}
-    for line in out.stdout.splitlines():
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                rec["result"] = json.loads(line)
-                break
-            except json.JSONDecodeError:
-                pass
-    if "result" in rec:
-        rec["status"] = "ok" if rec["result"].get("valid", True) else \
-            "invalid"
+    result, out = _run_subprocess(leg["role"], leg["quick"], leg["env"],
+                                  leg["timeout"], capture=True)
+    rec = {"leg": leg["id"], "wall_s": round(time.time() - t0, 1)}
+    if out == "timeout":
+        rec["status"] = "timeout"
+        return rec
+    rec["returncode"] = out.returncode
+    if result is not None and out.returncode == 0:
+        rec["result"] = result
+        rec["status"] = "ok" if result.get("valid", True) else "invalid"
     else:
-        err = (out.stderr + out.stdout)[-600:]
+        err = out.stderr + out.stdout
         rec["status"] = ("oom" if "Ran out of memory in memory space hbm"
                          in err else "error")
-        rec["detail"] = err
+        rec["detail"] = err[-600:]
     return rec
 
 
@@ -181,10 +167,11 @@ def main():
             if rec["status"] in ("ok", "invalid", "oom"):
                 st["done"].append(leg["id"])
                 save_state(st)
-            elif rec["status"] == "timeout":
-                break  # tunnel likely wedged again: back to probing
-        else:
-            continue
+            else:
+                # timeout OR error: the tunnel may have wedged (hanging
+                # or fail-fast) — go back to probing rather than burning
+                # one attempt on every remaining leg in minutes
+                break
 
 
 if __name__ == "__main__":

@@ -40,6 +40,7 @@ def test_resnet18_shapes_and_params(rng):
     assert 10_500_000 < total < 11_400_000
 
 
+@pytest.mark.slow
 def test_resnet18_stage_variants(rng):
     x, _ = cifar_batch()
     plan3 = get_plan(model="resnet18", mode="u_split")

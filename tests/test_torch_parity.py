@@ -1,0 +1,128 @@
+"""Cross-framework parity: the JAX split CNN vs a reference-style torch
+implementation (scripts/make_torch_parity_artifact.py).
+
+The reference's acceptance criterion is its torch loss curve
+(``/root/reference/src/client_part.py:107``, curve eyeballed per
+``README.md:105-107``). The committed ``parity_mnist_split.jsonl``
+establishes split ≡ monolithic within this framework; these tests pin
+the remaining step — this framework ≡ the reference's own stack — by
+(a) checking the weight-export forward equivalence live, (b) training
+both stacks for a few steps from identical init/data and comparing
+per-step losses, and (c) asserting the committed full-workload artifact.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+torch = pytest.importorskip("torch")
+
+from make_torch_parity_artifact import (  # noqa: E402
+    build_torch_split, compare, jax_init_params, run_torch)
+
+ARTIFACT = os.path.join(REPO, "artifacts", "parity_vs_torch.jsonl")
+
+
+def _synthetic(n=512):
+    from split_learning_tpu.data.datasets import synthetic
+    ds = synthetic("mnist", n_train=n, n_test=64, seed=0)
+    return ds.train.x, ds.train.y
+
+
+def test_weight_export_forward_equivalence():
+    """flax NHWC params exported into torch NCHW layout must produce the
+    same logits — this is the mapping the whole artifact rests on (conv
+    HWIO->OIHW, fc rows remapped HWC->CHW)."""
+    import jax.numpy as jnp
+
+    from split_learning_tpu.models import get_plan
+
+    params = jax_init_params()
+    part_a, part_b = build_torch_split(params)
+    x, _ = _synthetic(8)
+    x = x[:8]
+
+    plan = get_plan(mode="split")
+    jax_logits = np.asarray(plan.apply(params, jnp.asarray(x)))
+    with torch.no_grad():
+        t_logits = part_b(part_a(
+            torch.from_numpy(x.transpose(0, 3, 1, 2).copy()))).numpy()
+    np.testing.assert_allclose(jax_logits, t_logits, atol=2e-5)
+
+
+@pytest.mark.slow
+def test_short_training_curves_track():
+    """Same init, same batch order, same SGD: torch and JAX per-step
+    losses must agree to f32 cross-library conv drift over 12 steps.
+    (The committed artifact extends this to the full 2,814 steps.)"""
+    import jax
+    import jax.numpy as jnp
+
+    from split_learning_tpu.core import cross_entropy
+    from split_learning_tpu.models import get_plan
+    from split_learning_tpu.runtime import apply_grads, make_state, sgd
+
+    x, y = _synthetic(12 * 64)
+    steps = 12
+
+    torch_losses = run_torch(x, y, steps_limit=steps)
+
+    plan = get_plan(mode="split")
+    params = plan.init(jax.random.PRNGKey(42), jnp.asarray(x[:64]))
+    tx = sgd(0.01)
+    state = make_state(tuple(params), tx)
+
+    @jax.jit
+    def step(state, xb, yb):
+        loss, grads = jax.value_and_grad(
+            lambda p: cross_entropy(plan.apply(p, xb), yb))(state.params)
+        return apply_grads(tx, state, grads), loss
+
+    from make_torch_parity_artifact import epoch_batches
+    jax_losses = []
+    for xb, yb in epoch_batches(x, y, 0):
+        state, loss = step(state, jnp.asarray(xb), jnp.asarray(yb))
+        jax_losses.append(float(loss))
+        if len(jax_losses) >= steps:
+            break
+
+    diffs = [abs(a - b) for a, b in zip(jax_losses, torch_losses)]
+    assert max(diffs) < 1e-4, (jax_losses, torch_losses)
+
+
+def test_committed_artifact_full_workload():
+    """The committed artifact must cover the reference's complete
+    3-epoch workload with curve agreement at the numerics floor (the
+    stored JAX curve rounds to 4 decimals, so the floor is ~5e-5)."""
+    assert os.path.exists(ARTIFACT), (
+        "run scripts/make_torch_parity_artifact.py")
+    records = [json.loads(l) for l in open(ARTIFACT)]
+    by_kind = {}
+    for r in records:
+        by_kind.setdefault(r["kind"], []).append(r)
+    meta = by_kind["meta"][0]
+    summary = by_kind["summary"][0]
+    variants = {c["variant"] for c in by_kind["curve"]}
+    assert variants == {"torch_reference", "jax_monolithic"}
+
+    assert summary["steps_compared"] == 2814  # 938 x 3 epochs
+    assert summary["step0_abs_diff"] < 1e-5   # identical init, no updates
+    assert summary["max_abs_diff_first_100"] < 1e-4
+    assert summary["mean_abs_diff"] < 1e-4
+    # the synthetic fallback must be provably forced, not chosen
+    if meta["dataset"] == "mnist-synthetic":
+        assert meta["attempted_real_data"]["attempted"] is True
+        assert meta["attempted_real_data"]["error"]
+
+    # the recomputed summary from the stored curves must match the
+    # stored summary (the artifact is internally consistent)
+    curves = {c["variant"]: c["losses"] for c in by_kind["curve"]}
+    redo = compare(curves["jax_monolithic"], curves["torch_reference"])
+    assert redo["mean_abs_diff"] == pytest.approx(
+        summary["mean_abs_diff"], rel=1e-9)
