@@ -700,6 +700,8 @@ def cmd_eval(args) -> int:
 
 
 def main(argv: Optional[list] = None) -> int:
+    from split_learning_tpu.utils import ensure_pinned_platform_hermetic
+    ensure_pinned_platform_hermetic()  # JAX_PLATFORMS=cpu must never dial
     ap = argparse.ArgumentParser(prog="split_learning_tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
 

@@ -1,3 +1,4 @@
+from split_learning_tpu.utils.backend import ensure_pinned_platform_hermetic
 from split_learning_tpu.utils.config import Config
 
-__all__ = ["Config"]
+__all__ = ["Config", "ensure_pinned_platform_hermetic"]

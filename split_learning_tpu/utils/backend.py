@@ -1,0 +1,39 @@
+"""Backend hygiene for CPU-pinned processes.
+
+Some images register out-of-tree PJRT plugins at interpreter start (via
+``sitecustomize``) whose lazy device enumeration opens a network tunnel
+— and a wedged tunnel hangs the first bare ``jax.devices()`` call even
+under ``JAX_PLATFORMS=cpu``, because plugin *registration* ignores that
+env var. Tests solve this in ``tests/conftest.py``; this helper is the
+same guard for production entry points (the CLI, scripts), so a user who
+pins CPU gets CPU, never a hung tunnel dial.
+"""
+
+from __future__ import annotations
+
+import os
+
+_DEVICE_PLUGINS = ("axon",)   # out-of-tree PJRT factories seen in the wild
+
+
+def ensure_pinned_platform_hermetic() -> None:
+    """When ``JAX_PLATFORMS`` pins an explicit platform set, de-register
+    any device-plugin backend factory outside that set before a backend
+    initializes. No-op otherwise; safe to call multiple times; tolerant
+    of jax internals moving (falls back to trusting JAX_PLATFORMS)."""
+    plats = []   # order is priority order — preserve it, dedupe only
+    for p in os.environ.get("JAX_PLATFORMS", "").split(","):
+        p = p.strip().lower()
+        if p and p not in plats:
+            plats.append(p)
+    if not plats:
+        return
+    try:
+        import jax
+        import jax._src.xla_bridge as xb
+        jax.config.update("jax_platforms", ",".join(plats))
+        for name in _DEVICE_PLUGINS:
+            if name not in plats:
+                xb._backend_factories.pop(name, None)
+    except Exception:
+        pass
