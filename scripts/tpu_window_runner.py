@@ -65,6 +65,14 @@ LEGS = [
     {"id": "cnn_b1024_bf16_scan.q", "role": "fused",
      "env": {"SLT_BENCH_BATCH": "1024", "SLT_BENCH_DTYPE": "bfloat16"},
      "quick": True, "timeout": 900},
+    # north-star closure: the reference's full 3-epoch workload trained
+    # ON the chip (fused variant, per-epoch scan dispatch), appended to
+    # the committed parity artifact as the fused_tpu curve
+    {"id": "parity.fused_tpu",
+     "argv": [sys.executable, os.path.join(REPO, "scripts",
+                                           "make_parity_artifact.py"),
+              "--variant", "fused"],
+     "env": {}, "timeout": 1500},
     {"id": "decode.full", "role": "decode", "env": {}, "quick": False,
      "timeout": 1500},
     _t_leg(1024, 64, "flash", False, 1200),
@@ -118,11 +126,35 @@ def probe() -> bool:
     return "PROBE_OK tpu" in out.stdout
 
 
+def run_argv(leg):
+    """A leg that is its own script (e.g. the parity artifact): run the
+    argv, parse the last stdout JSON line as the result."""
+    env = dict(os.environ)
+    env.update(leg["env"])
+    try:
+        out = subprocess.run(leg["argv"], capture_output=True, text=True,
+                             timeout=leg["timeout"], env=env, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return None, "timeout"
+    rec = None
+    for line in out.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)   # last well-formed line wins
+            except json.JSONDecodeError:
+                pass
+    return rec, out
+
+
 def run_leg(leg) -> dict:
-    from bench import _run_subprocess  # the one subprocess protocol
     t0 = time.time()
-    result, out = _run_subprocess(leg["role"], leg["quick"], leg["env"],
-                                  leg["timeout"], capture=True)
+    if "argv" in leg:
+        result, out = run_argv(leg)
+    else:
+        from bench import _run_subprocess  # the one subprocess protocol
+        result, out = _run_subprocess(leg["role"], leg["quick"], leg["env"],
+                                      leg["timeout"], capture=True)
     rec = {"leg": leg["id"], "wall_s": round(time.time() - t0, 1)}
     if out == "timeout":
         rec["status"] = "timeout"
