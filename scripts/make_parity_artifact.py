@@ -259,6 +259,15 @@ def main() -> None:
     if args.variant is not None and os.path.exists(args.out):
         with open(args.out) as f:
             records = [json.loads(line) for line in f if line.strip()]
+    this_dataset = "mnist-synthetic" if is_synthetic else "mnist"
+    old_meta = next((r for r in records if r.get("kind") == "meta"), None)
+    if old_meta is not None and old_meta.get("dataset") != this_dataset:
+        raise SystemExit(
+            f"[parity] refusing --variant update: this run resolved "
+            f"dataset {this_dataset!r} but the existing artifact was "
+            f"built from {old_meta.get('dataset')!r} — curves from "
+            f"different data cannot be compared. Regenerate the full "
+            f"artifact (no --variant) or fix the data dir.")
     if not any(r.get("kind") == "meta" for r in records):
         meta = {
             "kind": "meta",

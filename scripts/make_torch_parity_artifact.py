@@ -45,19 +45,6 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 sys.path.insert(1, os.path.dirname(os.path.abspath(__file__)))
 
-def _ensure_cpu():
-    """CPU-only, axon plugin disabled (PALLAS_AXON_POOL_IPS=""
-    short-circuits the sitecustomize register hook) — same re-exec shape
-    as scripts/measure_reference_gap.py. Called from __main__ only so
-    importing this module (tests/test_torch_parity.py) never replaces
-    the host process."""
-    if (os.environ.get("JAX_PLATFORMS", "").strip().lower() != "cpu"
-            or os.environ.get("PALLAS_AXON_POOL_IPS", None) != ""):
-        env = dict(os.environ)
-        env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""})
-        os.execve(sys.executable, [sys.executable] + sys.argv, env)
-
-
 from make_parity_artifact import (BATCH, EPOCHS, LR, epoch_batches,  # noqa: E402
                                   get_data, run_monolithic)
 
@@ -234,5 +221,6 @@ def main():
 
 
 if __name__ == "__main__":
-    _ensure_cpu()
+    from split_learning_tpu.utils import reexec_pinned_cpu
+    reexec_pinned_cpu()  # CPU-only; import must never replace the process
     main()

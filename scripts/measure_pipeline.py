@@ -34,9 +34,11 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault(
-    "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+# This measurement is CPU-mesh-only (scheduling-relative pipeline
+# accounting). The CPU pin must exist before the interpreter loads jax
+# (the device-plugin shim registers at startup), so __main__ re-execs
+# via utils.reexec_pinned_cpu — see its docstring; import stays
+# side-effect-free.
 
 
 def hop_stats(trainer) -> dict:
@@ -160,4 +162,9 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    from split_learning_tpu.utils import reexec_pinned_cpu
+    reexec_pinned_cpu()
+    # after the pin (jax is not imported until main): the virtual mesh
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
     main()

@@ -35,14 +35,9 @@ sys.path.insert(0, REPO)
 
 # Both legs must run CPU-only: the JAX leg on the default backend would
 # ride the (wedge-prone) TPU tunnel while the torch leg stays on CPU — a
-# cross-backend "gap". Pinning must happen before the interpreter loads
-# jax (the axon plugin registers at startup), so re-exec once with the
-# same hermetic env bench.py's CPU_ENV subprocesses use.
-if (os.environ.get("JAX_PLATFORMS", "").strip().lower() != "cpu"
-        or os.environ.get("PALLAS_AXON_POOL_IPS", None) != ""):
-    env = dict(os.environ)
-    env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""})
-    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+# cross-backend "gap". Pinning must exist before the interpreter loads
+# jax, so __main__ re-execs via utils.reexec_pinned_cpu (import stays
+# side-effect-free).
 
 BATCH = 64
 WARMUP, STEPS = 5, 40  # same window as bench.py measure_baseline
@@ -157,4 +152,6 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    from split_learning_tpu.utils import reexec_pinned_cpu
+    reexec_pinned_cpu()
     main()

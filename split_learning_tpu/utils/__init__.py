@@ -1,4 +1,6 @@
-from split_learning_tpu.utils.backend import ensure_pinned_platform_hermetic
+from split_learning_tpu.utils.backend import (
+    ensure_pinned_platform_hermetic, reexec_pinned_cpu)
 from split_learning_tpu.utils.config import Config
 
-__all__ = ["Config", "ensure_pinned_platform_hermetic"]
+__all__ = ["Config", "ensure_pinned_platform_hermetic",
+           "reexec_pinned_cpu"]

@@ -12,15 +12,40 @@ pins CPU gets CPU, never a hung tunnel dial.
 from __future__ import annotations
 
 import os
+import sys
 
 _DEVICE_PLUGINS = ("axon",)   # out-of-tree PJRT factories seen in the wild
+
+
+def reexec_pinned_cpu(extra_env: dict | None = None) -> None:
+    """Replace this process with a CPU-pinned copy of itself unless it
+    already is one. For CPU-only measurement scripts: the pin must
+    exist when the interpreter starts (see
+    :func:`ensure_pinned_platform_hermetic`'s limit), so a script that
+    decides on CPU from Python re-execs once with the hermetic env.
+    Call from ``__main__`` only — importing a module must never replace
+    the importing process."""
+    if (os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
+            and os.environ.get("PALLAS_AXON_POOL_IPS", None) == ""):
+        return
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""})
+    for k, v in (extra_env or {}).items():
+        env.setdefault(k, v)
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 
 def ensure_pinned_platform_hermetic() -> None:
     """When ``JAX_PLATFORMS`` pins an explicit platform set, de-register
     any device-plugin backend factory outside that set before a backend
     initializes. No-op otherwise; safe to call multiple times; tolerant
-    of jax internals moving (falls back to trusting JAX_PLATFORMS)."""
+    of jax internals moving (falls back to trusting JAX_PLATFORMS).
+
+    Limit: the env var must have been set when the interpreter started
+    — a shim that defers registration can re-appear if the pin was
+    exported later from Python. Processes that decide on CPU *after*
+    startup should re-exec with the pinned env instead
+    (``scripts/measure_pipeline.py`` shows the pattern)."""
     plats = []   # order is priority order — preserve it, dedupe only
     for p in os.environ.get("JAX_PLATFORMS", "").split(","):
         p = p.strip().lower()

@@ -1,0 +1,60 @@
+"""utils.backend.ensure_pinned_platform_hermetic — the guard that keeps
+CPU-pinned entry points from dialing a wedged device-plugin tunnel
+(tests/conftest.py has the same guard inline; the CLI and scripts use
+this one). The subtle contract: JAX_PLATFORMS is a *priority list*, so
+the guard must preserve its order when it rewrites the config."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(code: str, **env):
+    full_env = dict(os.environ)
+    full_env.update(env)
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, env=full_env, cwd=REPO, timeout=120)
+    assert out.returncode == 0, out.stderr[-2000:]
+    return out.stdout
+
+
+def test_preserves_platform_priority_order():
+    # "cpu" first must stay first — an alphabetical sort would also pass
+    # here, so use a pair where sorted order differs from given order
+    out = _run(
+        "from split_learning_tpu.utils import "
+        "ensure_pinned_platform_hermetic as e\n"
+        "e()\n"
+        "import jax\n"
+        "print(jax.config.jax_platforms)\n",
+        JAX_PLATFORMS="cpu,axon")
+    assert out.strip().splitlines()[-1] == "cpu,axon"
+
+
+def test_idempotent_and_noop_without_pin():
+    out = _run(
+        "import os\n"
+        "os.environ.pop('JAX_PLATFORMS', None)\n"
+        "from split_learning_tpu.utils import "
+        "ensure_pinned_platform_hermetic as e\n"
+        "e(); e()\n"
+        "print('OK')\n",
+        JAX_PLATFORMS="")
+    assert out.strip().endswith("OK")
+
+
+def test_drops_out_of_set_plugin_factory():
+    out = _run(
+        "from split_learning_tpu.utils import "
+        "ensure_pinned_platform_hermetic as e\n"
+        "e()\n"
+        "import jax\n"
+        "import jax._src.xla_bridge as xb\n"
+        "print('axon' in xb._backend_factories)\n"
+        "print(sorted({d.platform for d in jax.devices()}))\n",
+        JAX_PLATFORMS="cpu")
+    lines = out.strip().splitlines()
+    assert lines[-2] == "False"
+    assert lines[-1] == "['cpu']"
