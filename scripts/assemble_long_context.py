@@ -10,10 +10,11 @@ folds those transformer legs into the same
 and ``tests/test_long_context_artifact.py`` pins, so the incremental
 path and the monolithic path publish through one format.
 
-For each (seq_len, attn) the newest completed record wins. When both a
-quick and a full leg landed, the full leg wins regardless of age (more
-timed steps). OOM records (no result payload) become ``status: "oom"``
-legs, carrying the shape parsed from the leg id.
+For each (seq_len, attn) candidates rank by status first (a
+gate-passing ``ok`` is never displaced by a later invalid/oom
+attempt), then full-over-quick (more timed steps), then recency. OOM
+records (no result payload) become ``status: "oom"`` legs, carrying
+the shape parsed from the leg id.
 
 Usage: python scripts/assemble_long_context.py [--out PATH]
 """

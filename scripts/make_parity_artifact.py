@@ -246,11 +246,6 @@ def main() -> None:
     ensure_pinned_platform_hermetic()  # CPU-pinned must not dial the tunnel
     import jax
 
-    x, y, attempt = get_data(args.data_dir)
-    is_synthetic = attempt is not None
-    platform = jax.devices()[0].platform
-    os.makedirs(os.path.dirname(args.out), exist_ok=True)
-
     selected = args.variant or sorted(VARIANTS)
     # replace-and-recompute semantics: a --variant run updates that
     # variant's record in an existing artifact and the summary is
@@ -259,8 +254,27 @@ def main() -> None:
     if args.variant is not None and os.path.exists(args.out):
         with open(args.out) as f:
             records = [json.loads(line) for line in f if line.strip()]
-    this_dataset = "mnist-synthetic" if is_synthetic else "mnist"
     old_meta = next((r for r in records if r.get("kind") == "meta"), None)
+
+    # a --variant update MUST train on the dataset the artifact's other
+    # curves used; when the meta says synthetic, don't even attempt the
+    # real download (a host where it unexpectedly succeeds would
+    # otherwise make the curves incomparable and abort the run)
+    if old_meta is not None and old_meta.get("dataset") == "mnist-synthetic":
+        from split_learning_tpu.data.datasets import synthetic
+        ds = synthetic("mnist", n_train=old_meta["n_train"], n_test=512,
+                       seed=0)
+        x, y = ds.train.x, ds.train.y
+        attempt = dict(old_meta.get("attempted_real_data",
+                                    {"attempted": True}),
+                       note="variant update: dataset pinned by meta")
+    else:
+        x, y, attempt = get_data(args.data_dir)
+    is_synthetic = attempt is not None
+    this_dataset = "mnist-synthetic" if is_synthetic else "mnist"
+    platform = jax.devices()[0].platform
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+
     if old_meta is not None and old_meta.get("dataset") != this_dataset:
         raise SystemExit(
             f"[parity] refusing --variant update: this run resolved "
@@ -271,7 +285,7 @@ def main() -> None:
     if not any(r.get("kind") == "meta" for r in records):
         meta = {
             "kind": "meta",
-            "dataset": "mnist-synthetic" if is_synthetic else "mnist",
+            "dataset": this_dataset,
             "n_train": int(len(y)), "epochs": EPOCHS, "batch": BATCH,
             "lr": LR, "seed": 42,
             "steps_per_epoch": -(-len(y) // BATCH),
@@ -325,8 +339,7 @@ def main() -> None:
     # re-parsing the artifact
     stdout_summary = {"artifact": args.out, "platform": platform,
                       "variants_run": selected,
-                      "dataset": "mnist-synthetic" if is_synthetic
-                      else "mnist"}
+                      "dataset": this_dataset}
     for rec in records:
         if rec.get("kind") == "summary":
             stdout_summary.update(

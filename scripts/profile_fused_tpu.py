@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""On-chip op-level profile of the fused split-CNN step.
+
+SURVEY.md §5 (tracing/profiling) promises jax.profiler traces; this
+script turns one into a committed, reviewable artifact: run the fused
+headline workload (split CNN, batch 64) on the default backend under
+``utils.profiling.device_trace``, parse the Perfetto trace the profiler
+writes, and emit the top ops by total device time plus the traced
+steps/sec. Output: ``artifacts/tpu_profile_<date>.json`` (committed when
+produced on the chip) and one stdout JSON line for the opportunistic
+window runner (scripts/tpu_window_runner.py).
+
+The trace file itself (MBs, binary) stays out of git — the summary is
+the evidence: which XLA fusions the step spends its time in, and how
+much of the wall clock is device-occupied vs dispatch gap.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+WARMUP = 20
+TRACED = 50
+
+
+def newest_trace(log_dir: str) -> str | None:
+    paths = glob.glob(os.path.join(log_dir, "plugins", "profile",
+                                   "*", "*.trace.json.gz"))
+    return max(paths, default=None)
+
+
+def summarize_trace(path: str, top_n: int = 15) -> dict:
+    """Chrome-trace summary: per process (pid), top events by total
+    duration. Device processes carry the XLA op timeline; host
+    processes carry Python/runtime frames."""
+    with gzip.open(path, "rt") as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    pid_names = {e["pid"]: e["args"]["name"] for e in events
+                 if e.get("ph") == "M" and e.get("name") == "process_name"
+                 and "name" in e.get("args", {})}
+    per_proc: dict = {}
+    for e in events:
+        if e.get("ph") != "X" or "dur" not in e:
+            continue
+        proc = pid_names.get(e["pid"], str(e["pid"]))
+        ops = per_proc.setdefault(proc, {})
+        rec = ops.setdefault(e["name"], {"count": 0, "total_us": 0.0})
+        rec["count"] += 1
+        rec["total_us"] += float(e["dur"])
+    out = {}
+    for proc, ops in per_proc.items():
+        top = sorted(ops.items(), key=lambda kv: -kv[1]["total_us"])[:top_n]
+        out[proc] = [{"name": n, "count": r["count"],
+                      "total_us": round(r["total_us"], 1),
+                      "mean_us": round(r["total_us"] / r["count"], 2)}
+                     for n, r in top]
+    return out
+
+
+def main() -> None:
+    from split_learning_tpu.utils import ensure_pinned_platform_hermetic
+    ensure_pinned_platform_hermetic()  # a CPU-pinned run must stay CPU
+
+    import numpy as np
+
+    import jax
+
+    from split_learning_tpu.data.datasets import synthetic
+    from split_learning_tpu.models import get_plan
+    from split_learning_tpu.runtime.fused import FusedSplitTrainer
+    from split_learning_tpu.utils import Config
+    from split_learning_tpu.utils.profiling import device_trace
+
+    batch = int(os.environ.get("SLT_PROFILE_BATCH", "64"))
+    ds = synthetic("mnist", n_train=batch, n_test=8, seed=0)
+    x = np.asarray(ds.train.x[:batch])
+    y = np.asarray(ds.train.y[:batch])
+
+    cfg = Config(mode="split", batch_size=batch, lr=0.01)
+    plan = get_plan(mode="split")
+    trainer = FusedSplitTrainer(plan, cfg, jax.random.PRNGKey(0), x)
+    device = trainer.state.step.devices().pop()
+
+    for _ in range(WARMUP):
+        trainer.train_step_async(x, y)
+    jax.block_until_ready(trainer.state.params)
+
+    log_dir = os.environ.get("SLT_PROFILE_DIR") or os.path.join(
+        "/tmp", f"slt_profile_{os.getpid()}")
+    t0 = time.perf_counter()
+    with device_trace(log_dir):
+        for _ in range(TRACED):
+            trainer.train_step_async(x, y)
+        jax.block_until_ready(trainer.state.params)
+    wall = time.perf_counter() - t0
+
+    trace_path = newest_trace(log_dir)
+    summary = {
+        "what": ("jax.profiler trace summary of the fused split-CNN "
+                 "step (top ops by total time per trace process)"),
+        "date": time.strftime("%Y-%m-%d"),
+        "platform": device.platform,
+        "device_kind": getattr(device, "device_kind", device.platform),
+        "batch": batch,
+        "traced_steps": TRACED,
+        "traced_steps_per_sec": round(TRACED / wall, 2),
+        "trace_file": trace_path,
+        "top_ops": summarize_trace(trace_path) if trace_path else None,
+    }
+    out_path = os.path.join(REPO, "artifacts",
+                            f"tpu_profile_{time.strftime('%Y-%m-%d')}.json")
+    if device.platform == "tpu":
+        with open(out_path, "w") as f:
+            json.dump(summary, f, indent=1)
+        print(f"[profile] wrote {out_path}", file=sys.stderr)
+    else:
+        print(f"[profile] platform={device.platform}: not committing a "
+              f"TPU-named artifact", file=sys.stderr)
+    # stdout line for the window runner (drop the bulky op table)
+    print(json.dumps({k: v for k, v in summary.items() if k != "top_ops"}
+                     | {"top_op_processes": list((summary["top_ops"] or {}))}))
+
+
+if __name__ == "__main__":
+    main()

@@ -50,21 +50,18 @@ def _t_leg(seq, batch, attn, quick, timeout):
 # crossover/ceiling probes, then decode, then the headline CNN legs,
 # then non-quick confirmations.
 LEGS = [
+    # round-4 design question first: does the reworked flash kernel beat
+    # dense at trainable T? (flash T1024 landed in window 1: 45.8 st/s)
     _t_leg(1024, 64, "flash", True, 900),
     _t_leg(1024, 64, "full", True, 900),
     _t_leg(4096, 16, "flash", True, 1200),
     _t_leg(4096, 16, "full", True, 1200),
-    {"id": "decode.q", "role": "decode", "env": {}, "quick": True,
-     "timeout": 900},
-    _t_leg(8192, 16, "flash", True, 1500),
-    _t_leg(8192, 16, "full", True, 1500),
-    _t_leg(16384, 16, "flash", True, 1700),
-    _t_leg(16384, 16, "full", True, 1700),
+    # round-record legs: cheap, high value, must not starve behind the
+    # expensive 8k/16k probes on a wedge-prone tunnel
     {"id": "cnn_headline.q", "role": "fused", "env": {}, "quick": True,
      "timeout": 900},
-    {"id": "cnn_b1024_bf16_scan.q", "role": "fused",
-     "env": {"SLT_BENCH_BATCH": "1024", "SLT_BENCH_DTYPE": "bfloat16"},
-     "quick": True, "timeout": 900},
+    {"id": "decode.q", "role": "decode", "env": {}, "quick": True,
+     "timeout": 900},
     # north-star closure: the reference's full 3-epoch workload trained
     # ON the chip (fused variant, per-epoch scan dispatch), appended to
     # the committed parity artifact as the fused_tpu curve
@@ -73,6 +70,20 @@ LEGS = [
                                            "make_parity_artifact.py"),
               "--variant", "fused"],
      "env": {}, "timeout": 1500},
+    {"id": "cnn_b1024_bf16_scan.q", "role": "fused",
+     "env": {"SLT_BENCH_BATCH": "1024", "SLT_BENCH_DTYPE": "bfloat16"},
+     "quick": True, "timeout": 900},
+    # op-level trace evidence for the profiling subsystem (SURVEY §5)
+    {"id": "profile.fused",
+     "argv": [sys.executable, os.path.join(REPO, "scripts",
+                                           "profile_fused_tpu.py")],
+     "env": {}, "timeout": 900},
+    # crossover boundary + memory-ceiling refresh
+    _t_leg(8192, 16, "flash", True, 1500),
+    _t_leg(8192, 16, "full", True, 1500),
+    _t_leg(16384, 16, "flash", True, 1700),
+    _t_leg(16384, 16, "full", True, 1700),
+    # non-quick confirmations
     {"id": "decode.full", "role": "decode", "env": {}, "quick": False,
      "timeout": 1500},
     _t_leg(1024, 64, "flash", False, 1200),

@@ -17,21 +17,20 @@ import sys
 _DEVICE_PLUGINS = ("axon",)   # out-of-tree PJRT factories seen in the wild
 
 
-def reexec_pinned_cpu(extra_env: dict | None = None) -> None:
+def reexec_pinned_cpu() -> None:
     """Replace this process with a CPU-pinned copy of itself unless it
     already is one. For CPU-only measurement scripts: the pin must
     exist when the interpreter starts (see
     :func:`ensure_pinned_platform_hermetic`'s limit), so a script that
     decides on CPU from Python re-execs once with the hermetic env.
     Call from ``__main__`` only — importing a module must never replace
-    the importing process."""
+    the importing process. Extra env (e.g. XLA_FLAGS) belongs after the
+    call: on return the process is pinned and jax is not yet imported."""
     if (os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
             and os.environ.get("PALLAS_AXON_POOL_IPS", None) == ""):
         return
     env = dict(os.environ)
     env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""})
-    for k, v in (extra_env or {}).items():
-        env.setdefault(k, v)
     os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 
