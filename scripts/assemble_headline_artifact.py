@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Assemble a committed, replayable TPU headline artifact from
+opportunistic window-runner legs.
+
+``bench.py`` replays the newest ``artifacts/bench_tpu_*.json`` whose
+fused leg passed the gate when the round-end tunnel is wedged
+(``_emit_degraded_headline``). This script produces that artifact from
+the incremental path: take the best gate-passing ``cnn_headline.*`` leg
+from ``artifacts/tpu_window_runs.jsonl``, measure a fresh hermetic CPU
+HTTP baseline (the headline's denominator — CPU-only, needs no tunnel),
+and write the same schema the round-3 artifact used. Extra window legs
+(b1024 scan, decode, profile) ride along when present.
+
+Usage: python scripts/assemble_headline_artifact.py [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+RUNS = os.path.join(REPO, "artifacts", "tpu_window_runs.jsonl")
+
+from bench import CPU_ENV, _run_subprocess  # noqa: E402
+
+
+def best_leg(records, prefix: str):
+    """Best gate-passing window record whose leg id starts with
+    ``prefix``: full-over-quick, then newest."""
+    best, best_rank = None, None
+    for rec in records:
+        if not rec.get("leg", "").startswith(prefix):
+            continue
+        result = rec.get("result")
+        if rec.get("status") != "ok" or not result:
+            continue
+        if not result.get("valid", False):
+            continue
+        rank = (not rec["leg"].endswith(".q"), rec.get("ts", 0))
+        if best_rank is None or rank > best_rank:
+            best, best_rank = result, rank
+    return best
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    with open(RUNS) as f:
+        records = [json.loads(line) for line in f if line.strip()]
+
+    fused = best_leg(records, "cnn_headline.")
+    if fused is None:
+        raise SystemExit("no gate-passing cnn_headline leg in " + RUNS
+                         + " yet — let the window runner land one first")
+    if fused.get("platform") != "tpu":
+        raise SystemExit(f"cnn_headline leg ran on platform="
+                         f"{fused.get('platform')!r}; refusing to publish "
+                         f"a non-TPU artifact")
+
+    print("[assemble] measuring fresh CPU HTTP baseline (hermetic, "
+          "no tunnel)...", file=sys.stderr)
+    baseline = _run_subprocess("baseline", False, CPU_ENV, timeout=900)
+    if baseline is None:
+        raise SystemExit("CPU baseline leg failed")
+
+    date = time.strftime("%Y-%m-%d")
+    art = {
+        "provenance": {
+            "date": date,
+            "command": ("scripts/tpu_window_runner.py leg (bench.py "
+                        "--role fused subprocess protocol) + fresh "
+                        "bench.py --role baseline on hermetic CPU"),
+            "device": fused.get("device_kind"),
+            "note": ("assembled from opportunistic tunnel windows; the "
+                     "fused leg passed bench.py's publication gate "
+                     "(util<=1, work-scaling window) on the chip"),
+        },
+        "headline": {
+            "metric": "mnist_split_cnn_steps_per_sec",
+            "value": round(fused["steps_per_sec"], 2),
+            "unit": "steps/sec",
+            "vs_baseline": round(
+                fused["steps_per_sec"] / baseline["steps_per_sec"], 2),
+        },
+        "baseline": baseline,
+        "fused": fused,
+    }
+    for key, prefix in (("split_cnn_b1024_bf16", "cnn_b1024_bf16_scan."),
+                        ("decode_kv_cache", "decode.")):
+        extra = best_leg(records, prefix)
+        if extra is not None:
+            art[key] = extra
+
+    out = args.out or os.path.join(REPO, "artifacts",
+                                   f"bench_tpu_{date}.json")
+    with open(out, "w") as f:
+        json.dump(art, f, indent=1)
+    print(f"wrote {out}", file=sys.stderr)
+    print(json.dumps(art["headline"]))
+
+
+if __name__ == "__main__":
+    main()

@@ -118,7 +118,8 @@ def main() -> None:
     }
     out_path = os.path.join(REPO, "artifacts",
                             f"tpu_profile_{time.strftime('%Y-%m-%d')}.json")
-    if device.platform == "tpu":
+    on_tpu = device.platform == "tpu"
+    if on_tpu:
         with open(out_path, "w") as f:
             json.dump(summary, f, indent=1)
         print(f"[profile] wrote {out_path}", file=sys.stderr)
@@ -127,7 +128,12 @@ def main() -> None:
               f"TPU-named artifact", file=sys.stderr)
     # stdout line for the window runner (drop the bulky op table)
     print(json.dumps({k: v for k, v in summary.items() if k != "top_ops"}
-                     | {"top_op_processes": list((summary["top_ops"] or {}))}))
+                     | {"top_op_processes": list((summary["top_ops"] or {})),
+                        "valid": on_tpu}))
+    if not on_tpu:
+        # non-zero so the window runner records an error (retried on a
+        # later window) instead of marking the leg permanently done
+        sys.exit(1)
 
 
 if __name__ == "__main__":

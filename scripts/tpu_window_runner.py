@@ -31,6 +31,12 @@ OUT = os.path.join(REPO, "artifacts", "tpu_window_runs.jsonl")
 STATE = "/tmp/tpu_runner_state.json"
 PROBE_INTERVAL = 300
 PROBE_TIMEOUT = 150
+# Hard stop: the round-end driver runs bench.py on the same tunnel; a
+# still-running leg would contend with (and possibly starve) the
+# driver's headline measurement. SLT_RUNNER_DEADLINE_H hours from
+# start, then exit whatever remains.
+DEADLINE = time.time() + 3600 * float(
+    os.environ.get("SLT_RUNNER_DEADLINE_H", "8"))
 
 TRANSFORMER = {"SLT_BENCH_MODEL": "transformer",
                "SLT_BENCH_DTYPE": "bfloat16"}
@@ -184,8 +190,15 @@ def run_leg(leg) -> dict:
 
 def main():
     st = load_state()
-    log(f"runner up; {len(st['done'])}/{len(LEGS)} legs already done")
+    log(f"runner up; {len(st['done'])}/{len(LEGS)} legs already done; "
+        f"deadline in {(DEADLINE - time.time()) / 3600:.1f}h")
     while True:
+        if time.time() > DEADLINE:
+            log("deadline reached; exiting to free the tunnel for the "
+                "round-end bench")
+            append({"leg": "__runner_deadline__", "status": "deadline",
+                    "done": st["done"]})
+            return
         remaining = [l for l in LEGS if l["id"] not in st["done"]
                      and st["attempts"].get(l["id"], 0) < MAX_ATTEMPTS]
         if not remaining:
@@ -200,6 +213,8 @@ def main():
             continue
         log("tunnel LIVE")
         for leg in remaining:
+            if time.time() > DEADLINE:
+                break  # outer loop exits on the same check
             st["attempts"][leg["id"]] = st["attempts"].get(leg["id"], 0) + 1
             save_state(st)
             log(f"leg {leg['id']} (attempt {st['attempts'][leg['id']]})...")

@@ -1,0 +1,104 @@
+"""Selection logic of the incremental measurement assemblers
+(scripts/assemble_headline_artifact.py, scripts/assemble_long_context.py):
+the rules that decide which opportunistic window-runner record becomes
+the committed artifact. Pure-python (no jax) — the expensive end of
+these scripts runs on the chip; the part that can rot silently is the
+ranking."""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name):
+    path = os.path.join(REPO, "scripts", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, REPO)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def headline():
+    return _load("assemble_headline_artifact")
+
+
+@pytest.fixture(scope="module")
+def longctx():
+    return _load("assemble_long_context")
+
+
+def _rec(leg, status="ok", ts=0.0, valid=True, **result):
+    rec = {"leg": leg, "status": status, "ts": ts}
+    if status != "oom":
+        rec["result"] = {"valid": valid, **result}
+    return rec
+
+
+def test_headline_full_beats_quick_and_newest_wins(headline):
+    records = [
+        _rec("cnn_headline.q", ts=1, steps_per_sec=100.0),
+        _rec("cnn_headline.full", ts=2, steps_per_sec=90.0),
+        _rec("cnn_headline.q", ts=3, steps_per_sec=110.0),
+    ]
+    # a full leg outranks any quick leg regardless of recency
+    assert headline.best_leg(records, "cnn_headline.")["steps_per_sec"] == 90.0
+
+
+def test_headline_skips_invalid_and_non_ok(headline):
+    records = [
+        _rec("cnn_headline.q", ts=1, steps_per_sec=100.0),
+        _rec("cnn_headline.q", ts=2, steps_per_sec=999.0, valid=False),
+        _rec("cnn_headline.full", status="timeout", ts=3),
+    ]
+    assert headline.best_leg(records, "cnn_headline.")["steps_per_sec"] == 100.0
+    assert headline.best_leg(records, "decode.") is None
+
+
+def test_longctx_ok_never_displaced_by_later_failed_full(longctx):
+    records = [
+        _rec("T1024.b64.flash.q", ts=1, steps_per_sec=45.0,
+             seq_len=1024, attn="flash", batch=64),
+        {"leg": "T1024.b64.flash.full", "status": "invalid", "ts": 2,
+         "result": {"valid": False, "steps_per_sec": None,
+                    "seq_len": 1024, "attn": "flash", "batch": 64}},
+    ]
+    legs = longctx.assemble(records)
+    assert len(legs) == 1
+    assert legs[0]["status"] == "ok" and legs[0]["steps_per_sec"] == 45.0
+
+
+def test_longctx_oom_becomes_leg_and_completeness_guard(longctx):
+    records = [
+        _rec("T1024.b64.flash.q", ts=1, steps_per_sec=45.0,
+             seq_len=1024, attn="flash", batch=64),
+        _rec("T1024.b64.full.q", ts=1, steps_per_sec=40.0,
+             seq_len=1024, attn="full", batch=64),
+        _rec("T16384.b16.full.q", status="oom", ts=2),
+        _rec("T16384.b16.flash.q", ts=2, steps_per_sec=0.5,
+             seq_len=16384, attn="flash", batch=16),
+    ]
+    legs = longctx.assemble(records)
+    assert {(l["seq_len"], l["attn"], l["status"]) for l in legs} == {
+        (1024, "flash", "ok"), (1024, "full", "ok"),
+        (16384, "full", "oom"), (16384, "flash", "ok")}
+    assert longctx.complete_enough(legs) == []
+    # dropping the ceiling pair makes it unpublishable
+    partial = [l for l in legs if l["seq_len"] == 1024]
+    assert longctx.complete_enough(partial)
+
+
+def test_longctx_full_leg_preferred_within_same_status(longctx):
+    records = [
+        _rec("T256.b64.full.q", ts=5, steps_per_sec=350.0,
+             seq_len=256, attn="full", batch=64),
+        _rec("T256.b64.full.full", ts=1, steps_per_sec=353.0,
+             seq_len=256, attn="full", batch=64),
+    ]
+    legs = longctx.assemble(records)
+    assert legs[0]["steps_per_sec"] == 353.0
