@@ -96,7 +96,9 @@ def main() -> None:
     for key, prefix in (("split_cnn_b1024_bf16", "cnn_b1024_bf16_scan."),
                         ("decode_kv_cache", "decode.")):
         extra = best_leg(records, prefix)
-        if extra is not None:
+        # same platform guard as the headline: a leg that silently fell
+        # back to CPU mid-window must not ride into a TPU artifact
+        if extra is not None and extra.get("platform") == "tpu":
             art[key] = extra
 
     out = args.out or os.path.join(REPO, "artifacts",

@@ -17,21 +17,26 @@ Design (the canonical TPU flash schedule):
 - Block inputs stream per grid step via BlockSpec index maps — Pallas
   double-buffers the DMAs, so K/V never resides whole in VMEM.
 - Forward saves only O and the per-row logsumexp (LSE).
-- Backward is the two-kernel flash split: dQ grids over (query, key)
-  blocks, dK/dV over (key, query) blocks, each recomputing P blockwise
-  from (Q, K, LSE) — the FLOPs-for-HBM trade. Total backward matmul
-  work is 14 units of T^2*D vs dense's 8 (1.75x): each kernel re-does
-  scores (2) and dO*V^T (2) plus its own products. A fused single-pass
-  backward (10 units) was analyzed and rejected for the regime flash
-  actually serves (long T, via ``attn="auto"``): with a (key, query)
-  grid, dK/dV accumulate fine in VMEM scratch but dQ blocks are
-  revisited *non-consecutively*, which Pallas TPU output revisiting
-  does not support; dQ-partials with a leading key-block axis (the
-  splash-attention fused form) cost O(n_k * T * D) HBM — ~17 GiB at
-  T=16384/bh=32, over the chip; and carrying whole dK/dV per bh in
-  scratch needs 2*T*D*4 bytes = 16 MiB at T=16k, the entire VMEM. So
-  the 1.75x recompute is a deliberate floor, and ``attn="auto"`` keeps
-  dense (which is faster while it fits) the default below the HBM wall.
+- Backward comes in two forms, picked per (padded T, d) by
+  ``_use_onepass``:
+  (a) *Mid-T one-pass* (``_onepass_bwd_kernel``): grid (bh, k block)
+  with Q/dO/LSE/delta and the f32 dQ accumulator whole-sequence
+  resident in VMEM; each (k, q) block pair computes scores and dO*V^T
+  once and feeds dQ, dK, dV — 10 matmul units of T^2*D vs dense's 8.
+  dQ's output block is revisited *consecutively* across the k grid dim
+  (index map ignores k), the supported accumulation idiom. Residency
+  caps this form: tp*d*(2*itemsize+4) against half the ~16 MiB/core
+  VMEM (T <= ~8k bf16 at d=128).
+  (b) *Long-T two-kernel split*: dQ grids over (query, key) blocks,
+  dK/dV over (key, query) blocks, each recomputing P blockwise from
+  (Q, K, LSE) — total 14 matmul units (1.75x dense): each kernel
+  re-does scores (2) and dO*V^T (2) plus its own products. The fused
+  alternatives fail exactly here: a (key, query) grid revisits dQ
+  blocks non-consecutively (unsupported), dQ-partials with a leading
+  key-block axis cost O(n_k * T * D) HBM (~17 GiB at T=16384/bh=32),
+  and whole-sequence VMEM residency is over budget. The 1.75x
+  recompute is the deliberate price of the only regime where flash is
+  mandatory (past the dense HBM wall); ``attn="auto"`` arbitrates.
 - Causal masking uses global block coordinates; block pairs with no
   causal overlap skip their matmuls entirely (``pl.when`` around the
   accumulate — the grid stays static, ~2x fewer FLOPs at large T), and
@@ -84,6 +89,22 @@ def _pick_block(t: int) -> int:
     while b > 128 and tp128 % b:   # largest edge that adds no extra padding
         b //= 2
     return b
+
+
+def _use_onepass(t: int, block: int, d: int, itemsize: int) -> bool:
+    """Backward-form selection: the one-pass kernel needs Q, dO, the f32
+    dQ accumulator, and the LSE/delta rows VMEM-resident for the whole
+    (padded) sequence — ≈ tp·d·(2·itemsize + 4) bytes plus working
+    blocks. Budget half the ~16 MiB/core so the block temporaries and
+    double-buffered K/V DMAs fit. ``SLT_FLASH_ONEPASS_T`` overrides:
+    one-pass at or below that padded T, two-kernel above (0 = never)."""
+    import os
+    tp = round_up(t, block)
+    env = os.environ.get("SLT_FLASH_ONEPASS_T")
+    if env:   # empty string = unset, like SLT_FLASH_AUTO_T
+        return tp <= int(env)
+    resident = tp * round_up(d, LANE) * (2 * itemsize + 4)
+    return resident <= 8 * 1024 * 1024
 
 
 def select_attention(b: int, t: int, h: int, itemsize: int,
@@ -204,6 +225,64 @@ def _fwd_kernel(blk: int, t: int, scale: float, causal: bool,
         lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
 
 
+def _onepass_bwd_kernel(blk: int, t: int, scale: float, causal: bool,
+                        strict: bool, n_q: int,
+                        k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                        dk_ref, dv_ref, dq_ref):
+    """Single-pass backward for mid-length T: grid ``(bh, k block)``
+    with Q/dO/LSE/delta — and the f32 dQ accumulator — fully VMEM
+    resident (≈6 MiB at T=4096, d=128, vs the ~16 MiB/core budget).
+    Each (k, q) block pair computes scores and ``dO·Vᵀ`` exactly once
+    and feeds all three gradients: 10 matmul units of T²·D vs the
+    two-kernel split's 14 (module docstring), and one kernel launch
+    instead of two. dQ rides an output block whose index map is
+    constant across the k grid dimension — consecutive revisiting, the
+    standard TPU accumulation idiom — so no O(n_k·T·D) partial buffer
+    and no non-consecutive revisits (the constraints that rule this
+    form out at long T, where the two-kernel split takes over)."""
+    kb_i = pl.program_id(1)
+    k0 = kb_i * blk
+
+    @pl.when(kb_i == 0)
+    def _init():
+        dq_ref[0] = jnp.zeros(dq_ref.shape[1:], dq_ref.dtype)
+
+    kb = k_ref[0]
+    vb = v_ref[0]
+
+    def body(j, carry):
+        dk, dv = carry
+        q0 = j * blk
+        qb = q_ref[0, pl.ds(q0, blk), :]
+        dob = do_ref[0, pl.ds(q0, blk), :]
+        lse = lse_ref[0, pl.ds(q0, blk), :][:, :1]
+        delta = delta_ref[0, pl.ds(q0, blk), :][:, :1]
+        s, ok = _scores(qb, kb, t, k0, q0, scale, causal, strict)
+        p = jnp.where(ok, jnp.exp(s - lse), 0.0)
+        dv += jax.lax.dot_general(
+            p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            dob, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk += jax.lax.dot_general(
+            ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dq_ref[0, pl.ds(q0, blk), :] += (jax.lax.dot_general(
+            ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        ).astype(dq_ref.dtype)
+        return dk, dv
+
+    zeros = jnp.zeros(kb.shape[:1] + (dq_ref.shape[-1],), jnp.float32)
+    # causal: query blocks strictly before this key block are dead
+    start = kb_i if causal else 0
+    dk, dv = jax.lax.fori_loop(start, n_q, body, (zeros, zeros))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
 def _dq_kernel(blk: int, t: int, scale: float, causal: bool,
                strict: bool, n_k: int,
                q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -292,7 +371,8 @@ def _dkv_kernel(blk: int, t: int, scale: float, causal: bool,
 # --------------------------------------------------------------------- #
 @functools.lru_cache(maxsize=None)
 def _make_flash(bh: int, t: int, d: int, causal: bool, dtype_name: str,
-                block: int, with_lse: bool = False, strict: bool = False):
+                block: int, with_lse: bool = False, strict: bool = False,
+                onepass: bool = False):
     """Custom-VJP flash attention for one static ([BH, T, D], causal).
 
     ``with_lse=True`` additionally returns the per-row logsumexp as a
@@ -370,31 +450,59 @@ def _make_flash(bh: int, t: int, d: int, causal: bool, dtype_name: str,
             delta = delta - pad_axis(
                 g_lse.astype(jnp.float32), 1, tp)[..., None]
         delta = jnp.broadcast_to(delta, (bh, tp, _ROWW))
-        dq = pl.pallas_call(
-            functools.partial(_dq_kernel, block, t, scale, causal,
-                              strict, n_blk),
-            out_shape=jax.ShapeDtypeStruct((bh, tp, dp), in_dtype),
-            grid=grid,
-            in_specs=[blk(outer), blk(inner), blk(inner), blk(outer),
-                      row(outer), row(outer)],
-            out_specs=blk(outer),
-            scratch_shapes=[acc_scratch],
-            interpret=use_interpret(),
-        )(qp, kp, vp, dop, lse, delta)
-        dk, dv = pl.pallas_call(
-            functools.partial(_dkv_kernel, block, t, scale, causal,
-                              strict, n_blk),
-            out_shape=(
-                jax.ShapeDtypeStruct((bh, tp, dp), in_dtype),
-                jax.ShapeDtypeStruct((bh, tp, dp), in_dtype),
-            ),
-            grid=grid,
-            in_specs=[blk(outer), blk(outer), blk(inner), blk(inner),
-                      row(inner), row(inner)],
-            out_specs=(blk(outer), blk(outer)),
-            scratch_shapes=[acc_scratch, acc_scratch],
-            interpret=use_interpret(),
-        )(kp, vp, qp, dop, lse, delta)
+        if onepass:
+            # mid-T fast path: one kernel, scores computed once per
+            # block pair; whole-sequence refs (index maps ignore the k
+            # grid dim; dq revisits its block consecutively across k)
+            seq = lambda: pl.BlockSpec((1, tp, dp), lambda b, k: (b, 0, 0),
+                                       memory_space=pltpu.VMEM)
+            seqrow = lambda: pl.BlockSpec(
+                (1, tp, _ROWW), lambda b, k: (b, 0, 0),
+                memory_space=pltpu.VMEM)
+            kblk = lambda: pl.BlockSpec((1, block, dp),
+                                        lambda b, k: (b, k, 0),
+                                        memory_space=pltpu.VMEM)
+            dk, dv, dq = pl.pallas_call(
+                functools.partial(_onepass_bwd_kernel, block, t, scale,
+                                  causal, strict, n_blk),
+                out_shape=(
+                    jax.ShapeDtypeStruct((bh, tp, dp), in_dtype),
+                    jax.ShapeDtypeStruct((bh, tp, dp), in_dtype),
+                    jax.ShapeDtypeStruct((bh, tp, dp), jnp.float32),
+                ),
+                grid=(bh, n_blk),
+                in_specs=[kblk(), kblk(), seq(), seq(), seqrow(),
+                          seqrow()],
+                out_specs=(kblk(), kblk(), seq()),
+                interpret=use_interpret(),
+            )(kp, vp, qp, dop, lse, delta)
+            dq = dq.astype(in_dtype)
+        else:
+            dq = pl.pallas_call(
+                functools.partial(_dq_kernel, block, t, scale, causal,
+                                  strict, n_blk),
+                out_shape=jax.ShapeDtypeStruct((bh, tp, dp), in_dtype),
+                grid=grid,
+                in_specs=[blk(outer), blk(inner), blk(inner), blk(outer),
+                          row(outer), row(outer)],
+                out_specs=blk(outer),
+                scratch_shapes=[acc_scratch],
+                interpret=use_interpret(),
+            )(qp, kp, vp, dop, lse, delta)
+            dk, dv = pl.pallas_call(
+                functools.partial(_dkv_kernel, block, t, scale, causal,
+                                  strict, n_blk),
+                out_shape=(
+                    jax.ShapeDtypeStruct((bh, tp, dp), in_dtype),
+                    jax.ShapeDtypeStruct((bh, tp, dp), in_dtype),
+                ),
+                grid=grid,
+                in_specs=[blk(outer), blk(outer), blk(inner), blk(inner),
+                          row(inner), row(inner)],
+                out_specs=(blk(outer), blk(outer)),
+                scratch_shapes=[acc_scratch, acc_scratch],
+                interpret=use_interpret(),
+            )(kp, vp, qp, dop, lse, delta)
         trim = lambda x: x[:, :t, :d]
         return trim(dq), trim(dk), trim(dv)
 
@@ -412,7 +520,9 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     elsewhere).
     """
     b, t, h, d = q.shape
-    fn = _make_flash(b * h, t, d, causal, str(q.dtype), _pick_block(t))
+    block = _pick_block(t)
+    fn = _make_flash(b * h, t, d, causal, str(q.dtype), block,
+                     onepass=_use_onepass(t, block, d, q.dtype.itemsize))
 
     def fold(x):  # [B, T, H, D] -> [B*H, T, D]
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
@@ -441,8 +551,10 @@ def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
         raise ValueError("strict=True refines the causal mask and "
                          "requires causal=True")
     b, t, h, d = q.shape
-    fn = _make_flash(b * h, t, d, causal, str(q.dtype), _pick_block(t),
-                     with_lse=True, strict=strict)
+    block = _pick_block(t)
+    fn = _make_flash(b * h, t, d, causal, str(q.dtype), block,
+                     with_lse=True, strict=strict,
+                     onepass=_use_onepass(t, block, d, q.dtype.itemsize))
 
     def fold(x):
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)

@@ -64,6 +64,43 @@ def test_multi_block_gradients(t, block):
                                    atol=2e-4, rtol=2e-4)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_onepass_backward_matches_two_kernel(monkeypatch, causal):
+    """The mid-T one-pass backward (grid (bh, k), VMEM-resident dQ) and
+    the long-T two-kernel split must produce the same gradients — the
+    form is a perf choice, never a numerics choice. T=256 tiles as
+    2x128 so the one-pass q loop and the causal start offset are both
+    multi-block."""
+    from split_learning_tpu.ops.flash_attention import _make_flash
+    q, k, v = qkv(t=256, b=1, h=2, d=16)
+    w = jax.random.normal(jax.random.PRNGKey(7), q.shape, jnp.float32)
+
+    grads = {}
+    for name, flag in (("onepass", "8192"), ("twokernel", "0")):
+        monkeypatch.setenv("SLT_FLASH_ONEPASS_T", flag)
+        _make_flash.cache_clear()  # onepass is part of the build key
+        f = lambda a, b, c: jnp.sum(
+            flash_attention(a, b, c, causal=causal) * w)
+        grads[name] = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    _make_flash.cache_clear()
+    for g1, g2 in zip(grads["onepass"], grads["twokernel"]):
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_onepass_selection_rule():
+    """_use_onepass: VMEM-residency-bounded, env-overridable."""
+    from split_learning_tpu.ops.flash_attention import _use_onepass
+
+    # bf16 d=128: tp*128*(2*2+4) = tp KiB -> cap at 8 MiB = tp 8192
+    assert _use_onepass(4096, 512, 128, 2)
+    assert _use_onepass(8192, 512, 128, 2)
+    assert not _use_onepass(16384, 512, 128, 2)
+    # f32 halves the resident T
+    assert _use_onepass(4096, 512, 128, 4)
+    assert not _use_onepass(8192, 512, 128, 4)
+
+
 def test_auto_attention_selection(monkeypatch):
     """attn='auto' resolves per shape: dense below the HBM wall, flash
     at it (the measured round-3 crossover); SLT_FLASH_AUTO_T re-pins."""
