@@ -1,0 +1,60 @@
+"""scripts/profile_fused_tpu.py — the trace-summary machinery, driven
+against a real (CPU) jax.profiler capture. The on-chip run happens via
+the window runner; what must not rot silently is the Perfetto parsing
+that turns a trace into the committed op-table artifact."""
+
+import importlib.util
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def mod():
+    path = os.path.join(REPO, "scripts", "profile_fused_tpu.py")
+    spec = importlib.util.spec_from_file_location("pft", path)
+    m = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, REPO)
+    spec.loader.exec_module(m)
+    return m
+
+
+@pytest.fixture(scope="module")
+def trace_dir(tmp_path_factory):
+    from split_learning_tpu.utils.profiling import device_trace
+
+    d = str(tmp_path_factory.mktemp("trace"))
+
+    @jax.jit
+    def f(x):
+        return (x @ x).sum()
+
+    x = jnp.ones((256, 256))
+    f(x).block_until_ready()
+    with device_trace(d):
+        for _ in range(3):
+            f(x).block_until_ready()
+    return d
+
+
+def test_newest_trace_finds_the_capture(mod, trace_dir):
+    path = mod.newest_trace(trace_dir)
+    assert path is not None and path.endswith(".trace.json.gz")
+    assert mod.newest_trace(trace_dir + "/nonexistent") is None
+
+
+def test_summarize_trace_groups_by_process(mod, trace_dir):
+    summary = mod.summarize_trace(mod.newest_trace(trace_dir), top_n=5)
+    assert summary, "no processes parsed from the trace"
+    for proc, ops in summary.items():
+        assert 0 < len(ops) <= 5
+        # sorted by total time, every record well-formed
+        totals = [o["total_us"] for o in ops]
+        assert totals == sorted(totals, reverse=True)
+        for o in ops:
+            assert o["count"] >= 1 and o["mean_us"] > 0
