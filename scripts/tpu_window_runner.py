@@ -56,16 +56,14 @@ def _t_leg(seq, batch, attn, quick, timeout):
 # crossover/ceiling probes, then decode, then the headline CNN legs,
 # then non-quick confirmations.
 LEGS = [
-    # round-4 design question first: does the reworked flash kernel beat
-    # dense at trainable T? (flash T1024 landed in window 1: 45.8 st/s)
-    _t_leg(1024, 64, "flash", True, 900),
-    _t_leg(1024, 64, "full", True, 900),
-    _t_leg(4096, 16, "flash", True, 1200),
-    _t_leg(4096, 16, "full", True, 1200),
-    # round-record legs: cheap, high value, must not starve behind the
-    # expensive 8k/16k probes on a wedge-prone tunnel
+    # Windows are rare and short (03:17 today lasted ~90s of leg time),
+    # so strictly by round-value-per-second. The dense transformer path
+    # is unchanged since round 3 — its committed numbers stay valid —
+    # so never-measured round-4 evidence (headline, flash rework,
+    # decode, on-chip parity) outranks dense re-measures.
     {"id": "cnn_headline.q", "role": "fused", "env": {}, "quick": True,
      "timeout": 900},
+    _t_leg(1024, 64, "flash", True, 900),
     {"id": "decode.q", "role": "decode", "env": {}, "quick": True,
      "timeout": 900},
     # north-star closure: the reference's full 3-epoch workload trained
@@ -76,6 +74,9 @@ LEGS = [
                                            "make_parity_artifact.py"),
               "--variant", "fused"],
      "env": {}, "timeout": 1500},
+    _t_leg(1024, 64, "full", True, 900),
+    _t_leg(4096, 16, "flash", True, 1200),
+    _t_leg(4096, 16, "full", True, 1200),
     {"id": "cnn_b1024_bf16_scan.q", "role": "fused",
      "env": {"SLT_BENCH_BATCH": "1024", "SLT_BENCH_DTYPE": "bfloat16"},
      "quick": True, "timeout": 900},
