@@ -29,7 +29,7 @@ sys.path.insert(0, REPO)
 
 OUT = os.path.join(REPO, "artifacts", "tpu_window_runs.jsonl")
 STATE = "/tmp/tpu_runner_state.json"
-PROBE_INTERVAL = 300
+PROBE_INTERVAL = 120   # windows can be shorter than a lazy probe gap
 PROBE_TIMEOUT = 150
 # Hard stop: the round-end driver runs bench.py on the same tunnel; a
 # still-running leg would contend with (and possibly starve) the
