@@ -1,0 +1,107 @@
+"""scripts/tpu_window_runner.py main-loop semantics, simulated.
+
+The runner is round-critical infrastructure (every on-chip number this
+round flows through it), so its state machine is pinned: completed legs
+are never re-run, a timeout/error breaks back to probing without
+burning an attempt on every remaining leg, attempts cap at
+MAX_ATTEMPTS, and the deadline frees the tunnel for the round-end
+driver bench."""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def runner(tmp_path, monkeypatch):
+    path = os.path.join(REPO, "scripts", "tpu_window_runner.py")
+    spec = importlib.util.spec_from_file_location("twr_sim", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, REPO)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "STATE", str(tmp_path / "state.json"))
+    monkeypatch.setattr(mod, "OUT", str(tmp_path / "runs.jsonl"))
+    monkeypatch.setattr(mod.time, "sleep", lambda s: None)
+    return mod
+
+
+def read_out(mod):
+    with open(mod.OUT) as f:
+        return [json.loads(line) for line in f]
+
+
+def test_done_legs_never_rerun_and_wedge_breaks(runner, monkeypatch):
+    legs = [{"id": "a", "role": "fused", "env": {}, "quick": True,
+             "timeout": 1},
+            {"id": "b", "role": "fused", "env": {}, "quick": True,
+             "timeout": 1},
+            {"id": "c", "role": "fused", "env": {}, "quick": True,
+             "timeout": 1}]
+    monkeypatch.setattr(runner, "LEGS", legs)
+    monkeypatch.setattr(runner, "probe", lambda: True)
+
+    calls = []
+    # window 1: a ok, b times out (wedge) -> break; window 2: b ok, c ok
+    script = {("a", 1): "ok", ("b", 1): "timeout", ("b", 2): "ok",
+              ("c", 1): "ok"}
+
+    def fake_run_leg(leg):
+        n = sum(1 for c in calls if c == leg["id"]) + 1
+        calls.append(leg["id"])
+        return {"leg": leg["id"], "status": script[(leg["id"], n)]}
+
+    monkeypatch.setattr(runner, "run_leg", fake_run_leg)
+    runner.main()
+    # a ran once only; b's timeout broke the window before c started
+    assert calls == ["a", "b", "b", "c"]
+    st = runner.load_state()
+    assert sorted(st["done"]) == ["a", "b", "c"]
+    assert read_out(runner)[-1]["leg"] == "__runner_done__"
+
+
+def test_attempts_cap_exhausts_a_dead_leg(runner, monkeypatch):
+    monkeypatch.setattr(runner, "LEGS", [
+        {"id": "dead", "role": "fused", "env": {}, "quick": True,
+         "timeout": 1}])
+    monkeypatch.setattr(runner, "probe", lambda: True)
+    calls = []
+
+    def fake_run_leg(leg):
+        calls.append(leg["id"])
+        return {"leg": leg["id"], "status": "error"}
+
+    monkeypatch.setattr(runner, "run_leg", fake_run_leg)
+    runner.main()
+    assert len(calls) == runner.MAX_ATTEMPTS
+    assert runner.load_state()["done"] == []
+
+
+def test_deadline_exits_before_next_leg(runner, monkeypatch):
+    monkeypatch.setattr(runner, "LEGS", [
+        {"id": "x", "role": "fused", "env": {}, "quick": True,
+         "timeout": 1}])
+    monkeypatch.setattr(runner, "probe", lambda: True)
+    monkeypatch.setattr(runner, "DEADLINE", 0.0)  # already past
+    monkeypatch.setattr(runner, "run_leg",
+                        lambda leg: pytest.fail("leg ran past deadline"))
+    runner.main()
+    assert read_out(runner)[-1]["leg"] == "__runner_deadline__"
+
+
+def test_invalid_and_oom_mark_done(runner, monkeypatch):
+    legs = [{"id": "i", "role": "fused", "env": {}, "quick": True,
+             "timeout": 1},
+            {"id": "o", "role": "fused", "env": {}, "quick": True,
+             "timeout": 1}]
+    monkeypatch.setattr(runner, "LEGS", legs)
+    monkeypatch.setattr(runner, "probe", lambda: True)
+    monkeypatch.setattr(runner, "run_leg", lambda leg: {
+        "leg": leg["id"],
+        "status": "invalid" if leg["id"] == "i" else "oom"})
+    runner.main()
+    assert sorted(runner.load_state()["done"]) == ["i", "o"]
