@@ -88,6 +88,24 @@ def test_onepass_backward_matches_two_kernel(monkeypatch, causal):
                                    atol=1e-5, rtol=1e-5)
 
 
+def test_onepass_backward_bf16_storage():
+    """The on-chip path runs bf16 storage with f32 accumulation; pin the
+    same property in interpret mode: bf16 one-pass grads track the f32
+    dense reference within bf16 resolution."""
+    q, k, v = qkv(t=128, b=1, h=2, d=16)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    w = jax.random.normal(jax.random.PRNGKey(8), q.shape, jnp.float32)
+
+    f = lambda a, b, c: jnp.sum(
+        flash_attention(a, b, c, causal=True).astype(jnp.float32) * w)
+    r = lambda a, b, c: jnp.sum(full_attention(a, b, c, causal=True) * w)
+    got = jax.grad(f, argnums=(0, 1, 2))(qb, kb, vb)
+    want = jax.grad(r, argnums=(0, 1, 2))(q, k, v)
+    for g, wg in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g, np.float32),
+                                   np.asarray(wg), atol=0.04, rtol=0.04)
+
+
 def test_onepass_selection_rule():
     """_use_onepass: VMEM-residency-bounded, env-overridable."""
     from split_learning_tpu.ops.flash_attention import _use_onepass
