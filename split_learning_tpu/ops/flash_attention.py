@@ -25,8 +25,11 @@ Design (the canonical TPU flash schedule):
   once and feeds dQ, dK, dV — 10 matmul units of T^2*D vs dense's 8.
   dQ's output block is revisited *consecutively* across the k grid dim
   (index map ignores k), the supported accumulation idiom. Residency
-  caps this form: tp*d*(2*itemsize+4) against half the ~16 MiB/core
-  VMEM (T <= ~8k bf16 at d=128).
+  caps this form: the double-buffered whole-sequence refs
+  (``_onepass_resident_bytes`` — ~4 KiB/row at bf16 d=128) against a
+  64 MiB budget inside a raised 96 MiB scoped-VMEM limit (the v5e core
+  has ~128 MiB; Mosaic's 16 MiB default is what the kernel overrides),
+  so bf16 d=128 stays one-pass through T = 16384.
   (b) *Long-T two-kernel split*: dQ grids over (query, key) blocks,
   dK/dV over (key, query) blocks, each recomputing P blockwise from
   (Q, K, LSE) — total 14 matmul units (1.75x dense): each kernel
@@ -91,20 +94,59 @@ def _pick_block(t: int) -> int:
     return b
 
 
+# The one-pass backward's whole-sequence refs exceed Mosaic's default
+# 16 MiB scoped-VMEM limit at T=4096 (measured: 16.5 MiB requested);
+# a v4/v5 core physically has ~128 MiB of VMEM, so the kernel raises
+# its own limit to _vmem_limit_bytes() and budgets the whole-sequence
+# refs against 2/3 of it, leaving the rest for the double-buffered
+# K/V/dK/dV blocks and compiler temporaries.
+
+
+def _vmem_limit_bytes() -> int:
+    """Scoped-VMEM limit the one-pass kernel may request, per device
+    generation (mirrors :func:`_device_hbm_bytes`'s query-with-v5e-
+    fallback discipline). v2/v3 cores have only 16 MiB of VMEM —
+    requesting more than Mosaic's default there would fail the compile
+    of shapes the two-kernel split handles fine — while v4 onward have
+    ~128 MiB. Unknown/CPU devices report the v5e figure so interpret-
+    mode tests select the same backward form as the bench chip."""
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return 96 * 1024 * 1024
+    if "v2" in kind or "v3" in kind:
+        return 16 * 1024 * 1024
+    return 96 * 1024 * 1024
+
+
+def _onepass_resident_bytes(tp: int, d: int, itemsize: int) -> int:
+    """True VMEM footprint of the one-pass backward's whole-sequence
+    refs. Per padded row: Q + dO in the storage dtype, the f32 dQ
+    output, and the LSE/delta rows — which cost a full 128-lane tile
+    each despite _ROWW=8, because VMEM pads the minor dimension to the
+    lane width. Pallas double-buffers every ref (constant index maps
+    included — the 16.5 MiB scoped-allocation failure at T=4096 bf16
+    was exactly 2x the naive sum), hence the factor 2."""
+    dp = round_up(d, LANE)
+    per_row = dp * (2 * itemsize + 4) + 2 * LANE * 4
+    return 2 * tp * per_row
+
+
 def _use_onepass(t: int, block: int, d: int, itemsize: int) -> bool:
-    """Backward-form selection: the one-pass kernel needs Q, dO, the f32
-    dQ accumulator, and the LSE/delta rows VMEM-resident for the whole
-    (padded) sequence — ≈ tp·d·(2·itemsize + 4) bytes plus working
-    blocks. Budget half the ~16 MiB/core so the block temporaries and
-    double-buffered K/V DMAs fit. ``SLT_FLASH_ONEPASS_T`` overrides:
-    one-pass at or below that padded T, two-kernel above (0 = never)."""
+    """Backward-form selection: one-pass while its whole-sequence
+    residency (see :func:`_onepass_resident_bytes`) fits 2/3 of the
+    device's scoped-VMEM limit, leaving the rest for the
+    double-buffered K/V/dK/dV blocks and compiler temporaries — on a
+    v4/v5 core (96 MiB limit, 64 MiB budget) bf16 d=128 passes through
+    T=16384. ``SLT_FLASH_ONEPASS_T`` overrides: one-pass at or below
+    that padded T, two-kernel above (0 = never)."""
     import os
     tp = round_up(t, block)
     env = os.environ.get("SLT_FLASH_ONEPASS_T")
     if env:   # empty string = unset, like SLT_FLASH_AUTO_T
         return tp <= int(env)
-    resident = tp * round_up(d, LANE) * (2 * itemsize + 4)
-    return resident <= 8 * 1024 * 1024
+    budget = _vmem_limit_bytes() * 2 // 3
+    return _onepass_resident_bytes(tp, d, itemsize) <= budget
 
 
 def select_attention(b: int, t: int, h: int, itemsize: int,
@@ -231,7 +273,9 @@ def _onepass_bwd_kernel(blk: int, t: int, scale: float, causal: bool,
                         dk_ref, dv_ref, dq_ref):
     """Single-pass backward for mid-length T: grid ``(bh, k block)``
     with Q/dO/LSE/delta — and the f32 dQ accumulator — fully VMEM
-    resident (≈6 MiB at T=4096, d=128, vs the ~16 MiB/core budget).
+    resident (≈16.5 MiB double-buffered at T=4096 bf16 d=128 — see
+    :func:`_onepass_resident_bytes` — against the raised
+    ``_vmem_limit_bytes()``, not Mosaic's 16 MiB default).
     Each (k, q) block pair computes scores and ``dO·Vᵀ`` exactly once
     and feeds all three gradients: 10 matmul units of T²·D vs the
     two-kernel split's 14 (module docstring), and one kernel launch
@@ -474,6 +518,8 @@ def _make_flash(bh: int, t: int, d: int, causal: bool, dtype_name: str,
                 in_specs=[kblk(), kblk(), seq(), seq(), seqrow(),
                           seqrow()],
                 out_specs=(kblk(), kblk(), seq()),
+                compiler_params=pltpu.CompilerParams(
+                    vmem_limit_bytes=_vmem_limit_bytes()),
                 interpret=use_interpret(),
             )(kp, vp, qp, dop, lse, delta)
             dq = dq.astype(in_dtype)

