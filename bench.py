@@ -118,6 +118,21 @@ def measure_baseline(quick: bool) -> dict:
     }
 
 
+def grow_window(window, n_chunks: int, floor_s: float = 1.0,
+                cap: int = 4096) -> int:
+    """Double ``n_chunks`` until ``window(n_chunks)`` takes at least
+    ``floor_s`` seconds. Every timed window pays a fixed close-out cost
+    (the final loss transfer through the device tunnel, ~45-85 ms
+    measured), and a window comparable to that cost fails the 2x
+    linearity cross-check no matter how fast the chip is — the
+    2026-07-31 quick CNN leg timed 0.07 s windows and was (correctly)
+    gated out at linearity 1.37. Re-times rather than extrapolates, so
+    the published number is always a directly measured window."""
+    while window(n_chunks)[0] < floor_s and n_chunks < cap:
+        n_chunks = min(n_chunks * 2, cap)
+    return n_chunks
+
+
 def validate_leg(leg: dict) -> tuple[bool, str | None]:
     """The publication gate README.md promises: a leg is INVALID (its
     number must never be published) unless
@@ -268,6 +283,7 @@ def measure_fused(quick: bool) -> dict:
             return time.perf_counter() - t0, last
 
         window(1)  # compile + warm + drain
+        n_chunks = grow_window(window, n_chunks)
         times = sorted(window(n_chunks)[0] for _ in range(3))
         t_med = times[1]
         t_2x, last_loss = window(2 * n_chunks)

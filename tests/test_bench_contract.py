@@ -136,3 +136,26 @@ def test_validate_leg_gates_impossible_throughput():
                                "model_tflops_per_sec": 1.0,
                                "linearity_2x": 1.02})
     assert not ok and "linearity" in reason
+
+
+def test_grow_window_clears_timing_floor():
+    """The fused role's timed window must dwarf the fixed per-window
+    close-out cost (the 2026-07-31 quick CNN leg timed 0.07 s windows
+    and failed its linearity gate at 1.37): grow_window doubles the
+    chunk count until a *measured* window clears the floor."""
+    import bench
+
+    calls = []
+
+    def fake_window(n):  # 50 ms fixed cost + 20 ms/chunk "compute"
+        calls.append(n)
+        return 0.05 + 0.02 * n, 0.0
+
+    n = bench.grow_window(fake_window, 2, floor_s=1.0)
+    assert n == 64                      # 0.05 + 1.28 s clears the floor
+    assert calls == [2, 4, 8, 16, 32, 64]
+    # an already-long window is left alone
+    assert bench.grow_window(lambda n: (5.0, 0.0), 4, floor_s=1.0) == 4
+    # the cap bounds pathological growth
+    assert bench.grow_window(lambda n: (0.0, 0.0), 2, floor_s=1.0,
+                             cap=16) == 16

@@ -3,6 +3,7 @@ continuation (the property a reference pod-restart destroys)."""
 
 import jax
 import numpy as np
+import pytest
 
 from split_learning_tpu.models import get_plan
 from split_learning_tpu.runtime import ServerRuntime, SplitClientTrainer
@@ -20,6 +21,7 @@ def data(n):
              rs.randint(0, 10, (BATCH,)).astype(np.int64)) for _ in range(n)]
 
 
+@pytest.mark.slow
 def test_fused_checkpoint_resume(tmp_path):
     plan = get_plan(mode="split")
     cfg = Config(mode="split", batch_size=BATCH)

@@ -13,6 +13,8 @@ import socket
 import threading
 import time
 
+import pytest
+
 import jax
 import numpy as np
 
@@ -34,6 +36,7 @@ def _free_port() -> int:
     return port
 
 
+@pytest.mark.slow
 def test_client_survives_server_restart(tmp_path):
     cfg = Config(mode="split", batch_size=BATCH)
     plan = get_plan(mode="split")

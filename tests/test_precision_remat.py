@@ -112,11 +112,15 @@ def test_bf16_logits_are_bf16():
     assert logits.dtype == jnp.bfloat16
 
 
-def test_config_remat_env_and_cli_plumbing(tmp_path):
+def test_config_remat_env_parsing():
     cfg = Config.from_env(env={"SLT_REMAT": "true"})
     assert cfg.remat is True
     cfg = Config.from_env(env={"SLT_REMAT": "0"})
     assert cfg.remat is False
+
+
+@pytest.mark.slow
+def test_config_remat_cli_plumbing(tmp_path):
     from split_learning_tpu.launch.run import main
     # --remat/--dtype parse and reach the Config (steps=2 keeps it quick)
     rc = main(["train", "--transport", "fused", "--dataset", "synthetic",
