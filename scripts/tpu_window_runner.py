@@ -90,6 +90,12 @@ LEGS = [
     _t_leg(8192, 16, "full", True, 1500),
     _t_leg(16384, 16, "flash", True, 1700),
     _t_leg(16384, 16, "full", True, 1700),
+    # crossover refinement: with the VMEM-fixed one-pass backward flash
+    # won T>=8192 outright (2026-07-31 window); T=2048 brackets the
+    # speed crossover between the T=1024 and T=4096 measurements so
+    # select_attention can be re-pinned from data
+    _t_leg(2048, 64, "flash", True, 1200),
+    _t_leg(2048, 64, "full", True, 1200),
     # non-quick confirmations
     {"id": "decode.full", "role": "decode", "env": {}, "quick": False,
      "timeout": 1500},

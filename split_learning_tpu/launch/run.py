@@ -44,6 +44,21 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--epochs", type=int, default=None)
     p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--optimizer", choices=["sgd", "adam", "adamw"],
+                   default=None,
+                   help="sgd (the reference's) | adam | adamw "
+                        "(runtime/state.py make_tx)")
+    p.add_argument("--momentum", type=float, default=None)
+    p.add_argument("--weight-decay", dest="weight_decay", type=float,
+                   default=None,
+                   help="adamw decoupled decay; coupled L2 for sgd")
+    p.add_argument("--warmup-steps", dest="warmup_steps", type=int,
+                   default=None,
+                   help="linear lr warmup over this many steps")
+    p.add_argument("--decay-steps", dest="decay_steps", type=int,
+                   default=None,
+                   help="cosine-decay the lr to 0 by this total step "
+                        "count (includes warmup)")
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--data-dir", default=None)
     p.add_argument("--tracking", default=None,
@@ -65,6 +80,8 @@ def _config_from_args(args) -> "Config":
     from split_learning_tpu.utils import Config
     overrides = {}
     for field in ("mode", "model", "dataset", "batch_size", "epochs", "lr",
+                  "optimizer", "momentum", "weight_decay", "warmup_steps",
+                  "decay_steps",
                   "seed", "data_dir", "tracking", "tracking_uri", "kernels",
                   "checkpoint_dir", "dtype", "remat"):
         val = getattr(args, field, None)
@@ -139,6 +156,24 @@ def cmd_train(args) -> int:
     from split_learning_tpu.utils import Config
 
     cfg = _config_from_args(args)
+    # dataset/model family pairing: a mismatch surfaces deep in the loss
+    # as an opaque shape error, so check it up front like the other
+    # flag-combination guards in this command
+    token_sets = {"tokens", "lm"}
+    if cfg.model == "transformer_lm" and cfg.dataset != "lm":
+        print(f"[error] model 'transformer_lm' needs per-token targets: "
+              f"--dataset lm (got {cfg.dataset!r})", file=sys.stderr)
+        return 2
+    if cfg.model == "transformer" and cfg.dataset != "tokens":
+        print(f"[error] model 'transformer' (sequence classifier) needs "
+              f"--dataset tokens (got {cfg.dataset!r})", file=sys.stderr)
+        return 2
+    if cfg.model not in ("transformer", "transformer_lm") \
+            and cfg.dataset in token_sets:
+        print(f"[error] dataset {cfg.dataset!r} is token-shaped; model "
+              f"{cfg.model!r} consumes images (mnist | cifar10 | "
+              "synthetic)", file=sys.stderr)
+        return 2
     plan = get_plan(model=cfg.model, mode=cfg.mode, dtype=cfg.dtype)
     ds = load_dataset(cfg.dataset, cfg.data_dir,
                       store=store_from_config(cfg),

@@ -36,7 +36,8 @@ import numpy as np
 
 from split_learning_tpu.core.losses import cross_entropy
 from split_learning_tpu.core.stage import SplitPlan, stage_backward
-from split_learning_tpu.runtime.state import TrainState, apply_grads, make_state, sgd
+from split_learning_tpu.runtime.state import (
+    TrainState, apply_grads, make_state, make_tx)
 from split_learning_tpu.transport.base import Transport, TransportError
 from split_learning_tpu.utils.config import Config
 
@@ -89,7 +90,7 @@ class SplitClientTrainer:
                              "U-shaped plans")
         self.stage = plan.stages[0]
         # init only the client stage (server inits its own half)
-        self._tx = sgd(cfg.lr, cfg.momentum)
+        self._tx = make_tx(cfg)
         self.state: Optional[TrainState] = None
         self._rng = rng
 
@@ -188,7 +189,7 @@ class USplitClientTrainer:
         self.transport = transport
         self.logger = logger
         self.client_id = client_id
-        self._tx = sgd(cfg.lr, cfg.momentum)
+        self._tx = make_tx(cfg)
         self.state_a: Optional[TrainState] = None
         self.state_c: Optional[TrainState] = None
         self._rng = rng
@@ -263,7 +264,7 @@ class FederatedClientTrainer:
         self.cfg = cfg
         self.transport = transport
         self.logger = logger
-        self._tx = sgd(cfg.lr, cfg.momentum)
+        self._tx = make_tx(cfg)
         self.state: Optional[TrainState] = None
         self._rng = rng
 

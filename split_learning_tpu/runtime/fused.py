@@ -33,7 +33,8 @@ from split_learning_tpu.core.losses import cross_entropy
 from split_learning_tpu.core.stage import SplitPlan, remat_plan
 from split_learning_tpu.parallel.mesh import (
     DATA_AXIS, SEQ_AXIS, batch_sharding, replicated, tp_param_sharding)
-from split_learning_tpu.runtime.state import TrainState, apply_grads, make_state, sgd
+from split_learning_tpu.runtime.state import (
+    TrainState, apply_grads, make_state, make_tx)
 from split_learning_tpu.utils.config import Config
 
 
@@ -48,10 +49,16 @@ class FusedSplitTrainer:
         self.cfg = cfg
         self.mesh = mesh
         use_pallas = cfg.kernels == "pallas"
-        self._tx = sgd(cfg.lr, cfg.momentum)
+        self._tx = make_tx(cfg)
+        # the hand-written fused_sgd_step implements exactly plain
+        # (momentum-)SGD at a constant lr; any other optimizer/schedule
+        # runs the optax update (the loss/attention kernels stay pallas)
+        fused_opt = (cfg.optimizer == "sgd" and not cfg.weight_decay
+                     and not cfg.warmup_steps and not cfg.decay_steps)
+        use_pallas_opt = use_pallas and fused_opt
 
         params = tuple(plan.init(rng, jnp.asarray(sample_input)))
-        if use_pallas:
+        if use_pallas_opt:
             # the fused-kernel path owns its optimizer state: the momentum
             # trace pytree (or () without momentum) instead of optax's
             from split_learning_tpu.ops.sgd import init_trace
@@ -101,7 +108,7 @@ class FusedSplitTrainer:
             return loss_op(logits, y)
 
         def update(state: TrainState, grads) -> TrainState:
-            if not use_pallas:
+            if not use_pallas_opt:
                 return apply_grads(tx, state, grads)
             trace = state.opt_state if momentum else None
             new_params, new_trace = fused_sgd_step(

@@ -43,7 +43,8 @@ import numpy as np
 
 from split_learning_tpu.core.stage import stage_backward
 from split_learning_tpu.runtime.client import StepRecord
-from split_learning_tpu.runtime.state import TrainState, apply_grads, make_state, sgd
+from split_learning_tpu.runtime.state import (
+    TrainState, apply_grads, make_state, make_tx)
 from split_learning_tpu.transport.base import Transport
 from split_learning_tpu.utils.config import Config
 
@@ -72,7 +73,7 @@ class PipelinedSplitClientTrainer:
         self.logger = logger
         self.client_id = client_id
         self.stage = plan.stages[0]
-        self._tx = sgd(cfg.lr, cfg.momentum)
+        self._tx = make_tx(cfg)
         self.state: Optional[TrainState] = None
         self._rng = rng
 

@@ -28,7 +28,8 @@ import numpy as np
 
 from split_learning_tpu.core.losses import cross_entropy
 from split_learning_tpu.core.stage import SplitPlan
-from split_learning_tpu.runtime.state import TrainState, apply_grads, make_state, sgd
+from split_learning_tpu.runtime.state import (
+    TrainState, apply_grads, make_state, make_tx)
 from split_learning_tpu.utils.config import Config
 
 
@@ -68,7 +69,7 @@ class ServerRuntime:
         self._step_floor = -1
 
         all_params = plan.init(rng, jnp.asarray(sample_input))
-        self._tx = sgd(cfg.lr, cfg.momentum)
+        self._tx = make_tx(cfg)
 
         if cfg.mode == "federated":
             # federated server keeps the full model (ref src/model_def.py:56-57)

@@ -23,12 +23,55 @@ class TrainState(NamedTuple):
     step: jax.Array  # int32 scalar
 
 
-def sgd(lr: float, momentum: float = 0.0) -> optax.GradientTransformation:
+def sgd(lr, momentum: float = 0.0) -> optax.GradientTransformation:
     """The reference's optimizer: SGD(lr=0.01), no momentum
-    (``src/client_part.py:17``, ``src/server_part.py:15``)."""
+    (``src/client_part.py:17``, ``src/server_part.py:15``). ``lr`` may
+    be a float or an optax schedule (make_lr)."""
     if momentum:
         return optax.sgd(lr, momentum=momentum)
     return optax.sgd(lr)
+
+
+def make_lr(cfg) -> "float | optax.Schedule":
+    """Learning-rate schedule from Config: constant by default; linear
+    warmup over ``warmup_steps`` then constant; cosine decay to 0 by
+    ``decay_steps`` (total, including warmup) when set. Schedules ride
+    optax's internal step count, so every trainer (fused, split client,
+    server, pipelined) gets them through its GradientTransformation
+    with no step-threading changes."""
+    if not (cfg.warmup_steps or cfg.decay_steps):
+        return cfg.lr
+    if cfg.decay_steps:
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=cfg.lr,
+            warmup_steps=cfg.warmup_steps,
+            decay_steps=cfg.decay_steps, end_value=0.0)
+    return optax.join_schedules(
+        [optax.linear_schedule(0.0, cfg.lr, cfg.warmup_steps),
+         optax.constant_schedule(cfg.lr)],
+        [cfg.warmup_steps])
+
+
+def make_tx(cfg) -> optax.GradientTransformation:
+    """Optimizer factory from Config — the one construction site every
+    trainer shares. ``sgd`` (+ optional L2 via weight_decay, momentum)
+    preserves the reference's exact update; ``adam``/``adamw`` serve
+    the transformer/causal-LM families, where decoupled weight decay
+    and warmup-cosine are the standard recipe."""
+    lr = make_lr(cfg)
+    if cfg.optimizer == "sgd":
+        tx = sgd(lr, cfg.momentum)
+        if cfg.weight_decay:
+            # coupled L2 for SGD: decay joins the gradient before the
+            # lr scaling, the classical formulation
+            tx = optax.chain(
+                optax.add_decayed_weights(cfg.weight_decay), tx)
+        return tx
+    if cfg.optimizer == "adam":
+        return optax.adam(lr)
+    if cfg.optimizer == "adamw":
+        return optax.adamw(lr, weight_decay=cfg.weight_decay)
+    raise ValueError(f"Unknown optimizer: {cfg.optimizer!r}")
 
 
 def make_state(params: Params, tx: optax.GradientTransformation) -> TrainState:

@@ -33,6 +33,11 @@ _ENV_MAP = {
     "batch_size": "SLT_BATCH_SIZE",
     "epochs": "SLT_EPOCHS",
     "lr": "SLT_LR",
+    "momentum": "SLT_MOMENTUM",
+    "optimizer": "SLT_OPTIMIZER",
+    "weight_decay": "SLT_WEIGHT_DECAY",
+    "warmup_steps": "SLT_WARMUP_STEPS",
+    "decay_steps": "SLT_DECAY_STEPS",
     "seed": "SLT_SEED",
     "dtype": "SLT_DTYPE",
     "num_clients": "SLT_NUM_CLIENTS",
@@ -67,6 +72,15 @@ class Config:
     epochs: int = 3
     lr: float = 0.01
     momentum: float = 0.0
+    # optimizer family: "sgd" (the reference's, src/client_part.py:17)
+    # | "adam" | "adamw" — the LM/transformer families train with adamw
+    optimizer: str = "sgd"
+    weight_decay: float = 0.0   # adamw decoupled decay; sgd L2 (adam: invalid)
+    # learning-rate schedule (runtime/state.py make_lr): linear warmup
+    # over warmup_steps, then constant — or cosine decay to 0 by
+    # decay_steps (total, including warmup) when decay_steps > 0
+    warmup_steps: int = 0
+    decay_steps: int = 0
     seed: int = 0
     dtype: str = "float32"
 
@@ -139,6 +153,26 @@ class Config:
                 "(expected 'xla' or 'pallas')")
         if self.seq_parallel <= 0:
             raise ValueError("seq_parallel must be positive")
+        if self.optimizer not in ("sgd", "adam", "adamw"):
+            raise ValueError(
+                f"Unknown optimizer: {self.optimizer!r} "
+                "(expected 'sgd', 'adam' or 'adamw')")
+        if self.weight_decay < 0 or self.warmup_steps < 0 \
+                or self.decay_steps < 0:
+            raise ValueError("weight_decay / warmup_steps / decay_steps "
+                             "must be non-negative")
+        if self.weight_decay and self.optimizer == "adam":
+            raise ValueError(
+                "weight_decay with adam silently L2-couples into the "
+                "moments; use optimizer='adamw' (decoupled) instead")
+        if self.momentum and self.optimizer != "sgd":
+            raise ValueError(
+                f"momentum is an SGD hyperparameter; {self.optimizer!r} "
+                "has its own moment estimates and would silently ignore "
+                "it")
+        if self.decay_steps and self.decay_steps <= self.warmup_steps:
+            raise ValueError("decay_steps counts total steps incl. "
+                             "warmup and must exceed warmup_steps")
         if self.attn not in ("full", "flash", "auto", "ring",
                              "ring_flash", "ulysses"):
             raise ValueError(

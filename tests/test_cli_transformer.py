@@ -62,3 +62,19 @@ def test_train_cli_seq_parallel_warns_on_non_transformer(tmp_path, capsys):
     assert rc == 0
     err = capsys.readouterr().err
     assert "--seq-parallel ignored" in err and "sequence axis" in err
+
+
+def test_train_cli_rejects_model_dataset_mismatch(tmp_path, capsys):
+    """A token model on an image dataset (or vice versa) used to die
+    deep in the loss with an opaque shape error; now it's a clear
+    up-front [error] like the other flag-combination guards."""
+    rc = main(["train", "--model", "transformer_lm", "--dataset", "tokens",
+               "--steps", "2", "--data-dir", str(tmp_path),
+               "--tracking", "noop"])
+    assert rc == 2
+    assert "--dataset lm" in capsys.readouterr().err
+    rc = main(["train", "--model", "split_cnn", "--dataset", "lm",
+               "--steps", "2", "--data-dir", str(tmp_path),
+               "--tracking", "noop"])
+    assert rc == 2
+    assert "token-shaped" in capsys.readouterr().err
