@@ -59,6 +59,9 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    default=None,
                    help="cosine-decay the lr to 0 by this total step "
                         "count (includes warmup)")
+    p.add_argument("--grad-clip-norm", dest="grad_clip_norm", type=float,
+                   default=None,
+                   help="clip gradients to this global L2 norm (0 = off)")
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--data-dir", default=None)
     p.add_argument("--tracking", default=None,
@@ -81,7 +84,7 @@ def _config_from_args(args) -> "Config":
     overrides = {}
     for field in ("mode", "model", "dataset", "batch_size", "epochs", "lr",
                   "optimizer", "momentum", "weight_decay", "warmup_steps",
-                  "decay_steps",
+                  "decay_steps", "grad_clip_norm",
                   "seed", "data_dir", "tracking", "tracking_uri", "kernels",
                   "checkpoint_dir", "dtype", "remat"):
         val = getattr(args, field, None)

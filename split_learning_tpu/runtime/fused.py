@@ -54,7 +54,8 @@ class FusedSplitTrainer:
         # (momentum-)SGD at a constant lr; any other optimizer/schedule
         # runs the optax update (the loss/attention kernels stay pallas)
         fused_opt = (cfg.optimizer == "sgd" and not cfg.weight_decay
-                     and not cfg.warmup_steps and not cfg.decay_steps)
+                     and not cfg.warmup_steps and not cfg.decay_steps
+                     and not cfg.grad_clip_norm)
         use_pallas_opt = use_pallas and fused_opt
 
         params = tuple(plan.init(rng, jnp.asarray(sample_input)))

@@ -66,12 +66,23 @@ def make_tx(cfg) -> optax.GradientTransformation:
             # lr scaling, the classical formulation
             tx = optax.chain(
                 optax.add_decayed_weights(cfg.weight_decay), tx)
-        return tx
-    if cfg.optimizer == "adam":
-        return optax.adam(lr)
-    if cfg.optimizer == "adamw":
-        return optax.adamw(lr, weight_decay=cfg.weight_decay)
-    raise ValueError(f"Unknown optimizer: {cfg.optimizer!r}")
+    elif cfg.optimizer == "adam":
+        tx = optax.adam(lr)
+    elif cfg.optimizer == "adamw":
+        tx = optax.adamw(lr, weight_decay=cfg.weight_decay)
+    else:
+        raise ValueError(f"Unknown optimizer: {cfg.optimizer!r}")
+    if cfg.grad_clip_norm:
+        # clip the raw gradient before moments/decay see it. Scope note:
+        # the norm is global over THIS transformation's param tree — the
+        # whole model in the fused/pipeline single-program trainers, but
+        # per party in the MPMD split runtimes (client and server each
+        # own a make_tx over their stages; syncing norms across the wire
+        # would add a round trip for a hyperparameter the reference
+        # doesn't even have)
+        tx = optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip_norm), tx)
+    return tx
 
 
 def make_state(params: Params, tx: optax.GradientTransformation) -> TrainState:

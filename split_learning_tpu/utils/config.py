@@ -38,6 +38,7 @@ _ENV_MAP = {
     "weight_decay": "SLT_WEIGHT_DECAY",
     "warmup_steps": "SLT_WARMUP_STEPS",
     "decay_steps": "SLT_DECAY_STEPS",
+    "grad_clip_norm": "SLT_GRAD_CLIP_NORM",
     "seed": "SLT_SEED",
     "dtype": "SLT_DTYPE",
     "num_clients": "SLT_NUM_CLIENTS",
@@ -81,6 +82,7 @@ class Config:
     # decay_steps (total, including warmup) when decay_steps > 0
     warmup_steps: int = 0
     decay_steps: int = 0
+    grad_clip_norm: float = 0.0   # clip grads to this global L2 norm (0 = off)
     seed: int = 0
     dtype: str = "float32"
 
@@ -158,9 +160,9 @@ class Config:
                 f"Unknown optimizer: {self.optimizer!r} "
                 "(expected 'sgd', 'adam' or 'adamw')")
         if self.weight_decay < 0 or self.warmup_steps < 0 \
-                or self.decay_steps < 0:
-            raise ValueError("weight_decay / warmup_steps / decay_steps "
-                             "must be non-negative")
+                or self.decay_steps < 0 or self.grad_clip_norm < 0:
+            raise ValueError("weight_decay / warmup_steps / decay_steps / "
+                             "grad_clip_norm must be non-negative")
         if self.weight_decay and self.optimizer == "adam":
             raise ValueError(
                 "weight_decay with adam silently L2-couples into the "

@@ -152,3 +152,20 @@ def test_momentum_rejected_off_sgd_and_env_parses():
     with pytest.raises(ValueError, match="momentum"):
         Config(optimizer="adamw", momentum=0.9)
     assert Config.from_env(env={"SLT_MOMENTUM": "0.9"}).momentum == 0.9
+
+
+def test_grad_clip_global_norm():
+    cfg = Config(grad_clip_norm=1.0, lr=1.0)
+    tx = make_tx(cfg)
+    params = toy_tree()
+    state = make_state(params, tx)
+    big = jax.tree_util.tree_map(lambda x: 100.0 * jnp.ones_like(x), params)
+    new = apply_grads(tx, state, big)
+    delta = jax.tree_util.tree_map(lambda a, b: np.asarray(a) - np.asarray(b),
+                                   new.params, params)
+    norm = np.sqrt(sum(float((d ** 2).sum())
+                       for d in jax.tree_util.tree_leaves(delta)))
+    # update = -lr * clipped grad, so its norm is exactly the clip
+    assert np.isclose(norm, 1.0, rtol=1e-5)
+    with pytest.raises(ValueError, match="non-negative"):
+        Config(grad_clip_norm=-0.5)
