@@ -350,6 +350,16 @@ def cmd_train(args) -> int:
                   "than stepwise dispatch; intended for TPU", file=sys.stderr)
 
         step = start_step
+        # observable schedules: when an lr schedule is active, log the
+        # applied rate alongside the loss (fused path; the schedule
+        # itself lives inside the optimizer via make_tx). make_lr
+        # returns a plain float when no schedule is configured — that
+        # return shape, not a re-statement of its trigger condition,
+        # decides whether to log
+        from split_learning_tpu.runtime.state import make_lr
+        lr_fn = make_lr(cfg)
+        if not callable(lr_fn):
+            lr_fn = None
         with trace_ctx:
             for epoch in range(cfg.epochs):  # step cap enforced by data_iter
                 if can_scan:
@@ -365,10 +375,19 @@ def cmd_train(args) -> int:
                             losses = np.asarray(trainer.train_epoch(
                                 np.stack(buf_x), np.stack(buf_y)))
                             buf_x, buf_y = [], []
-                            for loss_i in losses:
+                            lrs = None
+                            if lr_fn is not None:
+                                # one vectorized schedule eval per chunk,
+                                # not one tiny dispatch per step
+                                lrs = np.asarray(lr_fn(
+                                    step + np.arange(len(losses))))
+                            for i, loss_i in enumerate(losses):
                                 final_loss = float(loss_i)
                                 logger.log_metric("loss", final_loss,
                                                   step=step)
+                                if lrs is not None:
+                                    logger.log_metric(
+                                        "lr", float(lrs[i]), step=step)
                                 step += 1
                             if (args.checkpoint_every
                                     and (step - start_step)
@@ -382,6 +401,8 @@ def cmd_train(args) -> int:
                 for x, y in tail:
                     final_loss = trainer.train_step(x, y)
                     logger.log_metric("loss", final_loss, step=step)
+                    if lr_fn is not None:
+                        logger.log_metric("lr", float(lr_fn(step)), step=step)
                     step += 1
                     if (args.checkpoint_every
                             and (step - start_step) % args.checkpoint_every
