@@ -149,24 +149,42 @@ def _use_onepass(t: int, block: int, d: int, itemsize: int) -> bool:
     return _onepass_resident_bytes(tp, d, itemsize) <= budget
 
 
+# Measured speed crossover for the round-4 kernels (v5e, 2026-07-31
+# windows, artifacts/tpu_window_runs.jsonl): with the adaptive-block +
+# one-pass-backward rework, flash overtakes dense on throughput at
+# T=8192 (7.95 vs 4.54 steps/s, 47% vs 27% MFU) and holds 50% MFU at
+# T=16384 where dense cannot compile. 8192 is the conservative pin on
+# unambiguous same-day pairs. The crossover may yet move DOWN: at
+# T=1024 b64 the new flash measured 45.8 steps/s vs dense 42.57 from
+# the round-3 artifact (bench_tpu_transformer_2026-07-30.json; the
+# dense code path is unchanged since) — flash slightly ahead. The
+# round-4 window's own dense T=1024 leg read 2.61 steps/s, 16x below
+# its round-3 twin with perfect work-scaling, which smells like
+# transient contention on the pooled chip, not compute: a
+# confirmation leg is queued (tpu_window_runner.py) and this pin
+# should be revisited when it lands. T=256: dense ahead (353 vs 204,
+# round-3 kernels; round-4 re-measure queued).
+_FLASH_SPEED_T = 8192
+
+
 def select_attention(b: int, t: int, h: int, itemsize: int,
                      hbm_bytes: int | None = None,
                      t_kv: int | None = None) -> str:
     """``attn="auto"`` resolution: pick ``"full"`` (XLA dense) or
-    ``"flash"`` per shape. Round-3 measurements on the v5e chip
-    (artifacts/bench_tpu_transformer_*.json) put dense ahead at every
-    shape where it can train — its fused [T,T] softmax runs at higher
-    MFU than the blockwise recompute — and flash ahead exactly where
-    dense hits the HBM wall (b16/h2/T=16384 bf16 fails to compile at
-    16G). So the rule is memory-based: dense until its quadratic
-    residency threatens HBM, flash beyond. The residency estimate is
-    3 buffers of [B,H,T,T] (forward scores, saved softmax for the
-    backward, dP) against half the chip's HBM — half, because the model
-    activations/params/optimizer need the rest and a borderline compile
-    that OOMs mid-run is worse than the slower kernel.
+    ``"flash"`` per shape, from two measured rules:
 
-    ``SLT_FLASH_AUTO_T`` overrides: at or above that T, flash — the
-    knob for re-pinning the crossover when the kernels change.
+    1. *Speed*: at or past ``_FLASH_SPEED_T`` the round-4 kernels beat
+       dense outright on the chip (see the constant's note), so flash
+       wins even when dense would fit.
+    2. *Memory*: dense saves its quadratic score/softmax/dP buffers for
+       the backward — 3 buffers of [B,H,T,T] against half the chip's
+       HBM (half, because the model activations/params/optimizer need
+       the rest and a borderline compile that OOMs mid-run is worse
+       than the slower kernel). Past that, flash is mandatory
+       (measured: b16/h2/T=16384 bf16 fails to compile at 16G).
+
+    ``SLT_FLASH_AUTO_T`` overrides both: at or above that T, flash —
+    the knob for re-pinning the crossover when the kernels change.
 
     ``t_kv`` generalizes the rule to asymmetric query/key extents (the
     sharded parallel forms — ops/ring_attention.py — resolve their
@@ -177,6 +195,8 @@ def select_attention(b: int, t: int, h: int, itemsize: int,
     env = os.environ.get("SLT_FLASH_AUTO_T")
     if env:
         return "flash" if max(t, t_kv) >= int(env) else "full"
+    if max(t, t_kv) >= _FLASH_SPEED_T:
+        return "flash"
     if hbm_bytes is None:
         hbm_bytes = _device_hbm_bytes()
     dense_resident = 3 * b * h * t * t_kv * itemsize

@@ -129,16 +129,23 @@ def test_onepass_selection_rule(monkeypatch):
 
 
 def test_auto_attention_selection(monkeypatch):
-    """attn='auto' resolves per shape: dense below the HBM wall, flash
-    at it (the measured round-3 crossover); SLT_FLASH_AUTO_T re-pins."""
+    """attn='auto' resolves per shape by two rules: flash at/past the
+    measured round-4 speed crossover (_FLASH_SPEED_T, regardless of
+    HBM headroom), and flash wherever dense's quadratic backward
+    buffers threaten HBM; dense otherwise. SLT_FLASH_AUTO_T re-pins
+    both."""
     from split_learning_tpu.ops.flash_attention import select_attention
 
     hbm = 16 * 1024 ** 3
     # the measured facts: T=4096 b16/h2 bf16 trains dense; T=16384 OOMs
     assert select_attention(16, 4096, 2, 2, hbm_bytes=hbm) == "full"
     assert select_attention(16, 16384, 2, 2, hbm_bytes=hbm) == "flash"
-    # T=8192 is borderline (3 bufs = 12.9G): stay off the OOM cliff
+    # T=8192: flash by measured *speed* (2026-07-31: 7.95 vs 4.54
+    # steps/s) — even when dense would fit comfortably
     assert select_attention(16, 8192, 2, 2, hbm_bytes=hbm) == "flash"
+    assert select_attention(1, 8192, 1, 2, hbm_bytes=100 * hbm) == "flash"
+    # tiny batch below the speed crossover with huge HBM: dense
+    assert select_attention(1, 4096, 1, 2, hbm_bytes=100 * hbm) == "full"
     monkeypatch.setenv("SLT_FLASH_AUTO_T", "1024")
     assert select_attention(16, 1024, 2, 2, hbm_bytes=hbm) == "flash"
     assert select_attention(16, 512, 2, 2, hbm_bytes=hbm) == "full"
