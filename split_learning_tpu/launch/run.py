@@ -705,27 +705,43 @@ def cmd_serve(args) -> int:
     return 0
 
 
-def cmd_eval(args) -> int:
-    from split_learning_tpu.data import load_dataset
+def _resolve_checkpoint(args, cfg, cmd: str, require_model: str = None):
+    """Shared eval/generate preamble: meta-aware mode/model/dataset
+    resolution (``args.X or meta[X] or cfg.X``), plan build, latest-or-
+    ``--step`` pick, raw restore, full-composition assembly. Returns
+    ``(None, rc)`` on user error, else ``((meta, mode, model, dataset,
+    plan, step, params), None)``."""
     from split_learning_tpu.models import get_plan
     from split_learning_tpu.runtime.checkpoint import Checkpointer
-    from split_learning_tpu.runtime.evaluate import evaluate
 
-    cfg = _config_from_args(args)
     ckdir = cfg.checkpoint_dir
     if not ckdir:
-        print("eval requires --checkpoint-dir", file=sys.stderr)
-        return 2
+        print(f"{cmd} requires --checkpoint-dir", file=sys.stderr)
+        return None, 2
     meta = _read_ckpt_meta(ckdir)
     mode = args.mode or meta.get("mode", cfg.mode)
     model = args.model or meta.get("model", cfg.model)
     dataset = args.dataset or meta.get("dataset", cfg.dataset)
-
+    if require_model and model != require_model:
+        print(f"[error] {cmd} needs a {require_model!r} checkpoint "
+              f"(got {model!r})", file=sys.stderr)
+        return None, 2
     plan = get_plan(model=model, mode=mode, dtype=cfg.dtype)
     ckptr = Checkpointer(ckdir)
     step = args.step if args.step is not None else ckptr.latest_step()
-    raw = ckptr.restore_raw(step)
-    params = _assemble_full_params(meta["layout"], raw)
+    params = _assemble_full_params(meta["layout"], ckptr.restore_raw(step))
+    return (meta, mode, model, dataset, plan, step, params), None
+
+
+def cmd_eval(args) -> int:
+    from split_learning_tpu.data import load_dataset
+    from split_learning_tpu.runtime.evaluate import evaluate
+
+    cfg = _config_from_args(args)
+    resolved, rc = _resolve_checkpoint(args, cfg, "eval")
+    if resolved is None:
+        return rc
+    meta, mode, model, dataset, plan, step, params = resolved
     from split_learning_tpu.data import store_from_config as _sfc
     ds = load_dataset(dataset, cfg.data_dir, store=_sfc(cfg))
     record = {"checkpoint_step": step, "dataset": dataset}
@@ -754,6 +770,121 @@ def cmd_eval(args) -> int:
         "examples": res["examples"],
         "predictions": res["predictions"],
     })
+    print(json.dumps(record))
+    return 0
+
+
+def cmd_generate(args) -> int:
+    """Decode from a causal-LM checkpoint: KV-cache local decode by
+    default, O(T²) re-forward with --no-kv-cache, split-party remote
+    decode (client stages local, server compute behind /predict) with
+    --server-url."""
+    import jax
+
+    from split_learning_tpu.runtime.generate import (
+        generate_remote, greedy_generate, sample_generate)
+
+    cfg = _config_from_args(args)
+
+    # cheap flag validation before the (expensive) checkpoint restore;
+    # every rejection is an [error] + rc 2, like the rest of the CLI.
+    # No falsy-zero coercion: --temperature 0 / --top-p 0 are errors
+    # with the library's own explanations, never a silent rewrite.
+    sampled = (args.temperature is not None or args.top_p is not None
+               or args.top_k > 0)
+    temperature = 1.0 if args.temperature is None else args.temperature
+    top_p = 1.0 if args.top_p is None else args.top_p
+    if sampled and not temperature > 0.0:
+        print(f"[error] --temperature must be > 0 (got {temperature}); "
+              "omit all sampling flags for deterministic greedy decode",
+              file=sys.stderr)
+        return 2
+    if sampled and not 0.0 < top_p <= 1.0:
+        print(f"[error] --top-p must be in (0, 1] (got {top_p})",
+              file=sys.stderr)
+        return 2
+    if args.top_k < 0:
+        print(f"[error] --top-k must be >= 0 (got {args.top_k})",
+              file=sys.stderr)
+        return 2
+    tokens = None
+    if args.prompt:
+        try:
+            tokens = [int(tok) for tok in args.prompt.split(",")]
+        except ValueError:
+            print(f"[error] --prompt must be comma-separated token ids "
+                  f"(got {args.prompt!r})", file=sys.stderr)
+            return 2
+        if any(tok < 0 for tok in tokens):
+            print(f"[error] --prompt token ids must be >= 0 "
+                  f"(got {args.prompt!r})", file=sys.stderr)
+            return 2
+
+    resolved, rc = _resolve_checkpoint(args, cfg, "generate",
+                                       require_model="transformer_lm")
+    if resolved is None:
+        return rc
+    meta, mode, model, dataset, plan, step, params = resolved
+
+    if tokens is not None:
+        prompt = np.asarray([tokens], np.int32)
+        # the embedding gather CLAMPS out-of-range ids (JAX semantics),
+        # which would silently decode from the wrong tokens — bound
+        # them against the checkpoint's own token-embed table, found by
+        # its flax param path (nn.Embed stores its [vocab, D] table
+        # under the unique leaf name "embedding"; the [max_len, D]
+        # positional table is a raw "pos" param and can't shadow it).
+        # No match = unknown layout, skip the check
+        vocab = None
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                params[0])[0]:
+            if any("embedding" in str(k) for k in path) \
+                    and getattr(leaf, "ndim", 0) == 2:
+                vocab = leaf.shape[0]
+                break
+        if vocab is not None:
+            bad = [tok for tok in tokens if tok >= vocab]
+            if bad:
+                print(f"[error] --prompt ids {bad} are outside the "
+                      f"checkpoint's vocabulary ({vocab})", file=sys.stderr)
+                return 2
+    else:
+        # no prompt: seed from the dataset's test split, like eval
+        from split_learning_tpu.data import load_dataset, store_from_config
+        ds = load_dataset(dataset, cfg.data_dir, store=store_from_config(cfg))
+        prompt = np.asarray(ds.test.x[:1, :args.prompt_len], np.int32)
+
+    record = {"checkpoint_step": step, "prompt_len": int(prompt.shape[1]),
+              "n_new": args.n_new,
+              "decode": "sampled" if sampled else "greedy"}
+    if args.server_url:
+        from split_learning_tpu.transport.http import HttpTransport
+        transport = HttpTransport(args.server_url)
+        try:
+            transport.wait_ready(timeout=60.0)
+            client_params = [params[i] for i in plan.stages_of("client")]
+            kw = {}
+            if sampled:
+                kw = dict(rng=jax.random.PRNGKey(cfg.seed),
+                          temperature=temperature,
+                          top_k=args.top_k, top_p=top_p)
+            out = generate_remote(plan, client_params, transport, prompt,
+                                  args.n_new, **kw)
+        finally:
+            transport.close()
+        record["remote_server"] = args.server_url
+    elif sampled:
+        out = sample_generate(plan, params, prompt, args.n_new,
+                              jax.random.PRNGKey(cfg.seed),
+                              temperature=temperature,
+                              top_k=args.top_k, top_p=top_p,
+                              kv_cache=not args.no_kv_cache)
+    else:
+        out = greedy_generate(plan, params, prompt, args.n_new,
+                              kv_cache=not args.no_kv_cache)
+    out = np.asarray(out)
+    record["prompt"] = out[:, :prompt.shape[1]].tolist()
+    record["tokens"] = out[:, prompt.shape[1]:].tolist()
     print(json.dumps(record))
     return 0
 
@@ -859,6 +990,32 @@ def main(argv: Optional[list] = None) -> int:
                          "owned stages locally and the server-owned "
                          "compute behind this serving server's /predict")
     pe.set_defaults(fn=cmd_eval)
+
+    pg = sub.add_parser("generate",
+                        help="decode from a causal-LM checkpoint "
+                             "(KV-cache local, or split-party remote)")
+    _add_common(pg)
+    pg.add_argument("--step", type=int, default=None,
+                    help="checkpoint step (default: latest)")
+    pg.add_argument("--prompt", default=None,
+                    help="comma-separated token ids (default: first "
+                         "test-split example)")
+    pg.add_argument("--prompt-len", dest="prompt_len", type=int, default=16,
+                    help="tokens taken from the test split when no "
+                         "--prompt is given")
+    pg.add_argument("--n-new", dest="n_new", type=int, default=32,
+                    help="tokens to generate")
+    pg.add_argument("--temperature", type=float, default=None,
+                    help="sample at this temperature (omit = greedy)")
+    pg.add_argument("--top-k", dest="top_k", type=int, default=0)
+    pg.add_argument("--top-p", dest="top_p", type=float, default=None)
+    pg.add_argument("--no-kv-cache", dest="no_kv_cache",
+                    action="store_true",
+                    help="use the O(T^2) re-forward reference decode")
+    pg.add_argument("--server-url", dest="server_url", default=None,
+                    help="split-party decode: client stages local, "
+                         "server compute behind this server's /predict")
+    pg.set_defaults(fn=cmd_generate)
 
     args = ap.parse_args(argv)
     return args.fn(args)
