@@ -100,17 +100,23 @@ def build_torch_split(params):
     return part_a, part_b
 
 
-def run_torch(x, y, steps_limit=None):
+def run_torch(x, y, steps_limit=None, opt_factory=None):
     """The reference's split training loop, in-process (the wire moves
-    no math: split fwd/bwd ≡ full fwd/bwd — SURVEY.md §3.1). Two SGD
-    optimizers at lr=0.01, one per party, like client_part.py:17 /
-    server_part.py:15."""
+    no math: split fwd/bwd ≡ full fwd/bwd — SURVEY.md §3.1). Default
+    optimizers: two SGDs at lr=0.01, one per party, like
+    client_part.py:17 / server_part.py:15. ``opt_factory(part_a,
+    part_b) -> [optimizers]`` swaps them (tests/test_torch_parity.py
+    uses one AdamW across both parties) while keeping the loop —
+    transpose, zero/backward/step, batch order — in this one place."""
     import torch
     from torch import nn
 
     part_a, part_b = build_torch_split(jax_init_params())
-    opt_a = torch.optim.SGD(part_a.parameters(), lr=LR)
-    opt_b = torch.optim.SGD(part_b.parameters(), lr=LR)
+    if opt_factory is None:
+        opts = [torch.optim.SGD(part_a.parameters(), lr=LR),
+                torch.optim.SGD(part_b.parameters(), lr=LR)]
+    else:
+        opts = opt_factory(part_a, part_b)
     criterion = nn.CrossEntropyLoss()
 
     losses = []
@@ -119,12 +125,12 @@ def run_torch(x, y, steps_limit=None):
         for xb, yb in epoch_batches(x, y, epoch):
             xt = torch.from_numpy(xb.transpose(0, 3, 1, 2).copy())
             yt = torch.from_numpy(yb)
-            opt_a.zero_grad()
-            opt_b.zero_grad()
+            for opt in opts:
+                opt.zero_grad()
             loss = criterion(part_b(part_a(xt)), yt)
             loss.backward()
-            opt_a.step()
-            opt_b.step()
+            for opt in opts:
+                opt.step()
             losses.append(float(loss.detach()))
             if steps_limit and len(losses) >= steps_limit:
                 done = True

@@ -126,3 +126,57 @@ def test_committed_artifact_full_workload():
     redo = compare(curves["jax_monolithic"], curves["torch_reference"])
     assert redo["mean_abs_diff"] == pytest.approx(
         summary["mean_abs_diff"], rel=1e-9)
+
+
+@pytest.mark.slow
+def test_adamw_curves_track_torch():
+    """Cross-framework optimizer parity for the round-4 factory: the
+    same init/data/batch order under make_tx(adamw + weight decay) must
+    track torch.optim.AdamW step for step — optax and torch share the
+    decoupled-decay formulation (update = m_hat/(sqrt(v_hat)+eps) +
+    wd*param, scaled by lr), so the curves may differ only by f32
+    cross-library conv drift, which adam's sqrt(v)-normalization
+    amplifies only mildly over a short run."""
+    import jax
+    import jax.numpy as jnp
+
+    from split_learning_tpu.core import cross_entropy
+    from split_learning_tpu.models import get_plan
+    from split_learning_tpu.runtime import apply_grads, make_state
+    from split_learning_tpu.runtime.state import make_tx
+    from split_learning_tpu.utils import Config
+
+    from make_torch_parity_artifact import epoch_batches
+
+    lr, wd, steps = 1e-3, 0.01, 10
+    x, y = _synthetic(steps * 64)
+
+    # torch side: one AdamW across both parties (== one optax tx over
+    # the param tuple), through the same run_torch loop the artifact
+    # generator uses
+    torch_losses = run_torch(
+        x, y, steps_limit=steps,
+        opt_factory=lambda a, b: [torch.optim.AdamW(
+            list(a.parameters()) + list(b.parameters()),
+            lr=lr, weight_decay=wd)])
+
+    plan = get_plan(mode="split")
+    params = plan.init(jax.random.PRNGKey(42), jnp.asarray(x[:64]))
+    tx = make_tx(Config(optimizer="adamw", lr=lr, weight_decay=wd))
+    state = make_state(tuple(params), tx)
+
+    @jax.jit
+    def step(state, xb, yb):
+        loss, grads = jax.value_and_grad(
+            lambda p: cross_entropy(plan.apply(p, xb), yb))(state.params)
+        return apply_grads(tx, state, grads), loss
+
+    jax_losses = []
+    for xb, yb in epoch_batches(x, y, 0):
+        state, loss = step(state, jnp.asarray(xb), jnp.asarray(yb))
+        jax_losses.append(float(loss))
+        if len(jax_losses) >= steps:
+            break
+
+    diffs = [abs(a - b) for a, b in zip(jax_losses, torch_losses)]
+    assert max(diffs) < 5e-4, (jax_losses, torch_losses)
