@@ -27,6 +27,9 @@ def runner(tmp_path, monkeypatch):
     monkeypatch.setattr(mod, "STATE", str(tmp_path / "state.json"))
     monkeypatch.setattr(mod, "OUT", str(tmp_path / "runs.jsonl"))
     monkeypatch.setattr(mod.time, "sleep", lambda s: None)
+    # the real assemblers touch artifacts/ — out of scope here (probe,
+    # which embeds the canary, is stubbed per test)
+    monkeypatch.setattr(mod, "run_assemblers", lambda: None)
     return mod
 
 
@@ -105,3 +108,53 @@ def test_invalid_and_oom_mark_done(runner, monkeypatch):
         "status": "invalid" if leg["id"] == "i" else "oom"})
     runner.main()
     assert sorted(runner.load_state()["done"]) == ["i", "o"]
+
+
+def test_canary_record_lands_per_window(runner, monkeypatch):
+    """Each live window opens with the probe's chip-sanity canary
+    record, the context needed to attribute anomalous legs (healthy
+    canary = the leg; sick canary = pooled-chip contention) — and a
+    canary that errors still leaves a record, since the sickest
+    windows are the ones that most need attributing."""
+    monkeypatch.setattr(runner, "LEGS", [
+        {"id": "a", "role": "fused", "env": {}, "quick": True,
+         "timeout": 9}])
+    monkeypatch.setattr(runner, "probe", lambda: {"tflops": 123.0})
+    monkeypatch.setattr(runner, "run_leg",
+                        lambda leg: {"leg": leg["id"], "status": "ok",
+                                     "result": {"valid": True}})
+    runner.main()
+    recs = read_out(runner)
+    kinds = [r["leg"] for r in recs]
+    assert "__canary__" in kinds
+    assert kinds.index("__canary__") < kinds.index("a")
+    canary = next(r for r in recs if r["leg"] == "__canary__")
+    assert canary["status"] == "ok"
+    assert canary["result"]["tflops"] == 123.0
+
+
+def test_canary_error_still_recorded_and_deadline_assembles(
+        runner, monkeypatch):
+    monkeypatch.setattr(runner, "LEGS", [
+        {"id": "a", "role": "fused", "env": {}, "quick": True,
+         "timeout": 9}])
+    monkeypatch.setattr(runner, "probe",
+                        lambda: {"canary_error": "no CANARY line"})
+    monkeypatch.setattr(runner, "run_leg",
+                        lambda leg: {"leg": leg["id"], "status": "ok",
+                                     "result": {"valid": True}})
+    runner.main()
+    canary = next(r for r in read_out(runner) if r["leg"] == "__canary__")
+    assert canary["status"] == "error"
+
+    # deadline exit also assembles (the likely exit on a flaky tunnel)
+    assembled = []
+    monkeypatch.setattr(runner, "run_assemblers",
+                        lambda: assembled.append(True))
+    monkeypatch.setattr(runner, "DEADLINE", 0.0)
+    monkeypatch.setattr(runner, "STATE", runner.STATE + ".2")
+    monkeypatch.setattr(runner, "LEGS", [
+        {"id": "b", "role": "fused", "env": {}, "quick": True,
+         "timeout": 9}])
+    runner.main()
+    assert assembled == [True]
