@@ -37,7 +37,7 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--mode", choices=["split", "federated", "u_split"],
                    default=None)
     p.add_argument("--model", default=None,
-                   help="split_cnn | resnet18 | resnet18_4stage | "
+                   help="split_cnn | resnet18 | resnet18_4stage | vit | "
                         "transformer | transformer_lm")
     p.add_argument("--dataset", default=None,
                    help="mnist | cifar10 | synthetic | tokens | lm")
@@ -258,22 +258,36 @@ def cmd_train(args) -> int:
             from split_learning_tpu.runtime.fused import FusedSplitTrainer
             transformer_family = cfg.model in ("transformer",
                                                "transformer_lm")
-            if cfg.seq_parallel > 1 and not transformer_family:
+            # vit carries the same attention trunk: its sequence axis is
+            # the patch-token stream (models/vit.py)
+            attention_family = transformer_family or cfg.model == "vit"
+            if cfg.seq_parallel > 1 and not attention_family:
                 # without this guard the trainer would shard an image dim
                 # over 'seq' (or fail on divisibility) — not context
-                # parallelism; only the sequence family has a seq axis
+                # parallelism; only the attention families have a seq axis
                 print(f"[warn] --seq-parallel ignored: model {cfg.model!r} "
-                      "has no sequence axis (transformer family only)",
+                      "has no sequence axis (transformer/vit only)",
                       file=sys.stderr)
                 cfg = cfg.replace(seq_parallel=1)
+            if cfg.seq_parallel > 1 and cfg.model == "vit":
+                # vit's token count is fixed by the image grid: the ring/
+                # Ulysses shard_map needs it divisible by the seq axis
+                h, w, _ = sample.shape[1:]
+                t_tokens = (h // 4) * (w // 4)   # vit_plan default patch=4
+                if t_tokens % cfg.seq_parallel:
+                    print(f"[warn] --seq-parallel {cfg.seq_parallel} "
+                          f"ignored: {t_tokens} patch tokens "
+                          f"({h}x{w}, patch 4) do not divide across it",
+                          file=sys.stderr)
+                    cfg = cfg.replace(seq_parallel=1)
             mesh = None
             if (cfg.num_clients > 1 or cfg.model_parallel > 1
                     or cfg.seq_parallel > 1 or multi_host):
                 mesh = global_mesh(num_clients=cfg.num_clients, num_stages=1,
                                    model_parallel=cfg.model_parallel,
                                    seq_parallel=cfg.seq_parallel)
-            if transformer_family and cfg.attn in ("ring", "ring_flash",
-                                                  "ulysses") and (
+            if attention_family and cfg.attn in ("ring", "ring_flash",
+                                                 "ulysses") and (
                     mesh is None or "seq" not in mesh.axis_names
                     or mesh.shape["seq"] == 1):
                 # ring_attention falls back to single-device math when
@@ -286,20 +300,26 @@ def cmd_train(args) -> int:
                 print(f"[warn] --attn {cfg.attn!r} runs as {fallback}: "
                       "no 'seq' mesh axis (pass --seq-parallel > 1 to "
                       "shard the sequence)", file=sys.stderr)
-            if transformer_family and (cfg.seq_parallel > 1
-                                       or cfg.attn != "full"):
+            if attention_family and (cfg.seq_parallel > 1
+                                     or cfg.attn != "full"):
                 # the seq-parallel attention forms need the mesh at plan
                 # build time (the shard_map closes over it)
-                from split_learning_tpu.models.transformer import (
-                    transformer_plan)
-                plan = transformer_plan(mode=cfg.mode,
-                                        dtype=np.dtype(cfg.dtype),
-                                        mesh=mesh, attn=cfg.attn,
-                                        lm=cfg.model == "transformer_lm")
+                if cfg.model == "vit":
+                    from split_learning_tpu.models.vit import vit_plan
+                    plan = vit_plan(mode=cfg.mode,
+                                    dtype=np.dtype(cfg.dtype),
+                                    mesh=mesh, attn=cfg.attn)
+                else:
+                    from split_learning_tpu.models.transformer import (
+                        transformer_plan)
+                    plan = transformer_plan(mode=cfg.mode,
+                                            dtype=np.dtype(cfg.dtype),
+                                            mesh=mesh, attn=cfg.attn,
+                                            lm=cfg.model == "transformer_lm")
             elif cfg.attn != "full":
                 print(f"[warn] --attn {cfg.attn!r} ignored: model "
-                      f"{cfg.model!r} has no attention (transformer "
-                      "family only)", file=sys.stderr)
+                      f"{cfg.model!r} has no attention (transformer/vit "
+                      "only)", file=sys.stderr)
             trainer = FusedSplitTrainer(plan, cfg, rng, sample, mesh=mesh)
         else:
             from split_learning_tpu.parallel.pipeline import PipelinedTrainer

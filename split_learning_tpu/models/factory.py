@@ -56,6 +56,15 @@ def _resnet18_4stage(mode: str, dtype: Any) -> SplitPlan:
     return resnet18_plan(mode=mode, dtype=dtype, stages=4)
 
 
+@register_model("vit")
+def _vit(mode: str, dtype: Any) -> SplitPlan:
+    """Vision transformer on the image datasets: patchify stem +
+    the shared transformer trunk/head (models/vit.py); build
+    seq-parallel variants via models.vit.vit_plan(mesh=..., attn=...)."""
+    from split_learning_tpu.models.vit import vit_plan
+    return vit_plan(mode=mode, dtype=dtype)
+
+
 @register_model("transformer")
 def _transformer(mode: str, dtype: Any) -> SplitPlan:
     """Long-context family (beyond reference scope): dense attention by

@@ -1,0 +1,118 @@
+"""Split Vision Transformer — the attention stack on the image datasets.
+
+Fourth model family (beyond the reference's CNN scope,
+``/root/reference/src/model_def.py:5-46``): the transformer trunk
+(models/transformer.py Block — dense, flash, or sequence-parallel
+attention) applied to images through a patchify stem, under the same
+split-learning capability surface as every other family — a cut layer,
+two/three-party ownership, every transport/trainer/checkpoint path
+unchanged.
+
+Stage layout mirrors the CNN and transformer families:
+
+- split:   client(patch-embed + N_c blocks) -> server(N_s blocks + head)
+- u_split: client(patch-embed + N_c blocks) -> server(N_s blocks)
+           -> client(LN + mean-pool + Dense head) — labels and logits
+           never leave the client
+- federated: the composition of the split plan (same params by
+  construction, core/stage.py).
+
+The cut tensor is the patch-token stream ``[B, T, d_model]`` with
+``T = (H/p)·(W/p)`` — MNIST 28x28 at patch 4 gives T=49, CIFAR-10
+32x32 gives T=64. Mean-pool classification (no CLS token) keeps the
+head identical to the text classifier's (``HeadStage``), so the server
+stages are *shared code*, not parallel implementations.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from split_learning_tpu.core.stage import SplitPlan, from_flax
+from split_learning_tpu.models.transformer import (
+    _ATTN_IMPLS, Block, HeadStage, TrunkAndHead, TrunkStage)
+
+
+class PatchEmbedStage(nn.Module):
+    """Client bottom stage: ``[B, H, W, C] -> [B, T, d_model]``.
+
+    Non-overlapping ``patch x patch`` convolution (the standard ViT
+    stem — one matmul per patch on the MXU), learned positional
+    embeddings over the ``max_tokens`` grid, then ``depth`` transformer
+    blocks. H and W must divide by ``patch`` (28 and 32 both divide 4).
+    """
+
+    d_model: int
+    num_heads: int
+    depth: int
+    patch: int = 4
+    max_tokens: int = 256
+    mesh: Any = None
+    attn: str = "full"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, h, w, _ = x.shape
+        if h % self.patch or w % self.patch:
+            raise ValueError(
+                f"image {h}x{w} does not tile into {self.patch}x"
+                f"{self.patch} patches")
+        x = nn.Conv(self.d_model, kernel_size=(self.patch, self.patch),
+                    strides=(self.patch, self.patch), padding="VALID",
+                    dtype=self.dtype, name="patch")(x.astype(self.dtype))
+        t = (h // self.patch) * (w // self.patch)
+        if t > self.max_tokens:
+            raise ValueError(f"{t} patch tokens > max_tokens "
+                             f"{self.max_tokens}")
+        x = x.reshape(b, t, self.d_model)
+        pos = self.param("pos", nn.initializers.normal(0.02),
+                         (self.max_tokens, self.d_model), self.dtype)
+        x = x + pos[None, :t]
+        for i in range(self.depth):
+            x = Block(self.num_heads, mesh=self.mesh, attn=self.attn,
+                      causal=False, dtype=self.dtype, name=f"block{i}")(x)
+        return x
+
+
+def vit_plan(mode: str = "split", dtype: Any = jnp.float32, *,
+             d_model: int = 64, num_heads: int = 4, patch: int = 4,
+             client_depth: int = 1, server_depth: int = 2,
+             num_classes: int = 10, max_tokens: int = 256,
+             mesh: Optional[Any] = None, attn: str = "full") -> SplitPlan:
+    """Build the split-ViT :class:`SplitPlan` for ``mode``.
+
+    ``mesh``/``attn`` select the attention math exactly as in
+    :func:`...transformer.transformer_plan` — the patch-token count
+    must divide the mesh's ``seq`` axis for the parallel forms.
+    """
+    if attn not in _ATTN_IMPLS:
+        raise ValueError(
+            f"Unknown attn impl: {attn!r} (expected {_ATTN_IMPLS})")
+    common = dict(mesh=mesh, attn=attn, dtype=dtype)
+    embed = from_flax("patch_embed", PatchEmbedStage(
+        d_model=d_model, num_heads=num_heads, depth=client_depth,
+        patch=patch, max_tokens=max_tokens, **common))
+    if mode == "u_split":
+        return SplitPlan(
+            stages=(
+                embed,
+                from_flax("trunk", TrunkStage(
+                    num_heads=num_heads, depth=server_depth,
+                    causal=False, **common)),
+                from_flax("head", HeadStage(num_classes, dtype=dtype)),
+            ),
+            owners=("client", "server", "client"),
+        )
+    return SplitPlan(
+        stages=(
+            embed,
+            from_flax("trunk_head", TrunkAndHead(
+                num_heads=num_heads, depth=server_depth,
+                num_classes=num_classes, causal=False, **common)),
+        ),
+        owners=("client", "server"),
+    )

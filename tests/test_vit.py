@@ -1,0 +1,97 @@
+"""Split ViT (models/vit.py): the attention trunk on image datasets,
+under the same plan machinery as every other family."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from split_learning_tpu.models import get_plan
+from split_learning_tpu.models.vit import vit_plan
+
+
+def images(b=8, hw=28, c=1, seed=0):
+    rs = np.random.RandomState(seed)
+    return rs.randn(b, hw, hw, c).astype(np.float32)
+
+
+def test_forward_shapes_and_cut_tensor():
+    plan = get_plan(model="vit", mode="split")
+    x = jnp.asarray(images())
+    params = plan.init(jax.random.PRNGKey(0), x)
+    # cut tensor: the patch-token stream [B, T=49, d_model] for MNIST
+    # 28x28 at patch 4
+    cut = plan.stages[0].apply(params[0], x)
+    assert cut.shape == (8, 49, 64)
+    logits = plan.apply(params, x)
+    assert logits.shape == (8, 10)
+    # CIFAR-shaped input tiles to T=64 through the same params? No —
+    # pos table slices per T, but conv/blocks are shape-polymorphic:
+    # a fresh init at 32x32x3 must produce [B, 64, d_model]
+    x32 = jnp.asarray(images(hw=32, c=3))
+    p32 = plan.init(jax.random.PRNGKey(0), x32)
+    assert plan.stages[0].apply(p32[0], x32).shape == (8, 64, 64)
+
+
+def test_non_tiling_image_rejected():
+    plan = vit_plan(patch=4)
+    with pytest.raises(ValueError, match="patches"):
+        plan.init(jax.random.PRNGKey(0), jnp.zeros((2, 30, 30, 1)))
+
+
+def test_u_split_owners_and_composition():
+    plan = get_plan(model="vit", mode="u_split")
+    assert plan.owners == ("client", "server", "client")
+    x = jnp.asarray(images(b=4))
+    params = plan.init(jax.random.PRNGKey(1), x)
+    # composition == stage-by-stage threading (the invariant every
+    # trainer relies on)
+    h = x
+    for stage, p in zip(plan.stages, params):
+        h = stage.apply(p, h)
+    np.testing.assert_array_equal(np.asarray(h),
+                                  np.asarray(plan.apply(params, x)))
+
+
+def test_fused_training_learns():
+    from split_learning_tpu.runtime.fused import FusedSplitTrainer
+    from split_learning_tpu.utils import Config
+
+    rs = np.random.RandomState(2)
+    xb = rs.randn(16, 28, 28, 1).astype(np.float32)
+    yb = rs.randint(0, 10, (16,)).astype(np.int64)
+    cfg = Config(model="vit", batch_size=16, lr=0.05)
+    tr = FusedSplitTrainer(get_plan(model="vit", mode="split"), cfg,
+                           jax.random.PRNGKey(0), xb)
+    losses = [tr.train_step(xb, yb) for _ in range(8)]
+    assert np.mean(losses[-2:]) < losses[0]
+
+
+@pytest.mark.slow
+def test_cli_trains_vit_on_synthetic(tmp_path, capsys):
+    from split_learning_tpu.launch.run import main
+
+    rc = main(["train", "--model", "vit", "--dataset", "synthetic",
+               "--transport", "fused", "--steps", "4", "--batch-size", "8",
+               "--tracking", "noop", "--data-dir", str(tmp_path)])
+    assert rc == 0
+    assert "[done]" in capsys.readouterr().out
+
+
+@pytest.mark.slow
+def test_seq_parallel_vit_matches_dense(devices):
+    """Patch tokens context-shard like text tokens: ring attention over
+    a (data, seq) mesh reproduces the dense forward. T=64 (32x32)
+    divides seq=4."""
+    from jax.sharding import Mesh
+
+    grid = np.asarray(devices[:8]).reshape(2, 4)
+    mesh = Mesh(grid, ("data", "seq"))
+    x = jnp.asarray(images(b=4, hw=32, c=3))
+    dense = vit_plan()
+    ring = vit_plan(mesh=mesh, attn="ring")
+    params = dense.init(jax.random.PRNGKey(3), x)
+    want = dense.apply(params, x)
+    got = jax.jit(lambda p, a: ring.apply(p, a))(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
