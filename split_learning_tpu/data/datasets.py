@@ -459,7 +459,8 @@ def store_from_config(cfg) -> Optional[DatasetStore]:
 def load_dataset(name: str, data_dir: str,
                  store: Optional[DatasetStore] = None,
                  allow_synthetic: bool = True,
-                 download: bool = False) -> Dataset:
+                 download: bool = False,
+                 seq_len: Optional[int] = None) -> Dataset:
     """Cache-first dataset load, mirroring src/client_part.py:36-98:
     probe the store; on hit, fetch the prepared blob; on miss, build from
     raw files (or synthesize) and upload the blob for next time. With
@@ -471,10 +472,19 @@ def load_dataset(name: str, data_dir: str,
     cached in a data-less environment never shadows real files that appear
     later, and ``allow_synthetic=False`` can never be satisfied by a
     synthetic cache entry."""
+    if seq_len is not None and name not in ("tokens", "lm"):
+        raise ValueError(
+            f"seq_len applies to the token datasets only (got {name!r})")
+    if seq_len is not None and seq_len <= 0:
+        raise ValueError(f"seq_len must be positive (got {seq_len})")
     if store is None:
         store = LocalStore(os.path.join(data_dir, "cache"))
-    real_key = f"datasets/{name}.npz"
-    synth_key = f"datasets/{name}-synthetic.npz"
+    # a non-default sequence length is a different dataset: its own
+    # cache keys (real AND synthetic), so a default-T blob in a shared
+    # store never silently shadows a sized request
+    tkey = "" if seq_len is None else f"-t{seq_len}"
+    real_key = f"datasets/{name}{tkey}.npz"
+    synth_key = f"datasets/{name}-synthetic{tkey}.npz"
 
     if store.exists(real_key):
         return _from_blob(name, store.fetch(real_key))
@@ -502,10 +512,11 @@ def load_dataset(name: str, data_dir: str,
             "fallback disabled")
     if store.exists(synth_key):
         return _from_blob(name, store.fetch(synth_key))
+    tkw = {} if seq_len is None else {"seq_len": seq_len}
     if name == "tokens":
-        ds = synthetic_tokens()
+        ds = synthetic_tokens(**tkw)
     elif name == "lm":
-        ds = synthetic_lm()
+        ds = synthetic_lm(**tkw)
     else:
         ds = synthetic("mnist" if name == "synthetic" else name)
     store.put(synth_key, _to_blob(ds))

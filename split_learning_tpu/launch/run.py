@@ -77,6 +77,17 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="rematerialize stage forwards in the backward pass "
                         "(jax.checkpoint — trades FLOPs for HBM)")
     p.add_argument("--checkpoint-dir", dest="checkpoint_dir", default=None)
+    # size overrides for the transformer/vit families (the fixed
+    # reference CNN and ResNet reject them)
+    p.add_argument("--d-model", dest="d_model", type=int, default=None)
+    p.add_argument("--num-heads", dest="num_heads", type=int, default=None)
+    p.add_argument("--client-depth", dest="client_depth", type=int,
+                   default=None, help="blocks in the client stage")
+    p.add_argument("--server-depth", dest="server_depth", type=int,
+                   default=None, help="blocks in the server stage")
+    p.add_argument("--seq-len", dest="seq_len", type=int, default=None,
+                   help="sequence length of the synthetic token/lm "
+                        "datasets (default 64; cached per length)")
 
 
 def _config_from_args(args) -> "Config":
@@ -103,13 +114,76 @@ def _config_from_args(args) -> "Config":
 # records how the saved tree maps onto parties, so `eval` can reassemble
 # the full composition without reconstructing trainers.
 
-def _write_ckpt_meta(directory: str, layout: str, cfg) -> None:
+def _size_kw_from_args(args) -> Dict[str, Any]:
+    """Model-size overrides present on the command line (train + serve
+    share them through _add_common)."""
+    return {k: v for k, v in (
+        ("d_model", getattr(args, "d_model", None)),
+        ("num_heads", getattr(args, "num_heads", None)),
+        ("client_depth", getattr(args, "client_depth", None)),
+        ("server_depth", getattr(args, "server_depth", None)),
+    ) if v is not None}
+
+
+def _plan_size_kw(model: str, size_kw: Dict[str, Any],
+                  seq_len: Optional[int]) -> Dict[str, Any]:
+    """Plan-builder kwargs derived from the user-visible size overrides.
+    ``max_len`` (the positional-table extent a long ``--seq-len``
+    forces) is DERIVED here at every build site and never persisted —
+    storing it in checkpoint meta would make the saved ``size_kw``
+    compare unequal to the same command line's flags."""
+    kw = dict(size_kw)
+    if seq_len and seq_len > 2048 \
+            and model in ("transformer", "transformer_lm"):
+        kw["max_len"] = seq_len
+    return kw
+
+
+def _reconcile_ckpt_sizes(meta: Dict[str, Any], size_kw: Dict[str, Any],
+                          seq_len: Optional[int], what: str):
+    """Adopt-or-refuse against a checkpoint's recorded model sizes.
+    Returns ``(size_kw, seq_len, error)``: bare invocations adopt the
+    saved sizes/seq_len; conflicting explicit ones return an error
+    string BEFORE any meta rewrite or restore can run."""
+    saved = meta.get("size_kw", {})
+    if saved and not size_kw:
+        size_kw = dict(saved)
+        print(f"[ckpt] {what} with the checkpoint's model sizes "
+              f"{size_kw}", file=sys.stderr)
+    elif saved != size_kw:
+        return size_kw, seq_len, (
+            f"checkpoint was written with sizes {saved or '{}'} but "
+            f"{what} requested {size_kw or '{}'}")
+    saved_seq = meta.get("seq_len")
+    if saved_seq:
+        if seq_len is None:
+            seq_len = saved_seq
+            print(f"[ckpt] {what} with the checkpoint's --seq-len "
+                  f"{seq_len}", file=sys.stderr)
+        elif seq_len != saved_seq:
+            return size_kw, seq_len, (
+                f"checkpoint was trained at --seq-len {saved_seq} but "
+                f"{what} requested {seq_len}")
+    return size_kw, seq_len, None
+
+
+def _write_ckpt_meta(directory: str, layout: str, cfg,
+                     size_kw: Optional[Dict[str, Any]] = None,
+                     seq_len: Optional[int] = None) -> None:
     path = os.path.join(os.path.abspath(os.path.expanduser(directory)),
                         "meta.json")
     os.makedirs(os.path.dirname(path), exist_ok=True)
+    meta = {"layout": layout, "mode": cfg.mode, "model": cfg.model,
+            "dataset": cfg.dataset}
+    if size_kw:
+        # non-default model sizes are part of the checkpoint's identity:
+        # eval/generate must rebuild the same plan or restore fails on
+        # param shapes
+        meta["size_kw"] = size_kw
+    if seq_len is not None:
+        meta["seq_len"] = seq_len
     with open(path, "w") as f:
-        json.dump({"layout": layout, "mode": cfg.mode, "model": cfg.model,
-                   "dataset": cfg.dataset}, f)
+        json.dump(meta, f)
 
 
 def _read_ckpt_meta(directory: str) -> Dict[str, Any]:
@@ -177,11 +251,41 @@ def cmd_train(args) -> int:
               f"{cfg.model!r} consumes images (mnist | cifar10 | "
               "synthetic)", file=sys.stderr)
         return 2
-    plan = get_plan(model=cfg.model, mode=cfg.mode, dtype=cfg.dtype)
+    size_kw = _size_kw_from_args(args)
+    seq_len = args.seq_len
+    if seq_len is not None and seq_len <= 0:
+        print(f"[error] --seq-len must be positive (got {seq_len})",
+              file=sys.stderr)
+        return 2
+    if seq_len is not None and cfg.dataset not in ("tokens", "lm"):
+        print(f"[error] --seq-len applies to the token datasets "
+              f"(got --dataset {cfg.dataset!r})", file=sys.stderr)
+        return 2
+    if cfg.checkpoint_dir and getattr(args, "resume", False):
+        # a sized checkpoint's identity lives in its meta: resuming
+        # without the flags adopts the saved sizes; resuming WITH
+        # different ones is refused before meta gets clobbered
+        try:
+            existing_meta = _read_ckpt_meta(cfg.checkpoint_dir)
+        except (FileNotFoundError, json.JSONDecodeError, OSError):
+            existing_meta = None
+        if existing_meta is not None:
+            size_kw, seq_len, err = _reconcile_ckpt_sizes(
+                existing_meta, size_kw, seq_len, "--resume")
+            if err:
+                print(f"[error] {err}", file=sys.stderr)
+                return 2
+    try:
+        plan = get_plan(model=cfg.model, mode=cfg.mode, dtype=cfg.dtype,
+                        **_plan_size_kw(cfg.model, size_kw, seq_len))
+    except (ValueError, TypeError) as e:
+        print(f"[error] {e}", file=sys.stderr)
+        return 2
     ds = load_dataset(cfg.dataset, cfg.data_dir,
                       store=store_from_config(cfg),
                       allow_synthetic=not args.require_real,
-                      download=getattr(args, "download", False))
+                      download=getattr(args, "download", False),
+                      seq_len=seq_len)
     if ds.synthetic:
         print(f"[data] using synthetic {ds.name} "
               f"({len(ds.train)} train examples)", file=sys.stderr)
@@ -308,14 +412,15 @@ def cmd_train(args) -> int:
                     from split_learning_tpu.models.vit import vit_plan
                     plan = vit_plan(mode=cfg.mode,
                                     dtype=np.dtype(cfg.dtype),
-                                    mesh=mesh, attn=cfg.attn)
+                                    mesh=mesh, attn=cfg.attn, **size_kw)
                 else:
                     from split_learning_tpu.models.transformer import (
                         transformer_plan)
                     plan = transformer_plan(mode=cfg.mode,
                                             dtype=np.dtype(cfg.dtype),
                                             mesh=mesh, attn=cfg.attn,
-                                            lm=cfg.model == "transformer_lm")
+                                            lm=cfg.model == "transformer_lm",
+                                            **size_kw)
             elif cfg.attn != "full":
                 print(f"[warn] --attn {cfg.attn!r} ignored: model "
                       f"{cfg.model!r} has no attention (transformer/vit "
@@ -329,7 +434,8 @@ def cmd_train(args) -> int:
 
         start_step = 0
         if ckptr is not None:
-            _write_ckpt_meta(cfg.checkpoint_dir, "fused", cfg)
+            _write_ckpt_meta(cfg.checkpoint_dir, "fused", cfg, size_kw,
+                             seq_len)
             latest = ckptr.latest_step()
             if args.resume and latest is not None:
                 tree = ckptr.restore({"trainer": trainer.state})
@@ -509,7 +615,8 @@ def cmd_train(args) -> int:
 
         start_step = 0
         if ckptr is not None:
-            _write_ckpt_meta(cfg.checkpoint_dir, layout, cfg)
+            _write_ckpt_meta(cfg.checkpoint_dir, layout, cfg, size_kw,
+                             seq_len)
             latest = ckptr.latest_step()
             if args.resume and latest is not None:
                 tree = ckptr.restore(party_tree())
@@ -626,7 +733,25 @@ def cmd_serve(args) -> int:
     from split_learning_tpu.data.datasets import _SHAPES
 
     cfg = _config_from_args(args)
-    plan = get_plan(model=cfg.model, mode=cfg.mode, dtype=cfg.dtype)
+    size_kw = _size_kw_from_args(args)
+    seq_len = getattr(args, "seq_len", None)
+    if cfg.checkpoint_dir:
+        try:
+            prior = _read_ckpt_meta(cfg.checkpoint_dir)
+        except (FileNotFoundError, json.JSONDecodeError, OSError):
+            prior = None
+        if prior is not None:
+            size_kw, seq_len, err = _reconcile_ckpt_sizes(
+                prior, size_kw, seq_len, "serve")
+            if err:
+                print(f"[error] {err}", file=sys.stderr)
+                return 2
+    try:
+        plan = get_plan(model=cfg.model, mode=cfg.mode, dtype=cfg.dtype,
+                        **_plan_size_kw(cfg.model, size_kw, seq_len))
+    except (ValueError, TypeError) as e:
+        print(f"[error] {e}", file=sys.stderr)
+        return 2
     shape = _SHAPES.get("mnist" if cfg.dataset == "synthetic" else cfg.dataset,
                         (28, 28, 1))
     sample = np.zeros((cfg.batch_size,) + shape, np.float32)
@@ -666,12 +791,13 @@ def cmd_serve(args) -> int:
         if joint:
             save_dir = os.path.join(cfg.checkpoint_dir, "server_party")
             ckptr = Checkpointer(save_dir)
-            _write_ckpt_meta(save_dir, "server_only", cfg)
+            _write_ckpt_meta(save_dir, "server_only", cfg, size_kw)
             print(f"[ckpt] joint-layout dir: periodic server saves go to "
                   f"{save_dir}", file=sys.stderr)
         else:
             ckptr = Checkpointer(cfg.checkpoint_dir)
-            _write_ckpt_meta(cfg.checkpoint_dir, "server_only", cfg)
+            _write_ckpt_meta(cfg.checkpoint_dir, "server_only", cfg,
+                             size_kw)
         latest = ckptr.latest_step()
         if args.resume and joint:
             # a prior serve on this joint dir may have saved newer
@@ -746,7 +872,15 @@ def _resolve_checkpoint(args, cfg, cmd: str, require_model: str = None):
         print(f"[error] {cmd} needs a {require_model!r} checkpoint "
               f"(got {model!r})", file=sys.stderr)
         return None, 2
-    plan = get_plan(model=model, mode=mode, dtype=cfg.dtype)
+    # the checkpoint's recorded sizes are authoritative — explicit size
+    # flags must match or be absent, never silently overridden
+    size_kw, _, err = _reconcile_ckpt_sizes(
+        meta, _size_kw_from_args(args), None, cmd)
+    if err:
+        print(f"[error] {err}", file=sys.stderr)
+        return None, 2
+    plan = get_plan(model=model, mode=mode, dtype=cfg.dtype,
+                    **_plan_size_kw(model, size_kw, meta.get("seq_len")))
     ckptr = Checkpointer(ckdir)
     step = args.step if args.step is not None else ckptr.latest_step()
     params = _assemble_full_params(meta["layout"], ckptr.restore_raw(step))
@@ -763,7 +897,12 @@ def cmd_eval(args) -> int:
         return rc
     meta, mode, model, dataset, plan, step, params = resolved
     from split_learning_tpu.data import store_from_config as _sfc
-    ds = load_dataset(dataset, cfg.data_dir, store=_sfc(cfg))
+    # a sized-context checkpoint must be scored at its own T: explicit
+    # --seq-len wins, then the checkpoint's recorded one
+    seq_len = getattr(args, "seq_len", None) or meta.get("seq_len")
+    ds = load_dataset(dataset, cfg.data_dir, store=_sfc(cfg),
+                      seq_len=seq_len if dataset in ("tokens", "lm")
+                      else None)
     record = {"checkpoint_step": step, "dataset": dataset}
     if getattr(args, "server_url", None):
         # split-party inference: client stages local, server compute
@@ -871,7 +1010,11 @@ def cmd_generate(args) -> int:
     else:
         # no prompt: seed from the dataset's test split, like eval
         from split_learning_tpu.data import load_dataset, store_from_config
-        ds = load_dataset(dataset, cfg.data_dir, store=store_from_config(cfg))
+        seq_len = getattr(args, "seq_len", None) or meta.get("seq_len")
+        ds = load_dataset(dataset, cfg.data_dir,
+                          store=store_from_config(cfg),
+                          seq_len=seq_len if dataset in ("tokens", "lm")
+                          else None)
         prompt = np.asarray(ds.test.x[:1, :args.prompt_len], np.int32)
 
     record = {"checkpoint_step": step, "prompt_len": int(prompt.shape[1]),

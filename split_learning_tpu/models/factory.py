@@ -33,7 +33,11 @@ def _dtype_of(dtype: Any) -> Any:
 
 
 @register_model("split_cnn")
-def _split_cnn(mode: str, dtype: Any) -> SplitPlan:
+def _split_cnn(mode: str, dtype: Any, **kw: Any) -> SplitPlan:
+    if kw:
+        raise ValueError(f"split_cnn is the fixed reference architecture "
+                         f"(src/model_def.py:5-28); it takes no size "
+                         f"overrides (got {sorted(kw)})")
     if mode == "u_split":
         return u_split_cnn_plan(dtype=dtype)
     # both "split" and "federated" use the same 2-stage plan: federated mode
@@ -42,14 +46,20 @@ def _split_cnn(mode: str, dtype: Any) -> SplitPlan:
 
 
 @register_model("resnet18")
-def _resnet18(mode: str, dtype: Any) -> SplitPlan:
+def _resnet18(mode: str, dtype: Any, **kw: Any) -> SplitPlan:
+    if kw:
+        raise ValueError(f"resnet18 takes no size overrides "
+                         f"(got {sorted(kw)})")
     from split_learning_tpu.models.resnet import resnet18_plan
     return resnet18_plan(mode=mode, dtype=dtype)
 
 
 @register_model("resnet18_4stage")
-def _resnet18_4stage(mode: str, dtype: Any) -> SplitPlan:
+def _resnet18_4stage(mode: str, dtype: Any, **kw: Any) -> SplitPlan:
     """The BASELINE.md config-4 shape: 4 pipeline stages."""
+    if kw:
+        raise ValueError(f"resnet18_4stage takes no size overrides "
+                         f"(got {sorted(kw)})")
     from split_learning_tpu.models.resnet import resnet18_plan
     if mode != "split":
         raise ValueError("resnet18_4stage is a pipeline plan; use mode='split'")
@@ -57,41 +67,46 @@ def _resnet18_4stage(mode: str, dtype: Any) -> SplitPlan:
 
 
 @register_model("vit")
-def _vit(mode: str, dtype: Any) -> SplitPlan:
+def _vit(mode: str, dtype: Any, **kw: Any) -> SplitPlan:
     """Vision transformer on the image datasets: patchify stem +
     the shared transformer trunk/head (models/vit.py); build
     seq-parallel variants via models.vit.vit_plan(mesh=..., attn=...)."""
     from split_learning_tpu.models.vit import vit_plan
-    return vit_plan(mode=mode, dtype=dtype)
+    return vit_plan(mode=mode, dtype=dtype, **kw)
 
 
 @register_model("transformer")
-def _transformer(mode: str, dtype: Any) -> SplitPlan:
+def _transformer(mode: str, dtype: Any, **kw: Any) -> SplitPlan:
     """Long-context family (beyond reference scope): dense attention by
     default; build seq-parallel variants via
     models.transformer.transformer_plan(mesh=..., attn="ring")."""
     from split_learning_tpu.models.transformer import transformer_plan
-    return transformer_plan(mode=mode, dtype=dtype)
+    return transformer_plan(mode=mode, dtype=dtype, **kw)
 
 
 @register_model("transformer_lm")
-def _transformer_lm(mode: str, dtype: Any) -> SplitPlan:
+def _transformer_lm(mode: str, dtype: Any, **kw: Any) -> SplitPlan:
     """Causal language model: causal attention + per-token next-token
     head (train with --dataset lm, labels = inputs shifted by one)."""
     from split_learning_tpu.models.transformer import transformer_plan
-    return transformer_plan(mode=mode, dtype=dtype, lm=True)
+    return transformer_plan(mode=mode, dtype=dtype, lm=True, **kw)
 
 
 def get_plan(model: str = "split_cnn", mode: str = "split",
-             dtype: Any = jnp.float32) -> SplitPlan:
-    """Build the SplitPlan for a model family under a learning mode."""
+             dtype: Any = jnp.float32, **size_kw: Any) -> SplitPlan:
+    """Build the SplitPlan for a model family under a learning mode.
+
+    ``size_kw`` (d_model, num_heads, client_depth, server_depth, ...)
+    forwards to the family's plan builder; families without size
+    parameters (the fixed reference CNN, ResNet-18) reject them with a
+    ValueError rather than silently ignoring a requested size."""
     if mode not in ("split", "federated", "u_split"):
         # preserve the reference's ValueError contract (src/model_def.py:70-71)
         raise ValueError(f"Unknown learning mode: {mode!r}")
     if model not in _FAMILIES:
         raise ValueError(
             f"Unknown model family: {model!r} (have {sorted(_FAMILIES)})")
-    return _FAMILIES[model](mode, _dtype_of(dtype))
+    return _FAMILIES[model](mode, _dtype_of(dtype), **size_kw)
 
 
 def get_model(role: str, mode: str = "split", model: str = "split_cnn",

@@ -1,6 +1,8 @@
 """CLI end-to-end for the long-context family: transformer + tokens
 dataset, dense and context-parallel attention."""
 
+import os
+
 import pytest
 
 from split_learning_tpu.launch.run import main
@@ -78,3 +80,99 @@ def test_train_cli_rejects_model_dataset_mismatch(tmp_path, capsys):
                "--tracking", "noop"])
     assert rc == 2
     assert "token-shaped" in capsys.readouterr().err
+
+
+def test_size_overrides_reject_fixed_families(tmp_path, capsys):
+    rc = main(["train", "--model", "split_cnn", "--dataset", "synthetic",
+               "--d-model", "32", "--steps", "2",
+               "--data-dir", str(tmp_path), "--tracking", "noop"])
+    assert rc == 2
+    assert "no size overrides" in capsys.readouterr().err
+    rc = main(["train", "--model", "split_cnn", "--dataset", "synthetic",
+               "--seq-len", "128", "--steps", "2",
+               "--data-dir", str(tmp_path), "--tracking", "noop"])
+    assert rc == 2
+    assert "--seq-len" in capsys.readouterr().err
+
+
+@pytest.mark.slow
+def test_sized_lm_checkpoint_roundtrip(tmp_path, capsys):
+    """--d-model/--num-heads/--server-depth/--seq-len flow into the plan
+    AND the checkpoint meta, so eval/generate rebuild the same shapes."""
+    ck = str(tmp_path / "ck")
+    rc = main(["train", "--model", "transformer_lm", "--dataset", "lm",
+               "--transport", "fused", "--d-model", "32",
+               "--num-heads", "2", "--server-depth", "1",
+               "--seq-len", "16", "--steps", "4", "--batch-size", "8",
+               "--tracking", "noop", "--checkpoint-dir", ck,
+               "--data-dir", str(tmp_path)])
+    assert rc == 0
+    import json as _json
+    meta = _json.load(open(os.path.join(ck, "meta.json")))
+    assert meta["size_kw"] == {"d_model": 32, "num_heads": 2,
+                               "server_depth": 1}
+    capsys.readouterr()
+    rc = main(["generate", "--checkpoint-dir", ck, "--prompt", "1,2",
+               "--n-new", "3", "--data-dir", str(tmp_path)])
+    assert rc == 0
+    out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert len(out["tokens"][0]) == 3
+
+
+@pytest.mark.slow
+def test_sized_resume_adopts_and_guards(tmp_path, capsys):
+    """--resume without size flags adopts the checkpoint's sizes;
+    --resume with DIFFERENT sizes is refused before meta is clobbered."""
+    ck = str(tmp_path / "ck")
+    base = ["train", "--model", "transformer_lm", "--dataset", "lm",
+            "--transport", "fused", "--batch-size", "8",
+            "--tracking", "noop", "--checkpoint-dir", ck,
+            "--data-dir", str(tmp_path)]
+    rc = main(base + ["--d-model", "32", "--num-heads", "2",
+                      "--seq-len", "16", "--steps", "3"])
+    assert rc == 0
+    capsys.readouterr()
+    # resume bare: adopts d_model=32/heads=2/seq_len=16 from meta
+    rc = main(base + ["--steps", "2", "--resume"])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "with the checkpoint's model sizes" in err
+    import json as _json
+    meta = _json.load(open(os.path.join(ck, "meta.json")))
+    assert meta["size_kw"]["d_model"] == 32   # not clobbered
+    assert meta["seq_len"] == 16
+    # resume with conflicting sizes: refused
+    rc = main(base + ["--steps", "2", "--resume", "--d-model", "64"])
+    assert rc == 2
+    assert "written with sizes" in capsys.readouterr().err
+
+
+@pytest.mark.slow
+def test_resume_seq_len_conflict_refused(tmp_path, capsys):
+    ck = str(tmp_path / "ck")
+    base = ["train", "--model", "transformer_lm", "--dataset", "lm",
+            "--transport", "fused", "--batch-size", "8",
+            "--tracking", "noop", "--checkpoint-dir", ck,
+            "--data-dir", str(tmp_path)]
+    assert main(base + ["--seq-len", "16", "--steps", "2"]) == 0
+    capsys.readouterr()
+    rc = main(base + ["--seq-len", "32", "--steps", "2", "--resume"])
+    assert rc == 2
+    assert "trained at --seq-len 16" in capsys.readouterr().err
+    import json as _json
+    meta = _json.load(open(os.path.join(ck, "meta.json")))
+    assert meta["seq_len"] == 16   # refused BEFORE meta was clobbered
+
+
+def test_eval_size_flag_conflict_refused(tmp_path, capsys):
+    import json as _json
+    ck = tmp_path / "ck"
+    os.makedirs(ck)
+    with open(ck / "meta.json", "w") as f:
+        _json.dump({"layout": "fused", "mode": "split",
+                    "model": "transformer_lm", "dataset": "lm",
+                    "size_kw": {"d_model": 32}}, f)
+    rc = main(["eval", "--checkpoint-dir", str(ck), "--d-model", "64",
+               "--data-dir", str(tmp_path)])
+    assert rc == 2
+    assert "written with sizes" in capsys.readouterr().err
