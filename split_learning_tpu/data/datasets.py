@@ -394,9 +394,12 @@ def _categorical_rows(rs: np.random.RandomState, rows: int, cols: int,
     return probs / probs.sum(axis=1, keepdims=True)
 
 
+_TOKEN_SEQ_LEN = 64   # the token generators' default sequence length
+
+
 def synthetic_tokens(name: str = "tokens", n_train: int = 4096,
                      n_test: int = 512, num_classes: int = 10,
-                     vocab: int = 256, seq_len: int = 64,
+                     vocab: int = 256, seq_len: int = _TOKEN_SEQ_LEN,
                      seed: int = 0) -> Dataset:
     """Class-conditional token sequences for the transformer family
     (models/transformer.py): class k draws its tokens from a k-specific
@@ -416,7 +419,7 @@ def synthetic_tokens(name: str = "tokens", n_train: int = 4096,
 
 
 def synthetic_lm(name: str = "lm", n_train: int = 4096, n_test: int = 512,
-                 vocab: int = 256, seq_len: int = 64,
+                 vocab: int = 256, seq_len: int = _TOKEN_SEQ_LEN,
                  seed: int = 0) -> Dataset:
     """First-order Markov chains for the causal LM
     (models/transformer.py ``lm=True``): a fixed random transition
@@ -477,6 +480,10 @@ def load_dataset(name: str, data_dir: str,
             f"seq_len applies to the token datasets only (got {name!r})")
     if seq_len is not None and seq_len <= 0:
         raise ValueError(f"seq_len must be positive (got {seq_len})")
+    if seq_len == _TOKEN_SEQ_LEN:
+        # an explicit default-length request is the same dataset as a
+        # bare one: normalize so the two never fork the cache
+        seq_len = None
     if store is None:
         store = LocalStore(os.path.join(data_dir, "cache"))
     # a non-default sequence length is a different dataset: its own

@@ -408,11 +408,15 @@ def cmd_train(args) -> int:
                                      or cfg.attn != "full"):
                 # the seq-parallel attention forms need the mesh at plan
                 # build time (the shard_map closes over it)
+                # same derived kwargs as the first build: dropping the
+                # max_len a long --seq-len forces would cap the rebuilt
+                # plan at the 2048 default and crash the first forward
+                plan_kw = _plan_size_kw(cfg.model, size_kw, seq_len)
                 if cfg.model == "vit":
                     from split_learning_tpu.models.vit import vit_plan
                     plan = vit_plan(mode=cfg.mode,
                                     dtype=np.dtype(cfg.dtype),
-                                    mesh=mesh, attn=cfg.attn, **size_kw)
+                                    mesh=mesh, attn=cfg.attn, **plan_kw)
                 else:
                     from split_learning_tpu.models.transformer import (
                         transformer_plan)
@@ -420,7 +424,7 @@ def cmd_train(args) -> int:
                                             dtype=np.dtype(cfg.dtype),
                                             mesh=mesh, attn=cfg.attn,
                                             lm=cfg.model == "transformer_lm",
-                                            **size_kw)
+                                            **plan_kw)
             elif cfg.attn != "full":
                 print(f"[warn] --attn {cfg.attn!r} ignored: model "
                       f"{cfg.model!r} has no attention (transformer/vit "
@@ -791,13 +795,14 @@ def cmd_serve(args) -> int:
         if joint:
             save_dir = os.path.join(cfg.checkpoint_dir, "server_party")
             ckptr = Checkpointer(save_dir)
-            _write_ckpt_meta(save_dir, "server_only", cfg, size_kw)
+            _write_ckpt_meta(save_dir, "server_only", cfg, size_kw,
+                             seq_len)
             print(f"[ckpt] joint-layout dir: periodic server saves go to "
                   f"{save_dir}", file=sys.stderr)
         else:
             ckptr = Checkpointer(cfg.checkpoint_dir)
             _write_ckpt_meta(cfg.checkpoint_dir, "server_only", cfg,
-                             size_kw)
+                             size_kw, seq_len)
         latest = ckptr.latest_step()
         if args.resume and joint:
             # a prior serve on this joint dir may have saved newer
@@ -872,19 +877,21 @@ def _resolve_checkpoint(args, cfg, cmd: str, require_model: str = None):
         print(f"[error] {cmd} needs a {require_model!r} checkpoint "
               f"(got {model!r})", file=sys.stderr)
         return None, 2
-    # the checkpoint's recorded sizes are authoritative — explicit size
-    # flags must match or be absent, never silently overridden
-    size_kw, _, err = _reconcile_ckpt_sizes(
-        meta, _size_kw_from_args(args), None, cmd)
+    # the checkpoint's recorded sizes AND seq_len are authoritative —
+    # explicit flags must match or be absent, never silently overridden
+    # (the returned seq_len is what the caller's dataset load must use)
+    size_kw, seq_len, err = _reconcile_ckpt_sizes(
+        meta, _size_kw_from_args(args), getattr(args, "seq_len", None),
+        cmd)
     if err:
         print(f"[error] {err}", file=sys.stderr)
         return None, 2
     plan = get_plan(model=model, mode=mode, dtype=cfg.dtype,
-                    **_plan_size_kw(model, size_kw, meta.get("seq_len")))
+                    **_plan_size_kw(model, size_kw, seq_len))
     ckptr = Checkpointer(ckdir)
     step = args.step if args.step is not None else ckptr.latest_step()
     params = _assemble_full_params(meta["layout"], ckptr.restore_raw(step))
-    return (meta, mode, model, dataset, plan, step, params), None
+    return (meta, mode, model, dataset, plan, step, params, seq_len), None
 
 
 def cmd_eval(args) -> int:
@@ -895,11 +902,10 @@ def cmd_eval(args) -> int:
     resolved, rc = _resolve_checkpoint(args, cfg, "eval")
     if resolved is None:
         return rc
-    meta, mode, model, dataset, plan, step, params = resolved
+    meta, mode, model, dataset, plan, step, params, seq_len = resolved
     from split_learning_tpu.data import store_from_config as _sfc
-    # a sized-context checkpoint must be scored at its own T: explicit
-    # --seq-len wins, then the checkpoint's recorded one
-    seq_len = getattr(args, "seq_len", None) or meta.get("seq_len")
+    # seq_len comes reconciled from _resolve_checkpoint: the
+    # checkpoint's recorded T, already checked against any explicit flag
     ds = load_dataset(dataset, cfg.data_dir, store=_sfc(cfg),
                       seq_len=seq_len if dataset in ("tokens", "lm")
                       else None)
@@ -983,7 +989,7 @@ def cmd_generate(args) -> int:
                                        require_model="transformer_lm")
     if resolved is None:
         return rc
-    meta, mode, model, dataset, plan, step, params = resolved
+    meta, mode, model, dataset, plan, step, params, seq_len = resolved
 
     if tokens is not None:
         prompt = np.asarray([tokens], np.int32)
@@ -1010,7 +1016,6 @@ def cmd_generate(args) -> int:
     else:
         # no prompt: seed from the dataset's test split, like eval
         from split_learning_tpu.data import load_dataset, store_from_config
-        seq_len = getattr(args, "seq_len", None) or meta.get("seq_len")
         ds = load_dataset(dataset, cfg.data_dir,
                           store=store_from_config(cfg),
                           seq_len=seq_len if dataset in ("tokens", "lm")
