@@ -74,7 +74,8 @@ def _seq_len() -> int:
 def _data(n_steps: int, model: str):
     import numpy as np
     rs = np.random.RandomState(0)
-    if model == "resnet18":
+    if model in ("resnet18", "vit"):
+        # CIFAR-shaped images; for vit: 32x32 / patch 4 -> 64 tokens
         x = rs.randn(n_steps, BATCH, 32, 32, 3).astype(np.float32)
     elif model == "transformer":
         x = rs.randint(0, 256, (n_steps, BATCH, _seq_len())).astype(np.int32)
@@ -202,6 +203,8 @@ def measure_fused(quick: bool) -> dict:
         chunk, n_chunks = (4, 2) if quick else (15, 4)
     elif model == "transformer":
         chunk, n_chunks = (20, 2) if quick else (100, 4)
+    elif model == "vit":
+        chunk, n_chunks = (50, 2) if quick else (200, 4)
     x, y = _data(chunk, model)
     if batch != BATCH:
         reps = (batch + BATCH - 1) // BATCH
@@ -223,13 +226,19 @@ def measure_fused(quick: bool) -> dict:
         tkw = dict(mode=mode, dtype=np.dtype(dtype), d_model=256,
                    num_heads=2, max_len=max(2048, _seq_len()))
         plan = transformer_plan(attn=attn, **tkw)
+    elif model == "vit":
+        # same TPU-shaped trunk as the transformer leg (head_dim 128):
+        # 32x32/patch-4 images -> 64 patch tokens
+        from split_learning_tpu.models.vit import vit_plan
+        plan = vit_plan(mode=mode, dtype=np.dtype(dtype), d_model=256,
+                        num_heads=2, attn=attn)
     else:
         plan = get_plan(model=model, mode=mode, dtype=dtype)
     trainer = FusedSplitTrainer(plan, cfg, jax.random.PRNGKey(0), x[0])
     device = trainer.state.step.devices().pop()
     platform = device.platform
 
-    if model == "transformer" and attn != "full":
+    if model in ("transformer", "vit") and attn != "full":
         # the flash kernels hide their matmuls inside pallas_call, which
         # the jaxpr FLOPs counter cannot see; count a dense-attention
         # step of identical shapes instead. Trace-only on the existing
@@ -238,7 +247,11 @@ def measure_fused(quick: bool) -> dict:
         # [B,H,T,T] scores (17 GB at T=16k: an instant OOM)
         from split_learning_tpu.core.losses import cross_entropy as _ce
         from split_learning_tpu.utils.flops import jaxpr_matmul_flops
-        dense_plan = transformer_plan(attn="full", **tkw)
+        if model == "vit":
+            dense_plan = vit_plan(mode=mode, dtype=np.dtype(dtype),
+                                  d_model=256, num_heads=2, attn="full")
+        else:
+            dense_plan = transformer_plan(attn="full", **tkw)
 
         def _dense_step(params, xb, yb):
             return jax.value_and_grad(

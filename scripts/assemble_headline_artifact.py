@@ -94,7 +94,8 @@ def main() -> None:
         "fused": fused,
     }
     for key, prefix in (("split_cnn_b1024_bf16", "cnn_b1024_bf16_scan."),
-                        ("decode_kv_cache", "decode.")):
+                        ("decode_kv_cache", "decode."),
+                        ("vit_b256_bf16", "vit_b256_bf16.")):
         extra = best_leg(records, prefix)
         # same platform guard as the headline: a leg that silently fell
         # back to CPU mid-window must not ride into a TPU artifact

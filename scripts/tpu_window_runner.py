@@ -96,6 +96,13 @@ LEGS = [
     # select_attention can be re-pinned from data
     _t_leg(2048, 64, "flash", True, 1200),
     _t_leg(2048, 64, "full", True, 1200),
+    # round-4 ViT family: the transformer trunk on images (b256 bf16,
+    # 64 patch tokens, head_dim 128) — on-chip evidence for the fourth
+    # model family
+    {"id": "vit_b256_bf16.q", "role": "fused",
+     "env": {"SLT_BENCH_MODEL": "vit", "SLT_BENCH_BATCH": "256",
+             "SLT_BENCH_DTYPE": "bfloat16"},
+     "quick": True, "timeout": 900},
     # non-quick confirmations
     {"id": "decode.full", "role": "decode", "env": {}, "quick": False,
      "timeout": 1500},
