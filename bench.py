@@ -230,8 +230,9 @@ def measure_fused(quick: bool) -> dict:
         # same TPU-shaped trunk as the transformer leg (head_dim 128):
         # 32x32/patch-4 images -> 64 patch tokens
         from split_learning_tpu.models.vit import vit_plan
-        plan = vit_plan(mode=mode, dtype=np.dtype(dtype), d_model=256,
-                        num_heads=2, attn=attn)
+        vkw = dict(mode=mode, dtype=np.dtype(dtype), d_model=256,
+                   num_heads=2)
+        plan = vit_plan(attn=attn, **vkw)
     else:
         plan = get_plan(model=model, mode=mode, dtype=dtype)
     trainer = FusedSplitTrainer(plan, cfg, jax.random.PRNGKey(0), x[0])
@@ -248,8 +249,7 @@ def measure_fused(quick: bool) -> dict:
         from split_learning_tpu.core.losses import cross_entropy as _ce
         from split_learning_tpu.utils.flops import jaxpr_matmul_flops
         if model == "vit":
-            dense_plan = vit_plan(mode=mode, dtype=np.dtype(dtype),
-                                  d_model=256, num_heads=2, attn="full")
+            dense_plan = vit_plan(attn="full", **vkw)
         else:
             dense_plan = transformer_plan(attn="full", **tkw)
 

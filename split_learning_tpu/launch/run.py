@@ -756,9 +756,19 @@ def cmd_serve(args) -> int:
     except (ValueError, TypeError) as e:
         print(f"[error] {e}", file=sys.stderr)
         return 2
-    shape = _SHAPES.get("mnist" if cfg.dataset == "synthetic" else cfg.dataset,
-                        (28, 28, 1))
-    sample = np.zeros((cfg.batch_size,) + shape, np.float32)
+    if cfg.model in ("transformer", "transformer_lm"):
+        # token models init from an integer sequence sample (the image
+        # shape below would crash the embed); T follows the reconciled
+        # --seq-len / checkpoint meta, falling back to the dataset
+        # generators' default
+        from split_learning_tpu.data.datasets import _TOKEN_SEQ_LEN
+        sample = np.zeros((cfg.batch_size, seq_len or _TOKEN_SEQ_LEN),
+                          np.int32)
+    else:
+        shape = _SHAPES.get(
+            "mnist" if cfg.dataset == "synthetic" else cfg.dataset,
+            (28, 28, 1))
+        sample = np.zeros((cfg.batch_size,) + shape, np.float32)
     runtime = ServerRuntime(plan, cfg, jax.random.PRNGKey(cfg.seed), sample,
                             strict_steps=not args.allow_out_of_order)
 
