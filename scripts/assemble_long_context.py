@@ -32,6 +32,25 @@ RUNS = os.path.join(REPO, "artifacts", "tpu_window_runs.jsonl")
 
 _ID = re.compile(r"^T(\d+)\.b(\d+)\.(flash|full)\.(q|full)$")
 
+# Window records quarantined from assembly, keyed by (leg id, ts):
+# candidates contradicted by stronger evidence. They still rank above
+# nothing at all, but any non-suspect record of the same (seq, attn)
+# displaces them, and a published suspect leg carries the note.
+SUSPECT = {
+    # 16x below the round-3 measurement of the same shape on unchanged
+    # dense code (42.57 steps/s, bench_tpu_transformer_2026-07-30.json)
+    # with perfect work-scaling — consistent with pooled-chip
+    # contention; predates the per-window canary. Confirmation leg
+    # queued in tpu_window_runner.py.
+    ("T1024.b64.full.q", 1785501458): (
+        "suspected pooled-chip contention: 16x below the unchanged-code "
+        "round-3 twin; no same-window canary; confirmation queued"),
+}
+
+
+def _suspect_note(rec):
+    return SUSPECT.get((rec.get("leg"), int(rec.get("ts", 0))))
+
 
 def load_records():
     with open(RUNS) as f:
@@ -59,8 +78,15 @@ def assemble(records):
         else:
             leg = dict(rec["result"])
             leg["status"] = rec["status"]
+        note = _suspect_note(rec)
+        if note is not None:
+            leg["suspect"] = note
         key = (seq, attn_key)
-        rank = (status_rank[rec["status"]], is_full, rec.get("ts", 0))
+        # suspects rank below every non-suspect status: any clean
+        # record of the shape displaces them, but a suspect-only shape
+        # still publishes (carrying its note) rather than vanishing
+        rank = (note is None, status_rank[rec["status"]], is_full,
+                rec.get("ts", 0))
         if key not in best or rank > best[key][0]:
             best[key] = (rank, leg)
     return [best[k][1] for k in sorted(best)]

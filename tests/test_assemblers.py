@@ -102,3 +102,25 @@ def test_longctx_full_leg_preferred_within_same_status(longctx):
     ]
     legs = longctx.assemble(records)
     assert legs[0]["steps_per_sec"] == 353.0
+
+
+def test_suspect_records_demoted_but_not_vanished(longctx, monkeypatch):
+    """A quarantined record (SUSPECT registry: contradicted by stronger
+    evidence, e.g. the 16x-slow dense T=1024 window read) loses to ANY
+    clean record of the same shape — even a lower-priority quick one —
+    but still publishes, carrying its note, when it is all there is."""
+    ok = {"leg": "T64.b8.full.q", "status": "ok", "ts": 100,
+          "result": {"model": "transformer", "attn": "full", "batch": 8,
+                     "seq_len": 64, "steps_per_sec": 2.0, "valid": True}}
+    monkeypatch.setattr(longctx, "SUSPECT",
+                        {("T64.b8.full.q", 100): "contradicted"})
+    legs = longctx.assemble([ok])
+    assert legs[0]["suspect"] == "contradicted"   # alone: published+noted
+
+    clean = {"leg": "T64.b8.full.q", "status": "ok", "ts": 50,
+             "result": {"model": "transformer", "attn": "full", "batch": 8,
+                        "seq_len": 64, "steps_per_sec": 40.0,
+                        "valid": True}}
+    legs = longctx.assemble([ok, clean])   # older clean record wins anyway
+    assert legs[0]["steps_per_sec"] == 40.0
+    assert "suspect" not in legs[0]
