@@ -82,10 +82,12 @@ def assemble(records):
         if note is not None:
             leg["suspect"] = note
         key = (seq, attn_key)
-        # suspects rank below every non-suspect status: any clean
-        # record of the shape displaces them, but a suspect-only shape
-        # still publishes (carrying its note) rather than vanishing
-        rank = (note is None, status_rank[rec["status"]], is_full,
+        # status stays the primary key (a gate-passing ok — suspect or
+        # not — is never displaced by an invalid/oom attempt);
+        # suspectness breaks ties WITHIN a status, so any clean record
+        # of the same status displaces a suspect one, while a
+        # suspect-only shape still publishes (carrying its note)
+        rank = (status_rank[rec["status"]], note is None, is_full,
                 rec.get("ts", 0))
         if key not in best or rank > best[key][0]:
             best[key] = (rank, leg)
@@ -108,9 +110,13 @@ def complete_enough(legs) -> list:
         missing.append(f"memory-ceiling pair at T={t_max} "
                        "(dense oom + flash ok)")
     if not any({"full", "flash"} <= set(v) and
-               all(l.get("status") == "ok" for l in v.values())
+               all(l.get("status") == "ok" and "suspect" not in l
+                   for l in v.values())
                for v in by_t.values()):
-        missing.append("at least one shared-T (dense, flash) ok pair")
+        # a quarantined record must never be the measurement that
+        # greenlights publication — only clean pairs count
+        missing.append("at least one clean shared-T (dense, flash) "
+                       "ok pair")
     return missing
 
 

@@ -124,3 +124,28 @@ def test_suspect_records_demoted_but_not_vanished(longctx, monkeypatch):
     legs = longctx.assemble([ok, clean])   # older clean record wins anyway
     assert legs[0]["steps_per_sec"] == 40.0
     assert "suspect" not in legs[0]
+
+    # status stays primary: a gate-FAILED retry never displaces the
+    # suspect gate-passing ok (information would be strictly lost)
+    bad = {"leg": "T64.b8.full.q", "status": "invalid", "ts": 200,
+           "result": {"model": "transformer", "attn": "full", "batch": 8,
+                      "seq_len": 64, "steps_per_sec": 999.0,
+                      "valid": False}}
+    legs = longctx.assemble([ok, bad])
+    assert legs[0]["status"] == "ok"
+    assert legs[0]["suspect"] == "contradicted"
+
+    # and a suspect pair never greenlights publication by itself
+    flash_ok = {"leg": "T64.b8.flash.q", "status": "ok", "ts": 100,
+                "result": {"model": "transformer", "attn": "flash",
+                           "batch": 8, "seq_len": 64,
+                           "steps_per_sec": 3.0, "valid": True}}
+    oom_top = {"leg": "T128.b8.full.q", "status": "oom", "ts": 100}
+    flash_top = {"leg": "T128.b8.flash.q", "status": "ok", "ts": 100,
+                 "result": {"model": "transformer", "attn": "flash",
+                            "batch": 8, "seq_len": 128,
+                            "steps_per_sec": 1.0, "valid": True}}
+    legs = longctx.assemble([ok, flash_ok, oom_top, flash_top])
+    assert any("clean shared-T" in m for m in longctx.complete_enough(legs))
+    legs = longctx.assemble([clean, flash_ok, oom_top, flash_top])
+    assert longctx.complete_enough(legs) == []
