@@ -103,6 +103,7 @@ def test_optimizer_env_parsing():
     assert cfg.decay_steps == 70
 
 
+@pytest.mark.slow
 def test_fused_trainer_adamw_learns_and_differs_from_sgd():
     """The fused trainer accepts the new optimizers end-to-end: adamw
     with warmup reduces the loss and takes a different trajectory from

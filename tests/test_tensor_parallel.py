@@ -164,6 +164,7 @@ def test_tp_rejected_across_hosts():
                     devices=devs)
 
 
+@pytest.mark.slow
 def test_tp_transformer_matches_single_device_and_shards(devices):
     """TP generalizes to the attention family: 2-way model parallelism
     on the split transformer reproduces single-device training (the

@@ -53,6 +53,7 @@ def test_u_split_owners_and_composition():
                                   np.asarray(plan.apply(params, x)))
 
 
+@pytest.mark.slow
 def test_fused_training_learns():
     from split_learning_tpu.runtime.fused import FusedSplitTrainer
     from split_learning_tpu.utils import Config
