@@ -1,3 +1,7 @@
 from split_learning_tpu.models.factory import get_model, get_plan, register_model
 
+# family plan builders stay lazily imported (factory builders import them
+# on dispatch): `from split_learning_tpu.models.vit import vit_plan` /
+# `...models.transformer import transformer_plan` for direct sized/meshed
+# construction
 __all__ = ["get_model", "get_plan", "register_model"]

@@ -16,12 +16,14 @@ from split_learning_tpu.runtime.server import (
     ProtocolError,
     ServerRuntime,
 )
-from split_learning_tpu.runtime.state import TrainState, apply_grads, make_state, sgd
+from split_learning_tpu.runtime.state import (
+    TrainState, apply_grads, make_lr, make_state, make_tx, sgd)
 
 __all__ = [
     "SplitClientTrainer", "USplitClientTrainer", "FederatedClientTrainer",
     "FailurePolicy", "StepRecord", "ServerRuntime", "FedAvgAggregator",
     "ProtocolError", "TrainState", "make_state", "apply_grads", "sgd",
+    "make_tx", "make_lr",
     "Checkpointer", "joint_state", "MultiClientSplitRunner",
     "PipelinedSplitClientTrainer", "greedy_generate", "sample_generate",
     "evaluate", "evaluate_remote", "generate_remote",
