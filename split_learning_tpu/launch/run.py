@@ -817,17 +817,52 @@ def cmd_serve(args) -> int:
         if args.resume and joint:
             # a prior serve on this joint dir may have saved newer
             # server-only state under server_party/ — prefer it; else
-            # partial-restore the typed server subtree of the joint tree
+            # restore the server's share of the joint tree
             root = Checkpointer(cfg.checkpoint_dir)
             try:
                 root_latest = root.latest_step()
                 if root_latest is not None and (latest is None
                                                 or root_latest > latest):
-                    tree = root.restore_partial({"server": runtime.state},
-                                                root_latest)
-                    runtime.resume_from(tree["server"], root_latest)
+                    layout = (existing or {}).get("layout")
+                    if layout in ("fused", "pipeline"):
+                        # single-program layouts store one whole-plan
+                        # tree: take the server's share of the params
+                        # and re-init the optimizer for them (exact for
+                        # the reference's plain constant-lr SGD;
+                        # stateful optimizers restart their moments on
+                        # this handoff — the joint opt_state spans all
+                        # parties and cannot be attributed per stage
+                        # generically)
+                        import jax.numpy as jnp
+                        from split_learning_tpu.runtime.state import (
+                            make_state)
+                        if cfg.warmup_steps or cfg.decay_steps \
+                                or cfg.momentum \
+                                or cfg.optimizer != "sgd":
+                            print("[ckpt] note: optimizer state "
+                                  "(moments / lr-schedule position) "
+                                  "restarts on a fused-layout handoff; "
+                                  "params and the step handshake are "
+                                  "exact", file=sys.stderr)
+                        raw = root.restore_raw(root_latest)
+                        raw_params = raw["trainer"]["params"]
+                        # federated servers own the full composition;
+                        # split/u_split own one stage
+                        sp = (tuple(raw_params) if cfg.mode == "federated"
+                              else raw_params[runtime.server_stage])
+                        st = make_state(sp, runtime._tx)._replace(
+                            step=jnp.asarray(root_latest, jnp.int32))
+                        del raw, raw_params, sp  # the joint tree is ~3x
+                        # the served stage; don't pin it for the whole
+                        # server lifetime
+                        runtime.resume_from(st, root_latest)
+                    else:
+                        tree = root.restore_partial(
+                            {"server": runtime.state}, root_latest)
+                        runtime.resume_from(tree["server"], root_latest)
                     print(f"[ckpt] server resumed at step {root_latest} "
-                          f"from joint {cfg.checkpoint_dir}",
+                          f"from joint {cfg.checkpoint_dir} "
+                          f"(layout {layout or 'split_local'})",
                           file=sys.stderr)
                     latest = None  # handled; skip the server_party branch
             finally:

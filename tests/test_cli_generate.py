@@ -138,3 +138,52 @@ def test_cli_split_party_decode_roundtrip(tmp_path, capsys):
     remote = gen("--server-url", f"http://127.0.0.1:{port}")
     assert remote["remote_server"].endswith(str(port))
     assert remote["tokens"] == local["tokens"]
+
+
+@pytest.mark.slow
+def test_serve_resumes_fused_checkpoint(tmp_path, capsys):
+    """The natural flow — train fused, then serve the server party from
+    the joint checkpoint: serve slices its stage from the whole-plan
+    tree, and split-party decode against it is token-exact vs local."""
+    import threading
+    import time
+    import urllib.request
+
+    ck = str(tmp_path / "ck")
+    rc = main(["train", "--model", "transformer_lm", "--dataset", "lm",
+               "--transport", "fused", "--d-model", "32", "--num-heads",
+               "2", "--seq-len", "16", "--steps", "4", "--batch-size", "8",
+               "--tracking", "noop", "--checkpoint-dir", ck,
+               "--data-dir", str(tmp_path)])
+    assert rc == 0
+    capsys.readouterr()
+
+    port = 18517
+    threading.Thread(
+        target=main,
+        args=(["serve", "--model", "transformer_lm", "--dataset", "lm",
+               "--port", str(port), "--tracking", "noop",
+               "--checkpoint-dir", ck, "--resume",
+               "--data-dir", str(tmp_path)],), daemon=True).start()
+    for _ in range(60):
+        time.sleep(0.5)
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/health", timeout=2)
+            break
+        except Exception:
+            continue
+    else:
+        raise AssertionError("serve never became healthy")
+    capsys.readouterr()
+
+    def gen(*extra):
+        rc = main(["generate", "--checkpoint-dir", ck, "--prompt",
+                   "4,5,6", "--n-new", "4", "--data-dir", str(tmp_path),
+                   *extra])
+        assert rc == 0
+        return json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+
+    local = gen()
+    remote = gen("--server-url", f"http://127.0.0.1:{port}")
+    assert remote["tokens"] == local["tokens"]
