@@ -951,6 +951,14 @@ def main() -> None:
             elif tfm is not None:
                 print(f"[bench] {leg_name} leg INVALID: "
                       f"{tfm.get('invalid_reason')}", file=sys.stderr)
+        # round-4 ViT family: the transformer trunk on images
+        vit = side_leg({"SLT_BENCH_MODEL": "vit", "SLT_BENCH_BATCH": "256",
+                        "SLT_BENCH_DTYPE": "bfloat16"})
+        if vit is not None and vit.get("valid"):
+            detail["vit_b256_bf16"] = vit
+        elif vit is not None:
+            print(f"[bench] vit leg INVALID: "
+                  f"{vit.get('invalid_reason')}", file=sys.stderr)
         # KV-cache decode throughput (runtime/generate.py): tokens/s at
         # a 1024-token prompt, vs the O(T^2) re-forward path
         dec = side_leg({}, role="decode")

@@ -857,8 +857,19 @@ def cmd_serve(args) -> int:
                         # server lifetime
                         runtime.resume_from(st, root_latest)
                     else:
-                        tree = root.restore_partial(
-                            {"server": runtime.state}, root_latest)
+                        try:
+                            tree = root.restore_partial(
+                                {"server": runtime.state}, root_latest)
+                        except KeyError:
+                            # client_only / remote-server federated
+                            # trees carry no server half to resume
+                            print(f"[error] checkpoint layout "
+                                  f"{layout or 'split_local'!r} under "
+                                  f"{cfg.checkpoint_dir} has no server "
+                                  "subtree to resume (it was written by "
+                                  "a client whose server was remote)",
+                                  file=sys.stderr)
+                            return 2
                         runtime.resume_from(tree["server"], root_latest)
                     print(f"[ckpt] server resumed at step {root_latest} "
                           f"from joint {cfg.checkpoint_dir} "

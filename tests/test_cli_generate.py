@@ -87,34 +87,35 @@ def test_generate_rejects_bad_sampling_flags(tmp_path, capsys):
 
 
 @pytest.mark.slow
-def test_cli_split_party_decode_roundtrip(tmp_path, capsys):
-    """The full CLI story: train a sized LM checkpoint, stand the server
-    party up with `serve --resume`, decode split-party with
-    `generate --server-url` — token-exact vs the local composed decode
-    (both halves share the checkpoint weights)."""
+@pytest.mark.parametrize("transport,port", [
+    ("local", 18411),   # split_local layout: per-party subtrees
+    ("fused", 18517),   # joint whole-plan tree: serve slices its stage
+])
+def test_cli_split_party_decode_roundtrip(tmp_path, capsys, transport,
+                                          port):
+    """The full CLI story for BOTH checkpoint layouts: train a sized LM,
+    stand the server party up with `serve --resume`, decode split-party
+    with `generate --server-url` — token-exact vs the local composed
+    decode (both halves share the checkpoint weights)."""
     import threading
     import time
     import urllib.request
 
     ck = str(tmp_path / "ck")
-    # --transport local writes the split_local layout (per-party
-    # subtrees) — the layout `serve --resume` restores its half from
     rc = main(["train", "--model", "transformer_lm", "--dataset", "lm",
-               "--transport", "local", "--d-model", "32", "--num-heads",
+               "--transport", transport, "--d-model", "32", "--num-heads",
                "2", "--seq-len", "16", "--steps", "4", "--batch-size", "8",
                "--tracking", "noop", "--checkpoint-dir", ck,
                "--data-dir", str(tmp_path)])
     assert rc == 0
     capsys.readouterr()
 
-    port = 18411
-    server = threading.Thread(
+    threading.Thread(
         target=main,
         args=(["serve", "--model", "transformer_lm", "--dataset", "lm",
                "--port", str(port), "--tracking", "noop",
                "--checkpoint-dir", ck, "--resume",
-               "--data-dir", str(tmp_path)],), daemon=True)
-    server.start()
+               "--data-dir", str(tmp_path)],), daemon=True).start()
     for _ in range(60):
         time.sleep(0.5)
         try:
@@ -137,53 +138,4 @@ def test_cli_split_party_decode_roundtrip(tmp_path, capsys):
     local = gen()
     remote = gen("--server-url", f"http://127.0.0.1:{port}")
     assert remote["remote_server"].endswith(str(port))
-    assert remote["tokens"] == local["tokens"]
-
-
-@pytest.mark.slow
-def test_serve_resumes_fused_checkpoint(tmp_path, capsys):
-    """The natural flow — train fused, then serve the server party from
-    the joint checkpoint: serve slices its stage from the whole-plan
-    tree, and split-party decode against it is token-exact vs local."""
-    import threading
-    import time
-    import urllib.request
-
-    ck = str(tmp_path / "ck")
-    rc = main(["train", "--model", "transformer_lm", "--dataset", "lm",
-               "--transport", "fused", "--d-model", "32", "--num-heads",
-               "2", "--seq-len", "16", "--steps", "4", "--batch-size", "8",
-               "--tracking", "noop", "--checkpoint-dir", ck,
-               "--data-dir", str(tmp_path)])
-    assert rc == 0
-    capsys.readouterr()
-
-    port = 18517
-    threading.Thread(
-        target=main,
-        args=(["serve", "--model", "transformer_lm", "--dataset", "lm",
-               "--port", str(port), "--tracking", "noop",
-               "--checkpoint-dir", ck, "--resume",
-               "--data-dir", str(tmp_path)],), daemon=True).start()
-    for _ in range(60):
-        time.sleep(0.5)
-        try:
-            urllib.request.urlopen(
-                f"http://127.0.0.1:{port}/health", timeout=2)
-            break
-        except Exception:
-            continue
-    else:
-        raise AssertionError("serve never became healthy")
-    capsys.readouterr()
-
-    def gen(*extra):
-        rc = main(["generate", "--checkpoint-dir", ck, "--prompt",
-                   "4,5,6", "--n-new", "4", "--data-dir", str(tmp_path),
-                   *extra])
-        assert rc == 0
-        return json.loads(capsys.readouterr().out.strip().splitlines()[-1])
-
-    local = gen()
-    remote = gen("--server-url", f"http://127.0.0.1:{port}")
     assert remote["tokens"] == local["tokens"]
