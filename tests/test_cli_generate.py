@@ -139,3 +139,27 @@ def test_cli_split_party_decode_roundtrip(tmp_path, capsys, transport,
     remote = gen("--server-url", f"http://127.0.0.1:{port}")
     assert remote["remote_server"].endswith(str(port))
     assert remote["tokens"] == local["tokens"]
+
+
+@pytest.mark.slow
+def test_serve_resume_rejects_serverless_layout(tmp_path, capsys):
+    """A checkpoint written by a client whose server was remote carries
+    no server half: serve --resume must exit 2 with a clear error, not
+    an uncaught KeyError."""
+    import numpy as np
+
+    from split_learning_tpu.runtime.checkpoint import Checkpointer
+
+    ck = tmp_path / "ck"
+    os.makedirs(ck)
+    with open(ck / "meta.json", "w") as f:
+        json.dump({"layout": "client_only", "mode": "split",
+                   "model": "split_cnn", "dataset": "synthetic"}, f)
+    ckptr = Checkpointer(str(ck))
+    ckptr.save(3, {"client": {"params": {"w": np.zeros(2)}}})
+    ckptr.close()
+
+    rc = main(["serve", "--checkpoint-dir", str(ck), "--resume",
+               "--tracking", "noop", "--data-dir", str(tmp_path)])
+    assert rc == 2
+    assert "no server subtree" in capsys.readouterr().err
