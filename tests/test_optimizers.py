@@ -170,3 +170,46 @@ def test_grad_clip_global_norm():
     assert np.isclose(norm, 1.0, rtol=1e-5)
     with pytest.raises(ValueError, match="non-negative"):
         Config(grad_clip_norm=-0.5)
+
+
+@pytest.mark.slow
+def test_schedule_position_survives_fused_resume(tmp_path):
+    """Warmup/cosine schedules ride optax's step count inside opt_state:
+    a checkpoint/resume at step k must continue the schedule from k, not
+    restart warmup — the resumed trajectory equals the uninterrupted one
+    step for step."""
+    import numpy as np
+
+    from split_learning_tpu.models import get_plan
+    from split_learning_tpu.runtime.checkpoint import Checkpointer
+    from split_learning_tpu.runtime.fused import FusedSplitTrainer
+
+    rs = np.random.RandomState(9)
+    xs = rs.randn(8, 16, 28, 28, 1).astype(np.float32)
+    ys = rs.randint(0, 10, (8, 16)).astype(np.int64)
+    cfg = Config(optimizer="adamw", lr=5e-3, warmup_steps=3,
+                 decay_steps=8, batch_size=16)
+
+    def trainer():
+        return FusedSplitTrainer(get_plan(mode="split"), cfg,
+                                 jax.random.PRNGKey(0), xs[0])
+
+    # uninterrupted reference
+    ref = trainer()
+    ref_losses = [ref.train_step(x, y) for x, y in zip(xs, ys)]
+
+    # train 4 steps, checkpoint, resume in a FRESH trainer, finish
+    a = trainer()
+    for x, y in zip(xs[:4], ys[:4]):
+        a.train_step(x, y)
+    ck = Checkpointer(str(tmp_path / "ck"))
+    ck.save(4, {"trainer": a.state})
+    ck.close()
+
+    b = trainer()
+    ck2 = Checkpointer(str(tmp_path / "ck"))
+    b.state = ck2.restore({"trainer": b.state})["trainer"]
+    ck2.close()
+    resumed = [b.train_step(x, y) for x, y in zip(xs[4:], ys[4:])]
+    np.testing.assert_allclose(resumed, ref_losses[4:], rtol=1e-6,
+                               atol=1e-7)
