@@ -109,7 +109,15 @@ def _vmem_limit_bytes() -> int:
     requesting more than Mosaic's default there would fail the compile
     of shapes the two-kernel split handles fine — while v4 onward have
     ~128 MiB. Unknown/CPU devices report the v5e figure so interpret-
-    mode tests select the same backward form as the bench chip."""
+    mode tests select the same backward form as the bench chip.
+
+    The raised figure was only *measured* on v5e; on other real-TPU
+    generations this static pick is optimistic on purpose, because it
+    is no longer the last line of defence: on any compiled-TPU path
+    :func:`_use_onepass` confirms the selection with a cached preflight
+    compile (:func:`_onepass_compile_ok`) and falls back to the
+    two-kernel split when the device refuses the raised limit — a
+    user-path shape can never be a compile error."""
     try:
         kind = jax.devices()[0].device_kind.lower()
     except Exception:
@@ -132,21 +140,71 @@ def _onepass_resident_bytes(tp: int, d: int, itemsize: int) -> int:
     return 2 * tp * per_row
 
 
-def _use_onepass(t: int, block: int, d: int, itemsize: int) -> bool:
+# Below this whole-sequence residency the one-pass backward fits
+# Mosaic's 16 MiB *default* scoped-VMEM limit with ~2 MiB to spare for
+# the double-buffered K/V/dK/dV block buffers (~1 MiB at block=512
+# d=128) and compiler temporaries, so no preflight is needed: the
+# raised limit only matters past it. The estimator is accurate — the
+# T=4096 bf16 failure requested 16.50 MiB vs a 16.51 MiB estimate.
+_DEFAULT_LIMIT_SAFE = 12 * 1024 * 1024
+
+
+def _use_onepass(t: int, block: int, d: int, dtype) -> bool:
     """Backward-form selection: one-pass while its whole-sequence
     residency (see :func:`_onepass_resident_bytes`) fits 2/3 of the
     device's scoped-VMEM limit, leaving the rest for the
     double-buffered K/V/dK/dV blocks and compiler temporaries — on a
     v4/v5 core (96 MiB limit, 64 MiB budget) bf16 d=128 passes through
     T=16384. ``SLT_FLASH_ONEPASS_T`` overrides: one-pass at or below
-    that padded T, two-kernel above (0 = never)."""
+    that padded T, two-kernel above (0 = never).
+
+    When the shape needs the *raised* scoped-VMEM limit (residency past
+    :data:`_DEFAULT_LIMIT_SAFE`) and the kernel will actually be
+    Mosaic-compiled (not interpreted), the static choice is confirmed
+    by :func:`_onepass_compile_ok` — a cached preflight compile of the
+    backward alone — and quietly falls back to the two-kernel split if
+    the device rejects the limit. Round-4 lesson: the T=4096 leg was a
+    hard compile error on-chip three times (scoped allocation 16.50M >
+    16.00M default) because selection trusted the static budget; a
+    user-path shape must never be a compile error."""
     import os
+    dtype = jnp.dtype(dtype)
     tp = round_up(t, block)
     env = os.environ.get("SLT_FLASH_ONEPASS_T")
     if env:   # empty string = unset, like SLT_FLASH_AUTO_T
         return tp <= int(env)
-    budget = _vmem_limit_bytes() * 2 // 3
-    return _onepass_resident_bytes(tp, d, itemsize) <= budget
+    resident = _onepass_resident_bytes(tp, d, dtype.itemsize)
+    if resident > _vmem_limit_bytes() * 2 // 3:
+        return False
+    if resident > _DEFAULT_LIMIT_SAFE and not use_interpret():
+        return _onepass_compile_ok(tp, round_up(d, LANE), block, dtype.name)
+    return True
+
+
+@functools.lru_cache(maxsize=None)
+def _onepass_compile_ok(tp: int, dp: int, block: int,
+                        dtype_name: str) -> bool:
+    """Preflight: does the one-pass backward *compile* on this device at
+    the padded shape? ``vmem_limit_bytes`` is serialized into the Mosaic
+    custom call as ``scoped_memory_configs`` (verified against the
+    lowered module — tests/test_flash_attention.py), but JAX documents
+    that XLA may additionally require ``--xla_tpu_scoped_vmem_limit_kib``
+    to honor it, and the only ground truth is the compiler's verdict on
+    the actual chip. AOT-compiles the backward pallas_call alone at
+    ``bh=1`` (per-grid-step VMEM residency is independent of the bh grid
+    dimension, so bh=1 is representative) and caches per process — one
+    ~seconds compile per distinct (padded T, padded D, block, dtype).
+    Mask flavor (causal/strict) is irrelevant to scoped allocation, so
+    the probe always uses ``causal=False``."""
+    call = _onepass_call(1, tp, tp, dp, block, 1.0, False, False,
+                         jnp.dtype(dtype_name))
+    seq = jax.ShapeDtypeStruct((1, tp, dp), jnp.dtype(dtype_name))
+    row = jax.ShapeDtypeStruct((1, tp, _ROWW), jnp.float32)
+    try:
+        jax.jit(call).lower(seq, seq, seq, seq, row, row).compile()
+        return True
+    except Exception:
+        return False
 
 
 # Measured speed crossover for the round-4 kernels (v5e, 2026-07-31
@@ -433,6 +491,38 @@ def _dkv_kernel(blk: int, t: int, scale: float, causal: bool,
 
 
 # --------------------------------------------------------------------- #
+def _onepass_call(bh: int, t: int, tp: int, dp: int, block: int,
+                  scale: float, causal: bool, strict: bool, in_dtype):
+    """The one-pass backward's ``pallas_call``, shared verbatim between
+    the real VJP (:func:`_make_flash`) and the preflight probe
+    (:func:`_onepass_compile_ok`) so the probe compiles exactly what the
+    user path would. Whole-sequence refs (index maps ignore the k grid
+    dim; dq revisits its block consecutively across k) against the
+    raised ``_vmem_limit_bytes()``, not Mosaic's 16 MiB default."""
+    n_blk = tp // block
+    seq = pl.BlockSpec((1, tp, dp), lambda b, k: (b, 0, 0),
+                       memory_space=pltpu.VMEM)
+    seqrow = pl.BlockSpec((1, tp, _ROWW), lambda b, k: (b, 0, 0),
+                          memory_space=pltpu.VMEM)
+    kblk = lambda: pl.BlockSpec((1, block, dp), lambda b, k: (b, k, 0),
+                                memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        functools.partial(_onepass_bwd_kernel, block, t, scale,
+                          causal, strict, n_blk),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, tp, dp), in_dtype),
+            jax.ShapeDtypeStruct((bh, tp, dp), in_dtype),
+            jax.ShapeDtypeStruct((bh, tp, dp), jnp.float32),
+        ),
+        grid=(bh, n_blk),
+        in_specs=[kblk(), kblk(), seq, seq, seqrow, seqrow],
+        out_specs=(kblk(), kblk(), seq),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_vmem_limit_bytes()),
+        interpret=use_interpret(),
+    )
+
+
 @functools.lru_cache(maxsize=None)
 def _make_flash(bh: int, t: int, d: int, causal: bool, dtype_name: str,
                 block: int, with_lse: bool = False, strict: bool = False,
@@ -516,31 +606,9 @@ def _make_flash(bh: int, t: int, d: int, causal: bool, dtype_name: str,
         delta = jnp.broadcast_to(delta, (bh, tp, _ROWW))
         if onepass:
             # mid-T fast path: one kernel, scores computed once per
-            # block pair; whole-sequence refs (index maps ignore the k
-            # grid dim; dq revisits its block consecutively across k)
-            seq = lambda: pl.BlockSpec((1, tp, dp), lambda b, k: (b, 0, 0),
-                                       memory_space=pltpu.VMEM)
-            seqrow = lambda: pl.BlockSpec(
-                (1, tp, _ROWW), lambda b, k: (b, 0, 0),
-                memory_space=pltpu.VMEM)
-            kblk = lambda: pl.BlockSpec((1, block, dp),
-                                        lambda b, k: (b, k, 0),
-                                        memory_space=pltpu.VMEM)
-            dk, dv, dq = pl.pallas_call(
-                functools.partial(_onepass_bwd_kernel, block, t, scale,
-                                  causal, strict, n_blk),
-                out_shape=(
-                    jax.ShapeDtypeStruct((bh, tp, dp), in_dtype),
-                    jax.ShapeDtypeStruct((bh, tp, dp), in_dtype),
-                    jax.ShapeDtypeStruct((bh, tp, dp), jnp.float32),
-                ),
-                grid=(bh, n_blk),
-                in_specs=[kblk(), kblk(), seq(), seq(), seqrow(),
-                          seqrow()],
-                out_specs=(kblk(), kblk(), seq()),
-                compiler_params=pltpu.CompilerParams(
-                    vmem_limit_bytes=_vmem_limit_bytes()),
-                interpret=use_interpret(),
+            # block pair (shared builder — see _onepass_call)
+            dk, dv, dq = _onepass_call(
+                bh, t, tp, dp, block, scale, causal, strict, in_dtype
             )(kp, vp, qp, dop, lse, delta)
             dq = dq.astype(in_dtype)
         else:
@@ -588,7 +656,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     b, t, h, d = q.shape
     block = _pick_block(t)
     fn = _make_flash(b * h, t, d, causal, str(q.dtype), block,
-                     onepass=_use_onepass(t, block, d, q.dtype.itemsize))
+                     onepass=_use_onepass(t, block, d, q.dtype))
 
     def fold(x):  # [B, T, H, D] -> [B*H, T, D]
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
@@ -620,7 +688,7 @@ def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
     block = _pick_block(t)
     fn = _make_flash(b * h, t, d, causal, str(q.dtype), block,
                      with_lse=True, strict=strict,
-                     onepass=_use_onepass(t, block, d, q.dtype.itemsize))
+                     onepass=_use_onepass(t, block, d, q.dtype))
 
     def fold(x):
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
