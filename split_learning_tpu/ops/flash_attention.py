@@ -203,7 +203,18 @@ def _onepass_compile_ok(tp: int, dp: int, block: int,
     try:
         jax.jit(call).lower(seq, seq, seq, seq, row, row).compile()
         return True
-    except Exception:
+    except Exception as e:
+        # Broad on purpose: ANY compile failure means the two-kernel
+        # split (always compilable) must take over. But the verdict is
+        # cached for the process, so make the demotion — and its true
+        # cause, VMEM rejection or probe bug or transient tunnel error
+        # — visible exactly once rather than silent.
+        import warnings
+        warnings.warn(
+            f"flash one-pass backward preflight failed at tp={tp} "
+            f"dp={dp} block={block} {dtype_name}; using the two-kernel "
+            f"split for this shape. Cause: {type(e).__name__}: "
+            f"{str(e)[:300]}", RuntimeWarning, stacklevel=2)
         return False
 
 

@@ -1,0 +1,75 @@
+"""Window-runner scheduling invariants (VERDICT r4, weak #1/#3/#5).
+
+Round 4's runner spent its one long live window on exploratory
+long-context legs and ended the round with no valid headline number,
+plus a 1,500 s decode timeout that ate 40 minutes of window. These
+tests pin the round-5 contract offline: the must-land set (headline,
+T=4096 flash, ViT, dense-T=1024 confirm) is ordered ahead of every
+exploratory leg and its expected walls — taken from round-4 recorded
+``wall_s`` where a twin leg exists — fit a single observed-median
+window, and no single leg budget can swallow a window whole.
+"""
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _runner():
+    path = os.path.join(REPO, "scripts", "tpu_window_runner.py")
+    spec = importlib.util.spec_from_file_location("tpu_window_runner", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_must_land_set_fits_one_window_budget():
+    r = _runner()
+    budget = sum(leg["expected_s"] for leg in r.MUST_LAND)
+    assert budget <= r.WINDOW_BUDGET_S, (
+        f"must-land legs expect {budget}s, over the {r.WINDOW_BUDGET_S}s "
+        "window planning budget — the round headline would again depend "
+        "on an unusually long window")
+
+
+def test_must_land_precedes_exploratory():
+    r = _runner()
+    ids = [leg["id"] for leg in r.LEGS]
+    must = [leg["id"] for leg in r.MUST_LAND]
+    assert ids[:len(must)] == must
+
+
+def test_leg_ids_unique_and_budgeted():
+    r = _runner()
+    ids = [leg["id"] for leg in r.LEGS]
+    assert len(ids) == len(set(ids))
+    for leg in r.LEGS:
+        # a budget below its own expected wall guarantees a timeout;
+        # one past 1.5x the window budget can eat the long observed
+        # window whole (round-4 decode.full: 1,500 s)
+        assert leg["expected_s"] <= leg["timeout"], leg["id"]
+        assert leg["timeout"] <= 1.5 * r.WINDOW_BUDGET_S, leg["id"]
+
+
+def test_decode_leg_is_tightened():
+    """The round-4 decode.full leg timed out at its own 1,500 s budget;
+    the round-5 confirmation shrinks the workload via bench.py's env
+    knobs AND halves the cap, so the worst case costs half a window."""
+    r = _runner()
+    decode = [leg for leg in r.LEGS if leg["role"] == "decode"]
+    assert decode, "decode confirmation leg missing"
+    for leg in decode:
+        assert leg["timeout"] <= 900
+        assert int(leg["env"].get("SLT_DECODE_PROMPT", "1024")) <= 512
+        assert int(leg["env"].get("SLT_DECODE_NEW", "256")) <= 128
+
+
+def test_sweep_legs_cover_pick_block_neighbours():
+    """The block sweep (VERDICT r4 #8) must bracket the incumbent 512
+    edge at the compute-bound and long-context shapes so _pick_block's
+    winner is chosen from data, not one measurement."""
+    r = _runner()
+    swept = {(leg["seq_len"], int(leg["env"]["SLT_FLASH_BLOCK"]))
+             for leg in r.LEGS if "SLT_FLASH_BLOCK" in leg.get("env", {})}
+    assert {(1024, 256), (1024, 1024), (4096, 256), (4096, 1024)} <= swept
