@@ -8,7 +8,7 @@ mid-leg. This runner inverts that: probe cheaply in a fresh process,
 and while the tunnel answers, burn down a *prioritized* leg list,
 appending every result to ``artifacts/tpu_window_runs.jsonl`` the
 moment it lands. A wedged leg sends us back to probing; completed legs
-are never re-run (state in ``/tmp/tpu_runner_state.json``).
+are never re-run (state in the round-keyed ``STATE`` file below).
 
 Legs reuse bench.py's subprocess protocol (fresh PJRT client per leg,
 every number carries bench.py's own publication gate).
@@ -269,19 +269,22 @@ def main():
                 f"sleeping {PROBE_INTERVAL}s")
             time.sleep(PROBE_INTERVAL)
             continue
-        append({"leg": "__canary__",
-                "status": "ok" if "tflops" in c else "error",
-                "result": c})
-        if "canary_error" in c:
-            # ADVICE r4: a window that answers the probe but fails the
-            # ~1 s matmul canary is sick — dispatching legs would burn
-            # their bounded MAX_ATTEMPTS on it. Same treatment as a
-            # down tunnel (the error record above still documents it).
-            err = c["canary_error"][:80]
-            log(f"tunnel answers but canary FAILED ({err}); treating "
-                f"as down, sleeping {PROBE_INTERVAL}s")
-            time.sleep(PROBE_INTERVAL)
-            continue
+        if isinstance(c, dict):
+            append({"leg": "__canary__",
+                    "status": "ok" if "tflops" in c else "error",
+                    "result": c})
+            if "canary_error" in c:
+                # ADVICE r4: a window that answers the probe but fails
+                # the ~1 s matmul canary is sick — dispatching legs
+                # would burn their bounded MAX_ATTEMPTS on it. Same
+                # treatment as a down tunnel (the error record above
+                # still documents the window, since the sickest windows
+                # are the ones that most need attributing).
+                err = c["canary_error"][:80]
+                log(f"tunnel answers but canary FAILED ({err}); "
+                    f"treating as down, sleeping {PROBE_INTERVAL}s")
+                time.sleep(PROBE_INTERVAL)
+                continue
         log(f"tunnel LIVE; canary {c}")
         for leg in remaining:
             if time.time() > DEADLINE:

@@ -139,21 +139,66 @@ def _plan_size_kw(model: str, size_kw: Dict[str, Any],
     return kw
 
 
+def _sig_defaults(builder, *names):
+    """Read parameter defaults off a plan builder's own signature —
+    the one source that cannot drift from the code (ADVICE r4: both the
+    size reconciliation and the vit patch guard hardcoded figures the
+    builders already declare)."""
+    import inspect
+    params = inspect.signature(builder).parameters
+    return {k: params[k].default for k in names if k in params}
+
+
+def _builder_size_defaults(model: str) -> Dict[str, Any]:
+    """The size-parameterized plan builders' effective defaults.
+    Families without size parameters return ``{}`` (their only valid
+    size request is "none")."""
+    if model in ("transformer", "transformer_lm"):
+        from split_learning_tpu.models.transformer import (
+            transformer_plan as builder)
+    elif model == "vit":
+        from split_learning_tpu.models.vit import vit_plan as builder
+    else:
+        return {}
+    return _sig_defaults(builder, "d_model", "num_heads",
+                         "client_depth", "server_depth")
+
+
 def _reconcile_ckpt_sizes(meta: Dict[str, Any], size_kw: Dict[str, Any],
-                          seq_len: Optional[int], what: str):
+                          seq_len: Optional[int], what: str,
+                          model: str = ""):
     """Adopt-or-refuse against a checkpoint's recorded model sizes.
     Returns ``(size_kw, seq_len, error)``: bare invocations adopt the
     saved sizes/seq_len; conflicting explicit ones return an error
-    string BEFORE any meta rewrite or restore can run."""
+    string BEFORE any meta rewrite or restore can run.
+
+    Saved and requested sizes are compared as *effective* plans — each
+    merged over the builder's signature defaults — so an explicit flag
+    that merely restates a default (``--d-model 64`` against a
+    default-size checkpoint, ADVICE r4) is accepted, and only flags
+    that would rebuild a genuinely different plan refuse."""
     saved = meta.get("size_kw", {})
-    if saved and not size_kw:
-        size_kw = dict(saved)
-        print(f"[ckpt] {what} with the checkpoint's model sizes "
-              f"{size_kw}", file=sys.stderr)
-    elif saved != size_kw:
+    defaults = _builder_size_defaults(model)
+    effective_saved = {**defaults, **saved}
+    # unspecified flags inherit the checkpoint's values (a subset of
+    # matching flags is a match, not a request for defaults)
+    effective_req = {**effective_saved, **size_kw}
+    if size_kw and effective_saved != effective_req:
+        keys = sorted(set(effective_saved) | set(effective_req))
+        conflicts = ", ".join(
+            f"{k}: saved {effective_saved.get(k)} != requested "
+            f"{effective_req.get(k)}" for k in keys
+            if effective_saved.get(k) != effective_req.get(k))
         return size_kw, seq_len, (
             f"checkpoint was written with sizes {saved or '{}'} but "
-            f"{what} requested {size_kw or '{}'}")
+            f"{what} requested {size_kw} ({conflicts})")
+    if saved and not size_kw:
+        print(f"[ckpt] {what} with the checkpoint's model sizes "
+              f"{saved}", file=sys.stderr)
+    # the persisted form is canonical either way: an explicit request
+    # that reached here is effectively identical, so rebuilding from
+    # `saved` reproduces the checkpoint's plan exactly
+    size_kw = dict(saved)
     saved_seq = meta.get("seq_len")
     if saved_seq:
         if seq_len is None:
@@ -271,7 +316,8 @@ def cmd_train(args) -> int:
             existing_meta = None
         if existing_meta is not None:
             size_kw, seq_len, err = _reconcile_ckpt_sizes(
-                existing_meta, size_kw, seq_len, "--resume")
+                existing_meta, size_kw, seq_len, "--resume",
+                model=cfg.model)
             if err:
                 print(f"[error] {err}", file=sys.stderr)
                 return 2
@@ -375,14 +421,18 @@ def cmd_train(args) -> int:
                 cfg = cfg.replace(seq_parallel=1)
             if cfg.seq_parallel > 1 and cfg.model == "vit":
                 # vit's token count is fixed by the image grid: the ring/
-                # Ulysses shard_map needs it divisible by the seq axis
+                # Ulysses shard_map needs it divisible by the seq axis.
+                # The patch size comes from vit_plan's own signature so
+                # this guard cannot drift from the builder (ADVICE r4)
+                from split_learning_tpu.models.vit import vit_plan
+                patch = _sig_defaults(vit_plan, "patch")["patch"]
                 h, w, _ = sample.shape[1:]
-                t_tokens = (h // 4) * (w // 4)   # vit_plan default patch=4
+                t_tokens = (h // patch) * (w // patch)
                 if t_tokens % cfg.seq_parallel:
                     print(f"[warn] --seq-parallel {cfg.seq_parallel} "
                           f"ignored: {t_tokens} patch tokens "
-                          f"({h}x{w}, patch 4) do not divide across it",
-                          file=sys.stderr)
+                          f"({h}x{w}, patch {patch}) do not divide "
+                          "across it", file=sys.stderr)
                     cfg = cfg.replace(seq_parallel=1)
             mesh = None
             if (cfg.num_clients > 1 or cfg.model_parallel > 1
@@ -746,7 +796,7 @@ def cmd_serve(args) -> int:
             prior = None
         if prior is not None:
             size_kw, seq_len, err = _reconcile_ckpt_sizes(
-                prior, size_kw, seq_len, "serve")
+                prior, size_kw, seq_len, "serve", model=cfg.model)
             if err:
                 print(f"[error] {err}", file=sys.stderr)
                 return 2
@@ -917,7 +967,9 @@ def _resolve_checkpoint(args, cfg, cmd: str, require_model: str = None):
     resolution (``args.X or meta[X] or cfg.X``), plan build, latest-or-
     ``--step`` pick, raw restore, full-composition assembly. Returns
     ``(None, rc)`` on user error, else ``((meta, mode, model, dataset,
-    plan, step, params), None)``."""
+    plan, step, params, seq_len), None)`` — the trailing ``seq_len`` is
+    the checkpoint-reconciled sequence extent the caller's dataset load
+    must use."""
     from split_learning_tpu.models import get_plan
     from split_learning_tpu.runtime.checkpoint import Checkpointer
 
@@ -938,7 +990,7 @@ def _resolve_checkpoint(args, cfg, cmd: str, require_model: str = None):
     # (the returned seq_len is what the caller's dataset load must use)
     size_kw, seq_len, err = _reconcile_ckpt_sizes(
         meta, _size_kw_from_args(args), getattr(args, "seq_len", None),
-        cmd)
+        cmd, model=model)
     if err:
         print(f"[error] {err}", file=sys.stderr)
         return None, 2

@@ -211,3 +211,41 @@ def test_serve_resume_from_joint_checkpoint(tmp_path, capsys):
     assert abs(remote["loss"] - local["loss"]) < 1e-3
     meta = json.loads((ck / "meta.json").read_text())
     assert meta["layout"] == "split_local"  # not clobbered to server_only
+
+
+def test_reconcile_sizes_accepts_explicit_defaults():
+    """ADVICE r4: explicit size flags that restate the builder's
+    defaults against a default-size checkpoint (and vice versa) must be
+    accepted — saved and requested sizes compare as *effective* plans,
+    merged over the builder signature's defaults. Only a flag that
+    would rebuild a different plan refuses."""
+    from split_learning_tpu.launch.run import _reconcile_ckpt_sizes
+
+    # default-size checkpoint (no size_kw persisted) + flags == defaults
+    kw, seq, err = _reconcile_ckpt_sizes(
+        {}, {"d_model": 64, "num_heads": 4}, None, "eval",
+        model="transformer")
+    assert err is None and kw == {}
+
+    # sized checkpoint + explicit flags restating the same values
+    meta = {"size_kw": {"d_model": 256, "num_heads": 2}}
+    kw, seq, err = _reconcile_ckpt_sizes(
+        meta, {"d_model": 256, "num_heads": 2}, None, "eval",
+        model="transformer")
+    assert err is None and kw == {"d_model": 256, "num_heads": 2}
+
+    # a flag subset whose values match the saved ones
+    kw, seq, err = _reconcile_ckpt_sizes(
+        meta, {"d_model": 256}, None, "eval", model="transformer")
+    assert err is None and kw == {"d_model": 256, "num_heads": 2}
+
+    # genuinely different plan still refuses, naming the conflict
+    kw, seq, err = _reconcile_ckpt_sizes(
+        meta, {"d_model": 128}, None, "eval", model="transformer")
+    assert err is not None and "d_model" in err
+
+    # default-size checkpoint + non-default flag refuses too (the
+    # saved plan was built at d_model=64)
+    kw, seq, err = _reconcile_ckpt_sizes(
+        {}, {"d_model": 128}, None, "eval", model="transformer")
+    assert err is not None and "d_model" in err

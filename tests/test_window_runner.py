@@ -133,19 +133,35 @@ def test_canary_record_lands_per_window(runner, monkeypatch):
     assert canary["result"]["tflops"] == 123.0
 
 
-def test_canary_error_still_recorded_and_deadline_assembles(
+def test_canary_error_skips_window_and_deadline_assembles(
         runner, monkeypatch):
+    """ADVICE r4: a window that answers the probe but fails the matmul
+    canary gets NO legs (it would burn bounded MAX_ATTEMPTS on a sick
+    chip) but still leaves its error record; the next healthy window
+    proceeds normally."""
     monkeypatch.setattr(runner, "LEGS", [
         {"id": "a", "role": "fused", "env": {}, "quick": True,
          "timeout": 9}])
-    monkeypatch.setattr(runner, "probe",
-                        lambda: {"canary_error": "no CANARY line"})
-    monkeypatch.setattr(runner, "run_leg",
-                        lambda leg: {"leg": leg["id"], "status": "ok",
-                                     "result": {"valid": True}})
+    probes = iter([{"canary_error": "no CANARY line"},
+                   {"tflops": 99.0}])
+    monkeypatch.setattr(runner, "probe", lambda: next(probes))
+    ran = []
+
+    def fake_run_leg(leg):
+        ran.append(leg["id"])
+        return {"leg": leg["id"], "status": "ok",
+                "result": {"valid": True}}
+
+    monkeypatch.setattr(runner, "run_leg", fake_run_leg)
     runner.main()
-    canary = next(r for r in read_out(runner) if r["leg"] == "__canary__")
-    assert canary["status"] == "error"
+    recs = read_out(runner)
+    kinds = [r["leg"] for r in recs]
+    # sick window: error canary recorded, leg NOT run in it; healthy
+    # window: ok canary, then the leg
+    assert ran == ["a"]
+    assert kinds.index("a") > kinds.index("__canary__")
+    statuses = [r["status"] for r in recs if r["leg"] == "__canary__"]
+    assert statuses == ["error", "ok"]
 
     # deadline exit also assembles (the likely exit on a flaky tunnel)
     assembled = []
