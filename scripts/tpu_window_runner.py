@@ -225,7 +225,8 @@ def run_assemblers() -> None:
     publication doesn't depend on an interactive session being alive
     (the assemblers park incomplete sweeps under non-pinned names)."""
     for script in ("assemble_long_context.py",
-                   "assemble_headline_artifact.py"):
+                   "assemble_headline_artifact.py",
+                   "assemble_block_sweep.py"):
         path = os.path.join(REPO, "scripts", script)
         try:
             out = subprocess.run([sys.executable, path],
