@@ -71,6 +71,24 @@ def _seq_len() -> int:
     return int(os.environ.get("SLT_BENCH_SEQ", str(SEQ_LEN)))
 
 
+def _active_flash_block(model: str, attn: str):
+    """The block edge a flash-kernel leg actually ran with (env
+    override, else _pick_block's choice for this leg's token count) —
+    None for non-flash legs. Frozen into the leg record so later
+    assemblers can attribute the number to the right kernel shape even
+    after _pick_block's defaults change."""
+    if attn not in ("flash", "ring_flash"):
+        return None
+    if model == "transformer":
+        t = _seq_len()
+    elif model == "vit":
+        t = 64   # 32x32 / patch 4 patch tokens (see _data)
+    else:
+        return None
+    from split_learning_tpu.ops.flash_attention import _pick_block
+    return int(_pick_block(t))   # env SLT_FLASH_BLOCK honored inside
+
+
 def _data(n_steps: int, model: str):
     import numpy as np
     rs = np.random.RandomState(0)
@@ -317,6 +335,11 @@ def measure_fused(quick: bool) -> dict:
         "attn": attn,
         "batch": batch,
         "seq_len": _seq_len() if model == "transformer" else None,
+        # the block edge the flash kernel actually ran with, frozen at
+        # measurement time: assemblers must never re-derive it from a
+        # later _pick_block (whose constant is exactly what sweep
+        # results get used to change)
+        "flash_block": _active_flash_block(model, attn),
         "dtype": dtype,
         "steps_per_sec": steps_per_sec,
         "step_ms": t_med / step_count * 1e3,

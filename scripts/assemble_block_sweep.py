@@ -73,7 +73,12 @@ def collect(records):
             if not m:
                 continue
             seq, batch = int(m.group(1)), int(m.group(2))
-            blk = _incumbent_block(seq)
+            # the edge the kernel ACTUALLY ran with, frozen into the
+            # record by bench.py at measurement time; records predating
+            # that field fall back to today's _pick_block, which is
+            # valid only while its defaults are unchanged since those
+            # measurements (true for the 2026-07-31 round-4 legs)
+            blk = rec["result"].get("flash_block") or _incumbent_block(seq)
         sps = rec["result"]["steps_per_sec"]
         cur = table.setdefault((seq, batch), {})
         cur[blk] = max(cur.get(blk, 0.0), sps)

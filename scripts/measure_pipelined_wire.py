@@ -182,13 +182,15 @@ def run_client(steps: int, depth: int, batches, plan, cfg):
         piped = PipelinedSplitClientTrainer(
             plan, cfg, jax.random.PRNGKey(0), transport, depth=depth,
             transport_factory=lambda: HttpTransport(url))
-        pairs = list(zip(x, y))
-        piped.train(lambda: iter(pairs[:2]), epochs=1)   # warm lanes
-        t0 = time.perf_counter()
-        piped.train(lambda: iter(pairs[2:steps + 2]), epochs=1,
-                    start_step=2)
-        dt = time.perf_counter() - t0
-        piped.close()
+        try:
+            pairs = list(zip(x, y))
+            piped.train(lambda: iter(pairs[:2]), epochs=1)  # warm lanes
+            t0 = time.perf_counter()
+            piped.train(lambda: iter(pairs[2:steps + 2]), epochs=1,
+                        start_step=2)
+            dt = time.perf_counter() - t0
+        finally:
+            piped.close()
         return steps / dt, url
     finally:
         transport.close()

@@ -79,9 +79,14 @@ def main() -> int:
                 rec["attempted_real_data"] = attempt
                 hit = True
         if hit:
-            with open(path, "w") as f:
+            # atomic (tmp + rename), the datasets.py convention: a kill
+            # mid-write must never truncate committed parity curves
+            # this script only re-stamps a date into
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
                 for rec in records:
                     f.write(json.dumps(rec) + "\n")
+            os.replace(tmp, path)
             refreshed.append(os.path.relpath(path, REPO))
     print(json.dumps({"real_data": "blocked", "attempt": attempt,
                       "refreshed": refreshed}))
