@@ -1,0 +1,232 @@
+#!/usr/bin/env python
+"""Where the T=1024 flash MFU ceiling actually is (VERDICT r4 #8).
+
+The round-4 window measured the flash transformer leg at 36% reported
+MFU vs ResNet's 63.7%, and asked for either >45% or "a documented
+ceiling analysis". This script IS that analysis, computed — not
+asserted — from the bench leg's own plan:
+
+1. count the dense-equivalent matmul FLOPs of the exact bench step
+   (the MFU denominator bench.py uses) with the jaxpr counter;
+2. split out the attention-math share (scores + PV and their backward,
+   the only FLOPs the flash kernel owns) analytically from the same
+   shapes — with the traced total cross-checked against the
+   ``flops_per_step`` the on-chip leg itself recorded;
+3. fold in the flash form's recompute factor (one-pass backward: 10
+   matmul units of T^2*D vs dense's 8 — ops/flash_attention.py module
+   docstring) to get the kernel's true executed FLOPs;
+4. read the measured round-4 steps/sec from the committed artifact and
+   derive (a) the hardware MFU the chip actually sustained counting
+   executed FLOPs, and (b) the Amdahl ceiling for ANY attention-kernel
+   improvement at this shape: with attention infinitely fast, steps/s
+   is bounded by the non-attention trunk at its own measured
+   efficiency.
+
+Writes ``artifacts/flash_ceiling_analysis.json``. Pure CPU (tracing
+only) — no TPU needed; run after any kernel or model-shape change.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from split_learning_tpu.utils.backend import reexec_pinned_cpu  # noqa: E402
+
+ARTIFACT = os.path.join(REPO, "artifacts",
+                        "bench_tpu_transformer_2026-07-31.json")
+
+
+def _v5e_peak() -> float:
+    """The v5e bf16 peak from the repo's own table (utils/flops.py) —
+    never a second hardcoded copy that can drift."""
+    from split_learning_tpu.utils.flops import _PEAK_BF16_FLOPS
+    return dict(_PEAK_BF16_FLOPS)["v5"]
+
+
+def bench_plan_flops(t: int, batch: int):
+    """Dense-step FLOPs of the exact bench transformer shape
+    (bench.py measure_fused kwargs), total and attention-only."""
+    import jax
+    import numpy as np
+
+    from split_learning_tpu.core.losses import cross_entropy
+    from split_learning_tpu.models.transformer import transformer_plan
+    from split_learning_tpu.utils.flops import jaxpr_matmul_flops
+
+    kw = dict(mode="split", dtype=np.dtype("bfloat16"), d_model=256,
+              num_heads=2, max_len=max(2048, t))
+    plan = transformer_plan(attn="full", **kw)
+    rs = np.random.RandomState(0)
+    x = rs.randint(0, 256, (batch, t)).astype(np.int32)
+    y = rs.randint(0, 10, (batch,))
+    params = jax.eval_shape(lambda: plan.init(jax.random.PRNGKey(0), x))
+
+    def step(p, xb, yb):
+        return jax.value_and_grad(
+            lambda q: cross_entropy(plan.apply(q, xb), yb))(p)
+
+    total = jaxpr_matmul_flops(step, params, x, y)
+
+    # attention math the flash kernel owns: per layer, fwd scores
+    # (2*B*H*T^2*D) + PV (same); dense backward re-uses saved P for 4
+    # more T^2*D matmuls -> 12 units of B*H*T^2*D per layer, 2 FLOPs
+    # per MAC already folded into the unit
+    n_layers = 3   # client_depth 1 + server_depth 2 (builder defaults)
+    h, d = 2, 128
+    unit = 2 * batch * h * t * t * d
+    attn_dense = n_layers * 6 * unit          # fwd 2 + bwd 4 units
+    return total, attn_dense, n_layers
+
+
+def main() -> int:
+    import numpy as np  # noqa: F401
+
+    t, batch = 1024, 64
+    total, attn_dense, n_layers = bench_plan_flops(t, batch)
+
+    with open(ARTIFACT) as f:
+        art = json.load(f)
+    legs = {(l.get("seq_len"), l.get("attn")): l for l in art["legs"]}
+    flash = legs.get((t, "flash"))
+    if flash is None:
+        raise SystemExit(f"no T={t} flash leg in {ARTIFACT}")
+    # dense comparator: the round-3 artifact's T=1024 leg — the honest
+    # dense number while the round-4 window read (2.61, 16x low) sits
+    # in SUSPECT quarantine (scripts/assemble_long_context.py)
+    dense_sps = dense_src = None
+    r3 = os.path.join(REPO, "artifacts",
+                      "bench_tpu_transformer_2026-07-30.json")
+    if os.path.exists(r3):
+        with open(r3) as f:
+            for l in json.load(f)["legs"]:
+                if l.get("seq_len") == t and l.get("attn") == "full" \
+                        and l.get("valid"):
+                    dense_sps = l["steps_per_sec"]
+                    dense_src = os.path.relpath(r3, REPO)
+
+    PEAK = _v5e_peak()
+    measured_sps = flash["steps_per_sec"]
+    reported_mfu = flash["util_vs_bf16_peak"]
+    # the traced step must be the leg's step: the on-chip record
+    # carries its own jaxpr FLOP count
+    drift = abs(total - flash["flops_per_step"]) / flash["flops_per_step"]
+    if drift > 0.01:
+        raise SystemExit(
+            f"traced FLOPs ({total:.3e}) diverge {drift:.1%} from the "
+            f"leg's recorded flops_per_step "
+            f"({flash['flops_per_step']:.3e}) — bench shape changed "
+            "since the artifact; re-measure before analyzing")
+
+    # the one-pass backward executes 10 units of T^2*D where dense
+    # executes 8, and both forwards execute 4 (module docstring,
+    # ops/flash_attention.py) -> executed attention FLOPs are
+    # (4+10)/(4+8) of the dense-equivalent attention count
+    recompute = (4 + 10) / (4 + 8)
+    executed = total - attn_dense + attn_dense * recompute
+    hardware_mfu = measured_sps * executed / PEAK
+
+    # Two attention-free numbers, carefully labeled — time was never
+    # profiled, so FLOP shares stand in for time only under an explicit
+    # assumption:
+    # (a) equal-efficiency ESTIMATE: if attention and trunk sustain the
+    #     step's average hardware efficiency, attention's executed-FLOP
+    #     share IS its time share, and removing it yields
+    #     measured/(1-share). If the flash kernel is less efficient
+    #     than the trunk the true attention-free speed is HIGHER;
+    #     if more efficient, lower. An estimate, not a bound.
+    # (b) hard CAP: the trunk cannot run above chip peak, so
+    #     attention-free steps/s <= PEAK / trunk_flops regardless of
+    #     any efficiency assumption. A true bound, necessarily loose.
+    attn_exec_share = attn_dense * recompute / executed
+    est_sps = measured_sps / (1 - attn_exec_share)
+    est_reported_mfu = est_sps * total / PEAK
+    trunk_flops = total - attn_dense
+    cap_sps = PEAK / trunk_flops
+    cap_reported_mfu = cap_sps * total / PEAK
+
+    out = {
+        "provenance": {
+            "date": time.strftime("%Y-%m-%d"),
+            "command": "scripts/flash_ceiling_analysis.py",
+            "measured_from": os.path.relpath(ARTIFACT, REPO),
+            "shape": {"seq_len": t, "batch": batch, "d_model": 256,
+                      "heads": 2, "head_dim": 128, "layers": n_layers},
+        },
+        "flops_per_step_dense_equivalent": total,
+        "attention_share_of_dense_flops": round(attn_dense / total, 4),
+        "flash_recompute_factor": round(recompute, 4),
+        "measured": {
+            "flash_steps_per_sec": measured_sps,
+            "flash_reported_mfu": reported_mfu,
+            "dense_steps_per_sec_r3": dense_sps,
+            "dense_source": dense_src,
+            "dense_note": "round-4 same-artifact dense leg (2.61) is "
+                          "SUSPECT-quarantined; the round-3 figure is "
+                          "the standing dense number",
+        },
+        "derived": {
+            "hardware_mfu_counting_executed_flops": round(
+                hardware_mfu, 4),
+            "attention_share_of_executed_flops": round(
+                attn_exec_share, 4),
+            "attention_free_estimate_equal_efficiency": {
+                "steps_per_sec": round(est_sps, 2),
+                "reported_mfu": round(est_reported_mfu, 4),
+                "assumption": "attention and trunk sustain the step's "
+                              "average hardware efficiency (time never "
+                              "profiled; FLOP share stands in for time "
+                              "share only under this assumption)",
+            },
+            "attention_free_hard_cap": {
+                "steps_per_sec": round(cap_sps, 2),
+                "reported_mfu": round(cap_reported_mfu, 4),
+                "assumption": "none: the trunk cannot exceed chip peak",
+            },
+        },
+        "conclusion": (
+            f"At T={t} attention is {attn_dense / total:.0%} of the "
+            "step's dense-equivalent FLOPs "
+            f"({attn_exec_share:.0%} of executed FLOPs with the "
+            "one-pass recompute folded in); the non-attention trunk "
+            "(embeds/projections/MLP) owns the rest. Counting FLOPs "
+            "the chip actually executed, the leg sustains "
+            f"{hardware_mfu:.0%} hardware MFU — above the "
+            f"{reported_mfu:.0%} reported figure, whose denominator "
+            "credits no recompute. Removing attention entirely yields "
+            f"~{est_sps:.0f} steps/s (~{est_reported_mfu:.0%} reported "
+            "MFU) under the stated equal-efficiency assumption, and "
+            f"can never exceed {cap_sps:.0f} steps/s "
+            f"({cap_reported_mfu:.0%}) since the trunk is bound by "
+            "chip peak — so attention-side tuning (block sweep, "
+            "scripts/assemble_block_sweep.py) moves the leg toward "
+            "the former figure, and closing the remaining distance to "
+            "ResNet's 63.7% requires trunk efficiency (XLA's "
+            "territory), not kernel work."
+            + (f" The practical bar 'flash >= dense at this shape' is "
+               f"already met: {measured_sps:.1f} vs {dense_sps:.1f} "
+               f"steps/s ({dense_src})." if dense_sps else "")),
+    }
+    path = os.path.join(REPO, "artifacts", "flash_ceiling_analysis.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(out, f, indent=1)
+    os.replace(tmp, path)
+    print(json.dumps({"attention_share": out[
+        "attention_share_of_dense_flops"],
+        "attention_free_estimate_mfu": out["derived"][
+            "attention_free_estimate_equal_efficiency"]["reported_mfu"],
+        "attention_free_hard_cap_mfu": out["derived"][
+            "attention_free_hard_cap"]["reported_mfu"],
+        "artifact": path}))
+    return 0
+
+
+if __name__ == "__main__":
+    reexec_pinned_cpu()
+    raise SystemExit(main())
