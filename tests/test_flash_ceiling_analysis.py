@@ -32,10 +32,13 @@ def test_internally_consistent(art):
     cap = d["attention_free_hard_cap"]
     # both attention-free figures dominate the measurement, and the
     # assumption-free cap dominates the assumption-laden estimate
+    # (the cap deliberately has no reported-MFU form: the ratio
+    # exceeds 1.0 once unexecuted attention FLOPs stay in the
+    # numerator — a metric artifact, not a utilization)
     assert est["steps_per_sec"] > m["flash_steps_per_sec"]
     assert cap["steps_per_sec"] > est["steps_per_sec"]
-    assert cap["reported_mfu"] > est["reported_mfu"] > \
-        m["flash_reported_mfu"]
+    assert "reported_mfu" not in cap
+    assert est["reported_mfu"] > m["flash_reported_mfu"]
     # each figure states what it assumes — the estimate is NOT a bound
     assert "assumption" in est and "profiled" in est["assumption"]
     assert cap["assumption"].startswith("none")
