@@ -744,6 +744,26 @@ def _latest_tpu_artifact() -> tuple[str, dict] | None:
     return best
 
 
+def headline_route(fused: dict) -> str:
+    """Which path the headline takes, in priority order:
+
+    - ``"degraded"``: the intended TPU backend was unavailable and the
+      fused leg fell back to CPU — replay the newest committed gated
+      TPU artifact. This outranks the validity gate: the CPU fallback
+      is context, not the number, and its linearity can flake under
+      single-core contention; a flaky context figure must never null a
+      round that has a committed artifact to stand on.
+    - ``"invalid"``: the leg that WAS the intended measurement failed
+      the publication gate — null headline, exit nonzero.
+    - ``"publish"``: gate-passing measurement on the intended platform.
+    """
+    if fused.get("platform") == "cpu" and _tpu_intended():
+        return "degraded"
+    if not fused.get("valid", False):
+        return "invalid"
+    return "publish"
+
+
 def _emit_degraded_headline(fused: dict) -> bool:
     """The intended TPU backend was unavailable and the fused leg fell
     back to CPU. A bare CPU number in the TPU slot reads as a ~750x
@@ -771,7 +791,12 @@ def _emit_degraded_headline(fused: dict) -> bool:
             "artifact": path,
             "artifact_date": rec.get("provenance", {}).get("date"),
             "degraded_reason": reason,
+            # context only, and self-describing: since the reorder
+            # (headline_route) this figure may itself have failed the
+            # publication gate — its validity must ride along
             "cpu_fallback_steps_per_sec": round(fused["steps_per_sec"], 2),
+            "cpu_fallback_valid": fused.get("valid", False),
+            "cpu_fallback_invalid_reason": fused.get("invalid_reason"),
         }))
         return True
     print(json.dumps({
@@ -783,6 +808,8 @@ def _emit_degraded_headline(fused: dict) -> bool:
         "degraded": True,
         "degraded_reason": reason + "; no committed TPU artifact to replay",
         "cpu_fallback_steps_per_sec": round(fused["steps_per_sec"], 2),
+        "cpu_fallback_valid": fused.get("valid", False),
+        "cpu_fallback_invalid_reason": fused.get("invalid_reason"),
     }))
     return False
 
@@ -1021,10 +1048,20 @@ def main() -> None:
 
     print(f"[bench] detail: {json.dumps(detail)}", file=sys.stderr)
 
-    # THE GATE (README "every published figure must pass steps/sec x
-    # FLOPs/step <= chip peak", enforced since round 3): an invalid
-    # measurement publishes null + the reason, never the number.
-    if not fused.get("valid", False):
+    # One dispatch for all three routes — the priority (and its
+    # rationale: replay-over-null when the tunnel is wedged, the
+    # validity gate for measurements on the intended platform) lives
+    # in headline_route's docstring, and tests pin it there.
+    route = headline_route(fused)
+    if route == "degraded":
+        if not _emit_degraded_headline(fused):
+            sys.exit(1)  # no number this round, like the other null paths
+        return
+    if route == "invalid":
+        # THE GATE (README "every published figure must pass steps/sec
+        # x FLOPs/step <= chip peak", enforced since round 3): an
+        # invalid measurement publishes null + the reason, never the
+        # number.
         reason = fused.get("invalid_reason") or "leg reported valid=false"
         print(f"[bench] headline INVALID: {reason}", file=sys.stderr)
         print(json.dumps({"metric": "mnist_split_cnn_steps_per_sec",
@@ -1038,13 +1075,6 @@ def main() -> None:
         print(f"[bench] sanity: {fused['steps_per_sec']:.0f} steps/s vs "
               f"ceiling {ceiling:.0f} steps/s at 100% bf16 peak "
               f"(util {fused['util_vs_bf16_peak']:.3f})", file=sys.stderr)
-
-    if fused.get("platform") == "cpu" and _tpu_intended():
-        # never publish a bare CPU number in the TPU slot (VERDICT r3
-        # weak #1: BENCH_r03's parsed block read as a 750x regression)
-        if not _emit_degraded_headline(fused):
-            sys.exit(1)  # no number this round, like the other null paths
-        return
 
     print(json.dumps({
         "metric": "mnist_split_cnn_steps_per_sec",

@@ -159,3 +159,35 @@ def test_grow_window_clears_timing_floor():
     # the cap bounds pathological growth
     assert bench.grow_window(lambda n: (0.0, 0.0), 2, floor_s=1.0,
                              cap=16) == 16
+
+
+def test_headline_route_priority():
+    """Replay-over-null (round-5 fix): a wedged tunnel's CPU fallback
+    leg routes to the degraded replay even when that fallback's OWN
+    linearity flaked invalid (observed 2026-08-01: contention put the
+    CPU context leg at 1.23 and the old ordering nulled a round that
+    had a committed gated TPU artifact to replay). The validity gate
+    still nulls measurements on the intended platform."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    cpu_invalid = {"platform": "cpu", "valid": False,
+                   "invalid_reason": "linearity_2x=1.23 ..."}
+    cpu_valid = {"platform": "cpu", "valid": True}
+    tpu_invalid = {"platform": "tpu", "valid": False,
+                   "invalid_reason": "linearity"}
+    tpu_valid = {"platform": "tpu", "valid": True}
+
+    real = bench._tpu_intended
+    try:
+        bench._tpu_intended = lambda: True   # a tunnel exists here
+        assert bench.headline_route(cpu_invalid) == "degraded"
+        assert bench.headline_route(cpu_valid) == "degraded"
+        assert bench.headline_route(tpu_invalid) == "invalid"
+        assert bench.headline_route(tpu_valid) == "publish"
+
+        bench._tpu_intended = lambda: False  # CPU-only host: CPU is honest
+        assert bench.headline_route(cpu_invalid) == "invalid"
+        assert bench.headline_route(cpu_valid) == "publish"
+    finally:
+        bench._tpu_intended = real

@@ -82,12 +82,17 @@ def test_speedup_physically_plausible(art):
     per-point compute/wire decomposition would gate on noise — the
     artifact's note records that the overlap hides per-request
     overheads alongside the injected wire."""
+    depth = art["depth"]
     for p in art["points"]:
-        assert p["pipelining_speedup"] <= art["depth"], (
+        assert p["pipelining_speedup"] <= depth, (
             f"speedup {p['pipelining_speedup']} at "
             f"{p['one_way_delay_measured_ms']}ms exceeds the "
-            f"depth-{art['depth']} window's hard cap")
-        # both runs must be real execution at sane absolute rates:
-        # lock-step pays at least the measured RTT per step
+            f"depth-{depth} window's hard cap")
+        # both runs must be real execution at sane absolute rates —
+        # noise-immune wire floors: lock-step pays the full measured
+        # RTT per step, and even W perfectly overlapped lanes each
+        # still pay it (so the windowed rate floors at RTT/W per step)
         rtt_s = 2 * p["one_way_delay_measured_ms"] / 1e3
         assert 1.0 / p["steps_per_sec_sync"] >= rtt_s * 0.9
+        assert 1.0 / p[f"steps_per_sec_depth{depth}"] >= \
+            (rtt_s / depth) * 0.9
