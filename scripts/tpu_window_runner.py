@@ -130,7 +130,18 @@ EXPLORATORY = [
 
 LEGS = MUST_LAND + EXPLORATORY
 
+# Exploratory legs get 3 tries; the must-land set gets 5 — a short
+# window that dies mid-leg burns an attempt (status timeout), and the
+# round's priority legs must not be exhausted by three unlucky windows
+# the way round 4's T=4096 flash was by three compile errors.
 MAX_ATTEMPTS = 3
+MUST_LAND_ATTEMPTS = 5
+
+
+def max_attempts(leg) -> int:
+    return (MUST_LAND_ATTEMPTS
+            if any(leg["id"] == m["id"] for m in MUST_LAND)
+            else MAX_ATTEMPTS)
 
 
 def log(msg):
@@ -256,7 +267,7 @@ def main():
                     "done": st["done"]})
             return
         remaining = [l for l in LEGS if l["id"] not in st["done"]
-                     and st["attempts"].get(l["id"], 0) < MAX_ATTEMPTS]
+                     and st["attempts"].get(l["id"], 0) < max_attempts(l)]
         if not remaining:
             log("all legs done or exhausted; assembling artifacts "
                 "and exiting")

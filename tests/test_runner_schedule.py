@@ -73,3 +73,15 @@ def test_sweep_legs_cover_pick_block_neighbours():
     swept = {(leg["seq_len"], int(leg["env"]["SLT_FLASH_BLOCK"]))
              for leg in r.LEGS if "SLT_FLASH_BLOCK" in leg.get("env", {})}
     assert {(1024, 256), (1024, 1024), (4096, 256), (4096, 1024)} <= swept
+
+
+def test_must_land_legs_get_more_attempts():
+    """A short window that dies mid-leg burns an attempt; the round's
+    priority legs must survive more unlucky windows than exploratory
+    ones (round 4's T=4096 flash was exhausted by exactly 3)."""
+    r = _runner()
+    for leg in r.MUST_LAND:
+        assert r.max_attempts(leg) == r.MUST_LAND_ATTEMPTS
+    for leg in r.EXPLORATORY:
+        assert r.max_attempts(leg) == r.MAX_ATTEMPTS
+    assert r.MUST_LAND_ATTEMPTS > r.MAX_ATTEMPTS
