@@ -136,11 +136,11 @@ LEGS = MUST_LAND + EXPLORATORY
 # the way round 4's T=4096 flash was by three compile errors.
 MAX_ATTEMPTS = 3
 MUST_LAND_ATTEMPTS = 5
+_MUST_LAND_IDS = {m["id"] for m in MUST_LAND}
 
 
 def max_attempts(leg) -> int:
-    return (MUST_LAND_ATTEMPTS
-            if any(leg["id"] == m["id"] for m in MUST_LAND)
+    return (MUST_LAND_ATTEMPTS if leg["id"] in _MUST_LAND_IDS
             else MAX_ATTEMPTS)
 
 

@@ -3,9 +3,10 @@
 The runner is round-critical infrastructure (every on-chip number this
 round flows through it), so its state machine is pinned: completed legs
 are never re-run, a timeout/error breaks back to probing without
-burning an attempt on every remaining leg, attempts cap at
-MAX_ATTEMPTS, and the deadline frees the tunnel for the round-end
-driver bench."""
+burning an attempt on every remaining leg, attempts cap per leg class
+(MAX_ATTEMPTS for exploratory, MUST_LAND_ATTEMPTS for the round's
+priority set — tests/test_runner_schedule.py), and the deadline frees
+the tunnel for the round-end driver bench."""
 
 import importlib.util
 import json
