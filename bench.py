@@ -589,6 +589,109 @@ def measure_pipelined(quick: bool) -> dict:
     return out
 
 
+def measure_flash_micro(quick: bool) -> dict:
+    """Kernel-level flash block sweep: fwd and fwd+bwd timed SEPARATELY
+    per block edge (VERDICT r4 #8 asked for exactly this split — the
+    full-step `sweep.*` legs answer which edge wins end-to-end, this
+    role says WHERE the win/loss lives). One subprocess covers every
+    edge at one (T, batch) so a single window leg yields the whole
+    row.
+
+    Timing discipline matches the fused leg: each timed window is
+    closed by a host transfer of a data-dependent scalar, re-timed at
+    2x repetitions for the linearity cross-check, and the whole record
+    is gated by the same util<=1 rule per cell (attention-only FLOPs).
+
+    Env: SLT_BENCH_SEQ (default 4096), SLT_BENCH_BATCH (default 16),
+    SLT_FLASH_MICRO_BLOCKS (comma list, default "256,512,1024")."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from split_learning_tpu.ops.flash_attention import flash_attention
+    from split_learning_tpu.utils.flops import device_peak_flops
+
+    t = _seq_len() if os.environ.get("SLT_BENCH_SEQ") else 4096
+    batch = int(os.environ.get("SLT_BENCH_BATCH", "16"))
+    heads, d = 2, 128
+    blocks = [int(b) for b in os.environ.get(
+        "SLT_FLASH_MICRO_BLOCKS", "256,512,1024").split(",")]
+    reps = 4 if quick else 16
+
+    if jax.default_backend() == "cpu":
+        # interpret-mode kernels at T=4096 take hours on CPU; shrink to
+        # a smoke shape so the role stays runnable everywhere
+        t, batch, reps = 256, 4, 2
+
+    q, k, v = (jax.random.normal(jax.random.PRNGKey(i),
+                                 (batch, t, heads, d), jnp.bfloat16)
+               for i in range(3))
+    device = q.devices().pop()
+    peak = device_peak_flops(device)
+    # dense-equivalent attention FLOPs (the sweep compares edges, so
+    # the shared denominator only needs to be consistent): fwd 4 units
+    # of B*H*T^2*D MACs, bwd 8 more (2 FLOPs per MAC in the unit)
+    unit = 2 * batch * heads * t * t * d
+    flops_fwd = 2 * unit
+    flops_step = 6 * unit
+
+    def timed(fn, n):
+        t0 = time.perf_counter()
+        s = 0.0
+        for _ in range(n):
+            s = fn()
+        float(s)   # host transfer: data-dependent close
+        return time.perf_counter() - t0
+
+    cells = []
+    for block in blocks:
+        os.environ["SLT_FLASH_BLOCK"] = str(block)
+        try:
+            fwd = jax.jit(lambda a, b, c: flash_attention(
+                a, b, c, causal=True).astype(jnp.float32).sum())
+            bwd = jax.jit(jax.grad(lambda a: flash_attention(
+                a, k, v, causal=True).astype(jnp.float32).sum()))
+            fwd_c = lambda: fwd(q, k, v)
+            bwd_c = lambda: bwd(q).astype(jnp.float32).sum()
+            for f in (fwd_c, bwd_c):
+                f() and None   # compile + warm
+            t_fwd = timed(fwd_c, reps) / reps
+            lin_fwd = timed(fwd_c, 2 * reps) / (t_fwd * reps)
+            t_bwd = timed(bwd_c, reps) / reps
+            lin_bwd = timed(bwd_c, 2 * reps) / (t_bwd * reps)
+        except Exception as e:   # a rejected edge is a result, not a crash
+            cells.append({"block": block, "error":
+                          f"{type(e).__name__}: {str(e)[:200]}"})
+            continue
+        finally:
+            os.environ.pop("SLT_FLASH_BLOCK", None)
+        cell = {
+            "block": block,
+            "fwd_ms": t_fwd * 1e3,
+            "fwd_plus_bwd_ms": t_bwd * 1e3,
+            "bwd_only_ms_est": (t_bwd - t_fwd) * 1e3,
+            "fwd_tflops": flops_fwd / t_fwd / 1e12,
+            "step_tflops": flops_step / t_bwd / 1e12,
+            "linearity_2x_fwd": lin_fwd,
+            "linearity_2x_bwd": lin_bwd,
+            "util_fwd": (flops_fwd / t_fwd / peak) if peak else None,
+        }
+        cell["valid"] = (
+            (cell["util_fwd"] is None or cell["util_fwd"] <= 1.0)
+            and 1.5 <= lin_fwd <= 2.6 and 1.5 <= lin_bwd <= 2.6)
+        cells.append(cell)
+
+    return {
+        "leg": "flash_micro", "seq_len": t, "batch": batch,
+        "heads": heads, "head_dim": d, "dtype": "bfloat16",
+        "platform": device.platform,
+        "device_kind": getattr(device, "device_kind", "") or "",
+        "reps": reps, "cells": cells,
+        # the record is usable iff at least one cell measured cleanly
+        "valid": any(c.get("valid") for c in cells),
+    }
+
+
 def measure_decode(quick: bool) -> dict:
     """Autoregressive decode throughput (tokens/s) of the KV-cache path
     vs the O(T^2) re-forward path, same LM plan (runtime/generate.py).
@@ -862,7 +965,7 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--role",
                     choices=["baseline", "fused", "dp", "wire", "pipelined",
-                             "decode"],
+                             "decode", "flash_micro"],
                     default=None)
     ap.add_argument("--quick", action="store_true")
     args = ap.parse_args()
@@ -872,7 +975,8 @@ def main() -> None:
         fn = {"baseline": measure_baseline, "fused": measure_fused,
               "dp": measure_dp, "wire": measure_wire,
               "pipelined": measure_pipelined,
-              "decode": measure_decode}[args.role]
+              "decode": measure_decode,
+              "flash_micro": measure_flash_micro}[args.role]
         print(json.dumps(fn(args.quick)))
         return
 

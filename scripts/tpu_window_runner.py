@@ -121,6 +121,13 @@ EXPLORATORY = [
     _t_leg(4096, 16, "flash", True, 1200, expected_s=300, block=256),
     _t_leg(4096, 16, "flash", True, 1200, expected_s=300, block=1024),
     _t_leg(8192, 16, "flash", True, 1500, expected_s=360, block=1024),
+    # kernel-level fwd/bwd-split block sweep (VERDICT r4 #8's exact
+    # ask): one leg yields every edge's fwd and fwd+bwd timing at
+    # T=4096 b16, so end-to-end sweep wins can be attributed to the
+    # forward or the backward
+    {"id": "flash_micro.T4096", "role": "flash_micro",
+     "env": {"SLT_BENCH_SEQ": "4096", "SLT_BENCH_BATCH": "16"},
+     "quick": True, "timeout": 1200, "expected_s": 420},
     # T=256 re-measure on the round-4 kernels (round-3 kernels had
     # dense ahead 353 vs 204; the adaptive block may have moved it)
     _t_leg(256, 64, "flash", True, 900, expected_s=240),
