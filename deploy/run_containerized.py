@@ -42,6 +42,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import shlex
 import shutil
 import subprocess
 import sys
@@ -135,7 +136,8 @@ def container_argv(command, extra_env, hostname):
     uid 1000 -> exec the manifest command with the image ENV."""
     env = dict(IMAGE_ENV)
     env.update(extra_env)
-    env_args = " ".join(f"{k}={_shq(v)}" for k, v in env.items())
+    env_args = " ".join(f"{k}={shlex.quote(str(v))}"
+                        for k, v in env.items())
     # host binds remount read-only (top mount; /dev keeps its submounts
     # and stays rw — it needs writable /dev/shm), so the container
     # cannot write through them even where host perms would allow
@@ -152,14 +154,10 @@ mount -t proc proc {ROOTFS}/proc
 {binds}
 exec chroot {ROOTFS} /usr/bin/setpriv --reuid 1000 --regid 1000 \
   --clear-groups /usr/bin/env -i {env_args} \
-  sh -c 'cd /app && exec "$@"' -- {" ".join(_shq(c) for c in command)}
+  sh -c 'cd /app && exec "$@"' -- {" ".join(shlex.quote(c) for c in command)}
 """
     return ["unshare", "--mount", "--pid", "--fork", "--uts",
             "sh", "-euc", script]
-
-
-def _shq(s: str) -> str:
-    return "'" + str(s).replace("'", "'\\''") + "'"
 
 
 def main() -> int:

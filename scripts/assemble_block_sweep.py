@@ -21,11 +21,11 @@ import argparse
 import json
 import os
 import re
+import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 RUNS = os.path.join(REPO, "artifacts", "tpu_window_runs.jsonl")
-import sys  # noqa: E402
 sys.path.insert(0, REPO)
 
 _SWEEP = re.compile(r"^sweep\.T(\d+)\.b(\d+)\.flash\.blk(\d+)$")
@@ -35,10 +35,18 @@ _MAIN = re.compile(r"^T(\d+)\.b(\d+)\.flash\.(q|full)$")
 def _incumbent_block(seq: int) -> int:
     """What `_pick_block` itself chooses for this T — imported, never
     re-derived, so the artifact can't misattribute a main-leg number
-    to a block edge the kernel didn't use."""
-    os.environ.pop("SLT_FLASH_BLOCK", None)   # env would shadow the default
+    to a block edge the kernel didn't use. The env override is masked
+    (and restored) rather than popped: assembling must not mutate the
+    caller's environment."""
     from split_learning_tpu.ops.flash_attention import _pick_block
-    return _pick_block(seq)
+    saved = os.environ.pop("SLT_FLASH_BLOCK", None)
+    try:
+        return _pick_block(seq)
+    finally:
+        if saved is not None:
+            os.environ["SLT_FLASH_BLOCK"] = saved
+
+
 # best-vs-median spread of healthy window legs runs ~5-10%; a winner
 # must clear the incumbent by more than that to justify a re-pin
 NOISE_MARGIN = 0.10

@@ -85,8 +85,6 @@ def bench_plan_flops(t: int, batch: int):
 
 
 def main() -> int:
-    import numpy as np  # noqa: F401
-
     t, batch = 1024, 64
     total, attn_dense, n_layers = bench_plan_flops(t, batch)
 
@@ -148,7 +146,6 @@ def main() -> int:
     est_reported_mfu = est_sps * total / PEAK
     trunk_flops = total - attn_dense
     cap_sps = PEAK / trunk_flops
-
 
     out = {
         "provenance": {
