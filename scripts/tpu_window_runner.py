@@ -102,8 +102,14 @@ MUST_LAND = [
 EXPLORATORY = [
     # tightened decode confirmation (round-4 full leg timed out at
     # 1,500 s): smaller shapes via env knobs, hard 900 s cap
-    {"id": "decode.tight", "role": "decode",
-     "env": {"SLT_DECODE_PROMPT": "512", "SLT_DECODE_NEW": "128"},
+    # The first tightened shape (decode.tight: new=128) landed INVALID
+    # on-chip 2026-08-01: its timed window was ~0.1 s and the 2x window
+    # read *faster* than 1x (negative slope) — too small for the slope
+    # gate, not a chip problem. The leg is retired (record committed in
+    # the jsonl); new=512 grows the window ~4x so the per-token slope
+    # dominates jitter, prompt stays at the tightened 512.
+    {"id": "decode.n512", "role": "decode",
+     "env": {"SLT_DECODE_PROMPT": "512", "SLT_DECODE_NEW": "512"},
      "quick": False, "timeout": 900, "expected_s": 420},
     # headline confirmation at the full 3-epoch workload
     {"id": "cnn_headline.full", "role": "fused", "env": {}, "quick": False,

@@ -54,15 +54,21 @@ def test_leg_ids_unique_and_budgeted():
 
 def test_decode_leg_is_tightened():
     """The round-4 decode.full leg timed out at its own 1,500 s budget;
-    the round-5 confirmation shrinks the workload via bench.py's env
-    knobs AND halves the cap, so the worst case costs half a window."""
+    every round-5 decode leg halves the cap and shrinks the prompt, so
+    the worst case costs well under one window. The first tightened
+    shape (new=128) landed INVALID on-chip 2026-08-01 — its ~0.1 s
+    window was too short for the per-token slope gate — so one leg must
+    also grow new tokens back to >=512 (window ~0.4 s, slope dominates
+    jitter) while keeping the same budget cap."""
     r = _runner()
     decode = [leg for leg in r.LEGS if leg["role"] == "decode"]
     assert decode, "decode confirmation leg missing"
     for leg in decode:
         assert leg["timeout"] <= 900
         assert int(leg["env"].get("SLT_DECODE_PROMPT", "1024")) <= 512
-        assert int(leg["env"].get("SLT_DECODE_NEW", "256")) <= 128
+        assert int(leg["env"].get("SLT_DECODE_NEW", "256")) <= 512
+    assert any(int(leg["env"].get("SLT_DECODE_NEW", "0")) >= 512
+               for leg in decode), "no gate-able (large-window) decode leg"
 
 
 def test_sweep_legs_cover_pick_block_neighbours():
