@@ -38,8 +38,8 @@ MATRIX = [
     (1024, 64, "flash", False),
     (4096, 16, "full", True),
     (4096, 16, "flash", True),
-    (8192, 16, "full", True),    # the select_attention boundary: expect
-    (8192, 16, "flash", True),   # dense OOM-or-marginal; pins the crossover
+    (8192, 16, "full", True),    # above the speed crossover (pinned at
+    (8192, 16, "flash", True),   # T=1024 since 2026-08-01, _FLASH_SPEED_T)
     (16384, 16, "full", True),   # expected: dense OOM (P = 16 GiB > HBM)
     (16384, 16, "flash", True),
 ]

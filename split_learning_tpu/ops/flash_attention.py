@@ -218,33 +218,43 @@ def _onepass_compile_ok(tp: int, dp: int, block: int,
         return False
 
 
-# Measured speed crossover for the round-4 kernels (v5e, 2026-07-31
-# windows, artifacts/tpu_window_runs.jsonl): with the adaptive-block +
-# one-pass-backward rework, flash overtakes dense on throughput at
-# T=8192 (7.95 vs 4.54 steps/s, 47% vs 27% MFU) and holds 50% MFU at
-# T=16384 where dense cannot compile. 8192 is the conservative pin on
-# unambiguous same-day pairs. The crossover may yet move DOWN: at
-# T=1024 b64 the new flash measured 45.8 steps/s vs dense 42.57 from
-# the round-3 artifact (bench_tpu_transformer_2026-07-30.json; the
-# dense code path is unchanged since) — flash slightly ahead. The
-# round-4 window's own dense T=1024 leg read 2.61 steps/s, 16x below
-# its round-3 twin with perfect work-scaling, which smells like
-# transient contention on the pooled chip, not compute: a
-# confirmation leg is queued (tpu_window_runner.py) and this pin
-# should be revisited when it lands. T=256: dense ahead (353 vs 204,
-# round-3 kernels; round-4 re-measure queued).
-_FLASH_SPEED_T = 8192
+# Measured speed crossover for the round-4/5 kernels (v5e;
+# artifacts/bench_tpu_transformer_2026-08-01.json collects the legs,
+# which span the 07-31 and 08-01 windows — provenance per leg in
+# artifacts/tpu_window_runs.jsonl): flash beats dense at every
+# T >= 1024 measured on BOTH sides — T=1024 b64: flash 45.8 (07-31
+# window) vs dense 41.1 (08-01) / 42.6 (round 3); T=4096 b16: flash
+# 26.5 (08-01, 45.7% MFU) vs dense 17.4 (07-31) / 17.3 (round 3),
+# 1.52x; T=8192 b16: 7.95 vs 4.54 (both 07-31), 1.75x; T=16384:
+# flash-only, dense cannot compile (16G HBM). The cross-window pairs
+# are trusted because each dense figure is corroborated by an
+# independent round-3 read to <3% (17.4/17.3, 41.1/42.6) — unlike the
+# retired 07-31 dense-T=1024 contention read (2.61) they agree across
+# days — and the flash margins (8-52%) exceed that cross-window
+# variance. T=2048 has no dense read yet (twin timed out 08-01;
+# retry queued) and does not back this pin. Below 1024 dense leads
+# (T=256: 353 vs 204, round-3 kernels — round-5 re-measure queued;
+# if the adaptive single-block kernel flips it, this pin moves down
+# again).
+_FLASH_SPEED_T = 1024
 
 
 def select_attention(b: int, t: int, h: int, itemsize: int,
                      hbm_bytes: int | None = None,
-                     t_kv: int | None = None) -> str:
+                     t_kv: int | None = None,
+                     interpret: bool | None = None) -> str:
     """``attn="auto"`` resolution: pick ``"full"`` (XLA dense) or
     ``"flash"`` per shape, from two measured rules:
 
-    1. *Speed*: at or past ``_FLASH_SPEED_T`` the round-4 kernels beat
-       dense outright on the chip (see the constant's note), so flash
-       wins even when dense would fit.
+    1. *Speed*: at or past ``_FLASH_SPEED_T`` the round-4/5 kernels
+       beat dense outright on the chip (see the constant's note), so
+       flash wins even when dense would fit. This rule is about
+       *compiled Mosaic* speed, so it only applies where the kernel
+       compiles (``interpret`` False; default: resolved from the
+       backend via :func:`use_interpret`) — on interpreter backends
+       (CPU test meshes) interpreted flash is never faster than XLA
+       dense, and auto must not route a virtual-mesh run through the
+       Python interpreter for speed's sake.
     2. *Memory*: dense saves its quadratic score/softmax/dP buffers for
        the backward — 3 buffers of [B,H,T,T] against half the chip's
        HBM (half, because the model activations/params/optimizer need
@@ -263,8 +273,12 @@ def select_attention(b: int, t: int, h: int, itemsize: int,
         t_kv = t
     env = os.environ.get("SLT_FLASH_AUTO_T")
     if env:
+        # operator re-pin: absolute, on every backend (tests use it to
+        # force flash blocks onto the CPU mesh)
         return "flash" if max(t, t_kv) >= int(env) else "full"
-    if max(t, t_kv) >= _FLASH_SPEED_T:
+    if interpret is None:
+        interpret = use_interpret()
+    if not interpret and max(t, t_kv) >= _FLASH_SPEED_T:
         return "flash"
     if hbm_bytes is None:
         hbm_bytes = _device_hbm_bytes()

@@ -207,18 +207,37 @@ def test_auto_attention_selection(monkeypatch):
     from split_learning_tpu.ops.flash_attention import select_attention
 
     hbm = 16 * 1024 ** 3
-    # the measured facts: T=4096 b16/h2 bf16 trains dense; T=16384 OOMs
+    # the measured facts (bench_tpu_transformer_2026-08-01 +
+    # tpu_window_runs.jsonl): flash wins on compiled-Mosaic speed at
+    # every both-sides-measured T >= 1024, so on the chip
+    # (interpret=False) the pin sits at 1024 even when dense fits
+    tpu = dict(hbm_bytes=hbm, interpret=False)
+    assert select_attention(16, 1024, 2, 2, **tpu) == "flash"
+    assert select_attention(16, 4096, 2, 2, **tpu) == "flash"
+    assert select_attention(16, 16384, 2, 2, **tpu) == "flash"
+    assert select_attention(1, 8192, 1, 2, hbm_bytes=100 * hbm,
+                            interpret=False) == "flash"
+    # below the speed crossover with huge HBM: dense (T=256 measured
+    # dense-ahead, 353 vs 204)
+    assert select_attention(1, 512, 1, 2, hbm_bytes=100 * hbm,
+                            interpret=False) == "full"
+    # the speed rule is compiled-Mosaic-only: on interpreter backends
+    # (this CPU test process resolves interpret=True by default) auto
+    # keeps XLA dense at speed-rule shapes...
     assert select_attention(16, 4096, 2, 2, hbm_bytes=hbm) == "full"
-    assert select_attention(16, 16384, 2, 2, hbm_bytes=hbm) == "flash"
-    # T=8192: flash by measured *speed* (2026-07-31: 7.95 vs 4.54
-    # steps/s) — even when dense would fit comfortably
-    assert select_attention(16, 8192, 2, 2, hbm_bytes=hbm) == "flash"
-    assert select_attention(1, 8192, 1, 2, hbm_bytes=100 * hbm) == "flash"
-    # tiny batch below the speed crossover with huge HBM: dense
-    assert select_attention(1, 4096, 1, 2, hbm_bytes=100 * hbm) == "full"
-    monkeypatch.setenv("SLT_FLASH_AUTO_T", "1024")
-    assert select_attention(16, 1024, 2, 2, hbm_bytes=hbm) == "flash"
-    assert select_attention(16, 512, 2, 2, hbm_bytes=hbm) == "full"
+    assert select_attention(16, 4096, 2, 2, hbm_bytes=hbm,
+                            interpret=True) == "full"
+    # ...while the HBM rule stays universal — dense's quadratic
+    # backward buffers threatening memory force flash on any backend
+    assert select_attention(512, 512, 8, 4, hbm_bytes=hbm,
+                            interpret=True) == "flash"
+    assert select_attention(16, 16384, 2, 2, hbm_bytes=hbm,
+                            interpret=True) == "flash"
+    # the operator env re-pin is absolute on every backend
+    monkeypatch.setenv("SLT_FLASH_AUTO_T", "2048")
+    assert select_attention(16, 2048, 2, 2, hbm_bytes=hbm) == "flash"
+    assert select_attention(16, 1024, 2, 2, hbm_bytes=hbm,
+                            interpret=False) == "full"
 
 
 @pytest.mark.slow
