@@ -73,10 +73,13 @@ def _seq_len() -> int:
 
 def _active_flash_block(model: str, attn: str):
     """The block edge a flash-kernel leg actually ran with (env
-    override, else _pick_block's choice for this leg's token count) —
+    override, else _resolve_block's choice for this leg's shape) —
     None for non-flash legs. Frozen into the leg record so later
     assemblers can attribute the number to the right kernel shape even
-    after _pick_block's defaults change."""
+    after the picker's defaults change. _resolve_block, not
+    _pick_block: the entry points can cap the edge to the proven
+    split-form maximum when the one-pass backward is refused, and the
+    record must carry the edge that actually compiled."""
     if attn not in ("flash", "ring_flash"):
         return None
     if model == "transformer":
@@ -85,8 +88,13 @@ def _active_flash_block(model: str, attn: str):
         t = 64   # 32x32 / patch 4 patch tokens (see _data)
     else:
         return None
-    from split_learning_tpu.ops.flash_attention import _pick_block
-    return int(_pick_block(t))   # env SLT_FLASH_BLOCK honored inside
+    import numpy as np
+    from split_learning_tpu.ops.flash_attention import _resolve_block
+    # both bench attention trunks run head_dim 128 (d_model/heads —
+    # the MXU-filling shape; see the model kwargs in _fused_step_leg)
+    dtype = np.dtype(os.environ.get("SLT_BENCH_DTYPE", "float32"))
+    block, _ = _resolve_block(t, 128, dtype)
+    return int(block)
 
 
 def _data(n_steps: int, model: str):

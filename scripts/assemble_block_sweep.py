@@ -47,6 +47,20 @@ def _incumbent_block(seq: int) -> int:
             os.environ["SLT_FLASH_BLOCK"] = saved
 
 
+def _legacy_block(seq: int) -> int:
+    """The default edge for jsonl records that PREDATE bench.py's
+    ``flash_block`` field (everything before the 2026-08-01 morning
+    window): the pre-sweep picker started at 512, so that is the edge
+    those kernels actually compiled with. Frozen here — today's
+    `_pick_block` starts at 1024 (adopted from this very sweep) and
+    must not be used to label yesterday's runs."""
+    b = 512
+    tp128 = seq if seq % 128 == 0 else seq + 128 - seq % 128
+    while b > 128 and tp128 % b:
+        b //= 2
+    return b
+
+
 # best-vs-median spread of healthy window legs runs ~5-10%; a winner
 # must clear the incumbent by more than that to justify a re-pin
 NOISE_MARGIN = 0.10
@@ -83,10 +97,9 @@ def collect(records):
             seq, batch = int(m.group(1)), int(m.group(2))
             # the edge the kernel ACTUALLY ran with, frozen into the
             # record by bench.py at measurement time; records predating
-            # that field fall back to today's _pick_block, which is
-            # valid only while its defaults are unchanged since those
-            # measurements (true for the 2026-07-31 round-4 legs)
-            blk = rec["result"].get("flash_block") or _incumbent_block(seq)
+            # that field get the frozen pre-sweep default they really
+            # compiled with, never today's picker
+            blk = rec["result"].get("flash_block") or _legacy_block(seq)
         sps = rec["result"]["steps_per_sec"]
         cur = table.setdefault((seq, batch), {})
         cur[blk] = max(cur.get(blk, 0.0), sps)

@@ -127,6 +127,10 @@ EXPLORATORY = [
     _t_leg(4096, 16, "flash", True, 1200, expected_s=300, block=256),
     _t_leg(4096, 16, "flash", True, 1200, expected_s=300, block=1024),
     _t_leg(8192, 16, "flash", True, 1500, expected_s=360, block=1024),
+    # T=2048 is now governed by the adopted 1024 default but was the
+    # one shape the original sweep skipped — its quoted 18.0 steps/s
+    # was measured at blk 512 (08-01 morning, pre-adoption)
+    _t_leg(2048, 64, "flash", True, 1200, expected_s=300, block=1024),
     # kernel-level fwd/bwd-split block sweep (VERDICT r4 #8's exact
     # ask): one leg yields every edge's fwd and fwd+bwd timing at
     # T=4096 b16, so end-to-end sweep wins can be attributed to the

@@ -80,15 +80,22 @@ def _pick_block(t: int) -> int:
     per-grid-step overhead (DMA setup + semaphores): at T=4096 the
     3-D grid is bh*32*32 steps and the round-3 measurement put flash at
     2.8x slower than dense — worse than the ~1.8x recompute-FLOP ratio
-    explains. 512-row blocks cut the step count 16x and keep every
-    matmul MXU-shaped ([512,128]x[128,512]); VMEM stays ~2 MiB/kernel.
-    SLT_FLASH_BLOCK overrides for tuning."""
+    explains. The round-5 on-chip block sweep (v5e, full training
+    step, `artifacts/flash_block_sweep.json`) measured 1024-row blocks
+    faster than the prior 512 default at every swept shape — 58.1 vs
+    45.8 steps/s at T=1024 b64, 30.0 vs 26.5 at T=4096 b16, 9.2 vs
+    8.0 at T=8192 b16 — while 256 lost everywhere (16.1 at T=4096),
+    so larger edges win until VMEM, not grid overhead, binds. 1024
+    keeps every matmul MXU-shaped ([1024,128]x[128,1024]); the f32
+    scores block is 4 MiB and the kernels' working set stays inside
+    Mosaic's 16 MiB default (compiled and measured on-chip at
+    T=1024..8192). SLT_FLASH_BLOCK overrides for tuning."""
     import os
     env = os.environ.get("SLT_FLASH_BLOCK")
     if env:
         return int(env)
     tp128 = round_up(t, 128)
-    b = 512
+    b = 1024
     while b > 128 and tp128 % b:   # largest edge that adds no extra padding
         b //= 2
     return b
@@ -146,7 +153,46 @@ def _onepass_resident_bytes(tp: int, d: int, itemsize: int) -> int:
 # d=128) and compiler temporaries, so no preflight is needed: the
 # raised limit only matters past it. The estimator is accurate — the
 # T=4096 bf16 failure requested 16.50 MiB vs a 16.51 MiB estimate.
+# The margin was derived at block<=512; _use_onepass only consults it
+# there — larger blocks always preflight, because their per-pair f32
+# score temporaries (4 MiB each at 1024) void the "~2 MiB to spare"
+# arithmetic.
 _DEFAULT_LIMIT_SAFE = 12 * 1024 * 1024
+
+# Largest block edge the two-kernel backward split has ever been
+# compiled at (round-4 on-chip runs through T=16384 all used <=512;
+# the round-5 blk-1024 sweep legs all selected the ONE-PASS backward,
+# so blk-1024 evidence does not cover _dq_kernel/_dkv_kernel, whose
+# four f32 [block,block] temporaries can exceed Mosaic's default
+# scoped-VMEM limit at 1024). When the split is the chosen backward
+# form, the whole program drops to this proven edge instead of
+# risking a user-path compile error at an unproven one.
+_SPLIT_BLOCK_MAX = 512
+
+
+def _resolve_block(t: int, d: int, dtype) -> tuple[int, bool]:
+    """(block, onepass) for a public entry point: the swept default
+    edge when the one-pass backward (which preflight-confirms itself)
+    carries the gradient, capped to :data:`_SPLIT_BLOCK_MAX` when the
+    two-kernel split must take over. An explicit ``SLT_FLASH_BLOCK``
+    tuning override is honored verbatim — sweeps must measure the edge
+    they asked for, cap included in what they signed up for.
+
+    Cost note: resolving the backward form eagerly means even a
+    forward-only call at a >512 edge pays the one-pass preflight
+    compile (cached per shape, ~seconds once per process). Accepted:
+    deferring the probe to the first gradient would let the forward
+    and backward disagree on the block edge (the split cap changes
+    BOTH kernels' padding), and a cached compile is cheap next to a
+    user-path compile error."""
+    import os
+    block = _pick_block(t)
+    onepass = _use_onepass(t, block, d, dtype)
+    if (not onepass and block > _SPLIT_BLOCK_MAX
+            and not os.environ.get("SLT_FLASH_BLOCK")):
+        block = _SPLIT_BLOCK_MAX
+        onepass = _use_onepass(t, block, d, dtype)
+    return block, onepass
 
 
 def _use_onepass(t: int, block: int, d: int, dtype) -> bool:
@@ -176,7 +222,13 @@ def _use_onepass(t: int, block: int, d: int, dtype) -> bool:
     resident = _onepass_resident_bytes(tp, d, dtype.itemsize)
     if resident > _vmem_limit_bytes() * 2 // 3:
         return False
-    if resident > _DEFAULT_LIMIT_SAFE and not use_interpret():
+    # Skip the preflight only inside the margin it was derived for:
+    # small residency AND the <=512 block edge whose buffer arithmetic
+    # _DEFAULT_LIMIT_SAFE encodes. Larger edges (the swept 1024
+    # default) always ask the compiler — their f32 score temporaries
+    # alone can blow the default limit even at tiny T.
+    if ((resident > _DEFAULT_LIMIT_SAFE or block > _SPLIT_BLOCK_MAX)
+            and not use_interpret()):
         return _onepass_compile_ok(tp, round_up(d, LANE), block, dtype.name)
     return True
 
@@ -231,8 +283,9 @@ def _onepass_compile_ok(tp: int, dp: int, block: int,
 # independent round-3 read to <3% (17.4/17.3, 41.1/42.6) — unlike the
 # retired 07-31 dense-T=1024 contention read (2.61) they agree across
 # days — and the flash margins (8-52%) exceed that cross-window
-# variance. T=2048 has no dense read yet (twin timed out 08-01;
-# retry queued) and does not back this pin. Below 1024 dense leads
+# variance. T=2048 b64: flash 18.0 (08-01 morning) vs dense 13.3
+# (08-01 evening retry), 1.35x — every T >= 1024 now measured on both
+# sides. Below 1024 dense leads
 # (T=256: 353 vs 204, round-3 kernels — round-5 re-measure queued;
 # if the adaptive single-block kernel flips it, this pin moves down
 # again).
@@ -679,9 +732,9 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     elsewhere).
     """
     b, t, h, d = q.shape
-    block = _pick_block(t)
+    block, onepass = _resolve_block(t, d, q.dtype)
     fn = _make_flash(b * h, t, d, causal, str(q.dtype), block,
-                     onepass=_use_onepass(t, block, d, q.dtype))
+                     onepass=onepass)
 
     def fold(x):  # [B, T, H, D] -> [B*H, T, D]
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
@@ -710,10 +763,10 @@ def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
         raise ValueError("strict=True refines the causal mask and "
                          "requires causal=True")
     b, t, h, d = q.shape
-    block = _pick_block(t)
+    block, onepass = _resolve_block(t, d, q.dtype)
     fn = _make_flash(b * h, t, d, causal, str(q.dtype), block,
                      with_lse=True, strict=strict,
-                     onepass=_use_onepass(t, block, d, q.dtype))
+                     onepass=onepass)
 
     def fold(x):
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)

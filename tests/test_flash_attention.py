@@ -45,12 +45,13 @@ def test_gradients_match_dense(causal):
                                    atol=5e-5, rtol=5e-5)
 
 
-@pytest.mark.parametrize("t,block", [(640, 128), (1024, 512)])
+@pytest.mark.parametrize("t,block", [(640, 128), (2048, 1024)])
 def test_multi_block_gradients(t, block):
     """Multi-block grids under the adaptive block picker: T=640 tiles as
-    5x128 (ragged T keeps the small edge), T=1024 as 2x512 (the large
-    edge used at long context). Exercises the inner block loops of all
-    three kernels, causal (block-skew) masking on."""
+    5x128 (ragged T keeps the small edge), T=2048 as 2x1024 (the large
+    edge the round-5 on-chip sweep adopted as default). Exercises the
+    inner block loops of all three kernels, causal (block-skew)
+    masking on."""
     from split_learning_tpu.ops.flash_attention import _pick_block
     assert _pick_block(t) == block
     q, k, v = qkv(t=t, b=1, h=2)
@@ -161,6 +162,56 @@ def test_onepass_preflight_fallback(monkeypatch):
     # env override short-circuits everything, including the probe
     monkeypatch.setenv("SLT_FLASH_ONEPASS_T", "0")
     assert not fa._use_onepass(1024, 512, 128, jnp.bfloat16)
+
+
+def test_large_block_always_preflights(monkeypatch):
+    """Edges past _SPLIT_BLOCK_MAX must consult the compiler even at
+    tiny residency: the _DEFAULT_LIMIT_SAFE skip margin was derived
+    for <=512 blocks (~1 MiB of block buffers), and a 1024 edge's f32
+    score temporaries (4 MiB per pair) void it."""
+    import importlib
+    fa = importlib.import_module(
+        "split_learning_tpu.ops.flash_attention")
+    monkeypatch.setattr(fa, "_vmem_limit_bytes", lambda: 96 * 1024 * 1024)
+    monkeypatch.setattr(fa, "use_interpret", lambda: False)
+    probed = []
+
+    def probe(*a):
+        probed.append(a)
+        return False
+
+    monkeypatch.setattr(fa, "_onepass_compile_ok", probe)
+    # T=1024 bf16 d=128: ~4.1 MiB resident — inside the skip margin,
+    # but block=1024 still must preflight (and honor its verdict)
+    assert not fa._use_onepass(1024, 1024, 128, jnp.bfloat16)
+    assert probed
+    # same shape at the derived-for 512 edge: no probe, static yes
+    probed.clear()
+    assert fa._use_onepass(1024, 512, 128, jnp.bfloat16)
+    assert not probed
+
+
+def test_resolve_block_caps_split_form(monkeypatch):
+    """When the two-kernel split carries the gradient, the whole
+    program drops to the proven _SPLIT_BLOCK_MAX edge (the blk-1024
+    sweep legs all ran the one-pass backward, so 1024 evidence does
+    not cover _dq_kernel/_dkv_kernel); an explicit SLT_FLASH_BLOCK
+    tuning override is honored verbatim."""
+    import importlib
+    fa = importlib.import_module(
+        "split_learning_tpu.ops.flash_attention")
+    # default path, one-pass selected (interpret mode skips the probe):
+    # the swept 1024 edge stands
+    monkeypatch.setattr(fa, "use_interpret", lambda: True)
+    assert fa._resolve_block(2048, 128, jnp.bfloat16) == (1024, True)
+    # force the split form: the edge must drop to the proven 512
+    monkeypatch.setenv("SLT_FLASH_ONEPASS_T", "0")
+    assert fa._resolve_block(2048, 128, jnp.bfloat16) == (512, False)
+    # ragged T already below the cap: unchanged
+    assert fa._resolve_block(640, 128, jnp.bfloat16) == (128, False)
+    # explicit tuning override rides through the cap untouched
+    monkeypatch.setenv("SLT_FLASH_BLOCK", "1024")
+    assert fa._resolve_block(2048, 128, jnp.bfloat16) == (1024, False)
 
 
 @pytest.mark.slow
