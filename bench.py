@@ -668,7 +668,14 @@ def measure_flash_micro(quick: bool) -> dict:
                     s = 0.0
                     for _ in range(n):
                         s = fn(*a)
-                    return time.perf_counter() - t0, float(s)
+                    # close the window ON the clock: the host transfer
+                    # must be inside the timed region, or the loop
+                    # measures dispatch only. The 2026-08-01 attempt
+                    # read 6,000 "TFLOP/s" (util gate caught it)
+                    # because the tuple below evaluated perf_counter()
+                    # before float(s)
+                    s = float(s)
+                    return time.perf_counter() - t0, s
                 return w
 
             wf, wb = window(fwd, q, k, v), window(bwd, q)

@@ -22,15 +22,37 @@ Usage: python scripts/assemble_long_context.py [--out PATH]
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import os
 import re
+import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 RUNS = os.path.join(REPO, "artifacts", "tpu_window_runs.jsonl")
+sys.path.insert(0, REPO)
+# sibling-script import (shared _incumbent_block) must work however
+# this file is loaded — as __main__, or via spec_from_file_location
+# in the tests, where scripts/ is not implicitly on the path
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 _ID = re.compile(r"^T(\d+)\.b(\d+)\.(flash|full)\.(q|full)$")
+# A sweep leg pinned at what is NOW the default edge is the same
+# config a main flash leg would run today, so it qualifies as a flash
+# candidate (that is how the adopted-edge numbers publish without
+# re-burning chip time on identical re-measurements). Edges that
+# don't match today's default stay sweep-only.
+_SWEEP_ID = re.compile(r"^sweep\.T(\d+)\.b(\d+)\.flash\.blk(\d+)$")
+
+
+@functools.lru_cache(maxsize=None)
+def _default_block(seq: int) -> int:
+    """Today's `_pick_block` choice — one shared implementation with
+    assemble_block_sweep's incumbent lookup (env override masked there:
+    assembly must not inherit a sweep's pin or mutate the env)."""
+    from assemble_block_sweep import _incumbent_block
+    return _incumbent_block(seq)
 
 # Window records quarantined from assembly, keyed by (leg id, ts):
 # candidates contradicted by stronger evidence. They still rank above
@@ -64,12 +86,21 @@ def assemble(records):
     status_rank = {"ok": 2, "oom": 1, "invalid": 0}
     best = {}
     for rec in records:
-        m = _ID.match(rec.get("leg", ""))
-        if not m or rec.get("status") not in status_rank:
+        if rec.get("status") not in status_rank:
             continue
-        seq, batch, attn = int(m.group(1)), int(m.group(2)), m.group(3)
-        attn_key = "full" if attn == "full" else "flash"
-        is_full = m.group(4) == "full"
+        m = _ID.match(rec.get("leg", ""))
+        if m:
+            seq, batch, attn = int(m.group(1)), int(m.group(2)), m.group(3)
+            attn_key = "full" if attn == "full" else "flash"
+            is_full = m.group(4) == "full"
+        else:
+            m = _SWEEP_ID.match(rec.get("leg", ""))
+            if not m:
+                continue
+            seq, batch, blk = (int(g) for g in m.groups())
+            if blk != _default_block(seq):
+                continue   # non-default edge: sweep-artifact-only
+            attn_key, is_full = "flash", False
         if rec["status"] == "oom":
             leg = {"model": "transformer", "mode": "split", "attn": attn_key,
                    "batch": batch, "seq_len": seq, "dtype": "bfloat16",
@@ -144,7 +175,8 @@ def main():
         "date": date,
         "what": ("Long-context split transformer on one TPU chip: dense "
                  "(XLA) vs Pallas-flash attention (ops/flash_attention.py, "
-                 "round-4 adaptive 128-512 blocks), d_model 256, 2 heads "
+                 "adaptive 128-1024 blocks — the edge each leg compiled "
+                 "with is its flash_block field), d_model 256, 2 heads "
                  "(head_dim 128), bf16, bench.py fused role per leg "
                  "(gated: util<=1 + work-scaling window); assembled from "
                  "opportunistic tunnel windows "
