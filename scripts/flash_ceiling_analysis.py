@@ -103,8 +103,12 @@ def main() -> int:
         art = json.load(f)
     legs = {(l.get("seq_len"), l.get("attn")): l for l in art["legs"]}
     flash = legs.get((t, "flash"))
-    if flash is None:
-        raise SystemExit(f"no T={t} flash leg in {ARTIFACT}")
+    # same guard the dense side gets: the glob-newest assembly can in
+    # principle carry an oom/suspect/invalid flash leg, and an analysis
+    # must never headline a number the assembler quarantined
+    if (flash is None or flash.get("status") != "ok"
+            or not flash.get("valid") or "suspect" in flash):
+        raise SystemExit(f"no clean T={t} flash leg in {ARTIFACT}")
     # dense comparator: prefer the same artifact's clean dense leg
     # (the 08-01 confirmation retired the round-4 SUSPECT read);
     # fall back to the round-3 artifact for older assemblies

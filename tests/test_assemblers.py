@@ -149,3 +149,30 @@ def test_suspect_records_demoted_but_not_vanished(longctx, monkeypatch):
     assert any("clean shared-T" in m for m in longctx.complete_enough(legs))
     legs = longctx.assemble([clean, flash_ok, oom_top, flash_top])
     assert longctx.complete_enough(legs) == []
+
+
+def test_sweep_leg_at_default_edge_promoted(longctx, monkeypatch):
+    """A sweep leg pinned at TODAY's default block edge is the same
+    config a main flash leg would run now, so it qualifies as a flash
+    candidate (this is how adopted-edge numbers publish without
+    re-burning identical chip measurements); non-default edges stay
+    sweep-artifact-only."""
+    monkeypatch.setattr(longctx, "_default_block", lambda seq: 1024)
+    main = _rec("T2048.b64.flash.q", ts=1, steps_per_sec=18.0,
+                seq_len=2048, attn="flash", batch=64)
+    at_default = _rec("sweep.T2048.b64.flash.blk1024", ts=2,
+                      steps_per_sec=19.5, seq_len=2048, attn="flash",
+                      batch=64)
+    off_default = _rec("sweep.T2048.b64.flash.blk256", ts=3,
+                       steps_per_sec=99.0, seq_len=2048, attn="flash",
+                       batch=64)
+    legs = longctx.assemble([main, at_default, off_default])
+    assert len(legs) == 1
+    # newer same-status default-edge sweep displaces the older main
+    # leg; the blk-256 record (newest of all) never qualifies
+    assert legs[0]["steps_per_sec"] == 19.5
+    # a FULL main leg still outranks the quick sweep leg
+    full = _rec("T2048.b64.flash.full", ts=0, steps_per_sec=18.5,
+                seq_len=2048, attn="flash", batch=64)
+    legs = longctx.assemble([main, at_default, full])
+    assert legs[0]["steps_per_sec"] == 18.5
