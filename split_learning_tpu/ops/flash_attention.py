@@ -285,10 +285,14 @@ def _onepass_compile_ok(tp: int, dp: int, block: int,
 # days — and the flash margins (8-52%) exceed that cross-window
 # variance. T=2048 b64: flash 18.0 (08-01 morning) vs dense 13.3
 # (08-01 evening retry), 1.35x — every T >= 1024 now measured on both
-# sides. Below 1024 dense leads
-# (T=256: 353 vs 204, round-3 kernels — round-5 re-measure queued;
-# if the adaptive single-block kernel flips it, this pin moves down
-# again).
+# sides. The lower bracket is same-window round-5 silicon (08-01
+# evening): T=256 dense leads clearly (353.3 vs 279.4, +26%); T=512
+# is a statistical tie (flash 132.6 vs dense 129.7, +2.2% — inside
+# the ~5-10% window spread, so not evidence of a flash win); T=1024
+# flash leads clearly (58.1 vs 41.1 on the swept 1024 edge). The pin
+# stays at the smallest T with a clear measured flash win. (Historic
+# context: on round-3 kernels dense led T=256 by 73% — 353 vs 204 —
+# so the round-5 kernels closed most of that gap without flipping it.)
 _FLASH_SPEED_T = 1024
 
 
