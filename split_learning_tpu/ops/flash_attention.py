@@ -159,24 +159,30 @@ def _onepass_resident_bytes(tp: int, d: int, itemsize: int) -> int:
 # arithmetic.
 _DEFAULT_LIMIT_SAFE = 12 * 1024 * 1024
 
-# Largest block edge the two-kernel backward split has ever been
-# compiled at (round-4 on-chip runs through T=16384 all used <=512;
-# the round-5 blk-1024 sweep legs all selected the ONE-PASS backward,
-# so blk-1024 evidence does not cover _dq_kernel/_dkv_kernel, whose
-# four f32 [block,block] temporaries can exceed Mosaic's default
-# scoped-VMEM limit at 1024). When the split is the chosen backward
-# form, the whole program drops to this proven edge instead of
-# risking a user-path compile error at an unproven one.
+# Largest block edge the two-kernel backward split drops to on the
+# DEFAULT path when it must carry the gradient. The split kernels'
+# four f32 [block,block] temporaries exceed Mosaic's 16 MiB default
+# at 1024-row edges; they now request the per-generation allowance
+# (same as the fwd/one-pass calls) and a blk-1024 split compiled and
+# ran on-chip 2026-08-01 (T=2048 b16, 78.3 steps/s, forced via
+# SLT_FLASH_ONEPASS_T=0) — but on generations where the allowance IS
+# the 16 MiB default (v2/v3, unknown kinds at their floor) a >512
+# split would still be a compile error, and the split is only ever
+# chosen where one-pass was refused, i.e. exactly the
+# VMEM-constrained regime. 512 stays the proven-everywhere edge.
 _SPLIT_BLOCK_MAX = 512
 
 
-def _resolve_block(t: int, d: int, dtype) -> tuple[int, bool]:
+def _resolve_block(t: int, d: int, dtype, bh: int = 2) -> tuple[int, bool]:
     """(block, onepass) for a public entry point: the swept default
     edge when the one-pass backward (which preflight-confirms itself)
     carries the gradient, capped to :data:`_SPLIT_BLOCK_MAX` when the
     two-kernel split must take over. An explicit ``SLT_FLASH_BLOCK``
     tuning override is honored verbatim — sweeps must measure the edge
-    they asked for, cap included in what they signed up for.
+    they asked for, cap included in what they signed up for. ``bh`` is
+    the program's batch*heads, forwarded so the preflight probes the
+    grid shape the user will actually compile (see
+    :func:`_onepass_compile_ok`).
 
     Cost note: resolving the backward form eagerly means even a
     forward-only call at a >512 edge pays the one-pass preflight
@@ -187,15 +193,15 @@ def _resolve_block(t: int, d: int, dtype) -> tuple[int, bool]:
     user-path compile error."""
     import os
     block = _pick_block(t)
-    onepass = _use_onepass(t, block, d, dtype)
+    onepass = _use_onepass(t, block, d, dtype, bh=bh)
     if (not onepass and block > _SPLIT_BLOCK_MAX
             and not os.environ.get("SLT_FLASH_BLOCK")):
         block = _SPLIT_BLOCK_MAX
-        onepass = _use_onepass(t, block, d, dtype)
+        onepass = _use_onepass(t, block, d, dtype, bh=bh)
     return block, onepass
 
 
-def _use_onepass(t: int, block: int, d: int, dtype) -> bool:
+def _use_onepass(t: int, block: int, d: int, dtype, bh: int = 2) -> bool:
     """Backward-form selection: one-pass while its whole-sequence
     residency (see :func:`_onepass_resident_bytes`) fits 2/3 of the
     device's scoped-VMEM limit, leaving the rest for the
@@ -229,29 +235,36 @@ def _use_onepass(t: int, block: int, d: int, dtype) -> bool:
     # alone can blow the default limit even at tiny T.
     if ((resident > _DEFAULT_LIMIT_SAFE or block > _SPLIT_BLOCK_MAX)
             and not use_interpret()):
-        return _onepass_compile_ok(tp, round_up(d, LANE), block, dtype.name)
+        return _onepass_compile_ok(tp, round_up(d, LANE), block, dtype.name,
+                                   min(bh, 2))
     return True
 
 
 @functools.lru_cache(maxsize=None)
 def _onepass_compile_ok(tp: int, dp: int, block: int,
-                        dtype_name: str) -> bool:
+                        dtype_name: str, bh_probe: int = 2) -> bool:
     """Preflight: does the one-pass backward *compile* on this device at
     the padded shape? ``vmem_limit_bytes`` is serialized into the Mosaic
     custom call as ``scoped_memory_configs`` (verified against the
     lowered module — tests/test_flash_attention.py), but JAX documents
     that XLA may additionally require ``--xla_tpu_scoped_vmem_limit_kib``
     to honor it, and the only ground truth is the compiler's verdict on
-    the actual chip. AOT-compiles the backward pallas_call alone at
-    ``bh=1`` (per-grid-step VMEM residency is independent of the bh grid
-    dimension, so bh=1 is representative) and caches per process — one
-    ~seconds compile per distinct (padded T, padded D, block, dtype).
-    Mask flavor (causal/strict) is irrelevant to scoped allocation, so
-    the probe always uses ``causal=False``."""
-    call = _onepass_call(1, tp, tp, dp, block, 1.0, False, False,
+    the actual chip. ``bh_probe`` is ``min(program bh, 2)`` — NOT a
+    fixed 1: Mosaic double-buffers the whole-sequence refs across the
+    bh grid boundary, so a bh=1 probe has no next slice to prefetch
+    and under-counts scoped VMEM by one slice set. Measured
+    2026-08-01: a blk-2048 T=16384 probe PASSED at bh=1 while the real
+    bh=32 compile failed at 99.12M vs the 96M limit; bh=2 exhibits the
+    boundary, residency does not grow further with bh beyond it, and a
+    genuine bh=1 program (no boundary at all) still probes exactly.
+    Cached per process — one ~seconds compile per distinct (padded T,
+    padded D, block, dtype, probe-bh). Mask flavor (causal/strict) is
+    irrelevant to scoped allocation, so the probe always uses
+    ``causal=False``."""
+    call = _onepass_call(bh_probe, tp, tp, dp, block, 1.0, False, False,
                          jnp.dtype(dtype_name))
-    seq = jax.ShapeDtypeStruct((1, tp, dp), jnp.dtype(dtype_name))
-    row = jax.ShapeDtypeStruct((1, tp, _ROWW), jnp.float32)
+    seq = jax.ShapeDtypeStruct((bh_probe, tp, dp), jnp.dtype(dtype_name))
+    row = jax.ShapeDtypeStruct((bh_probe, tp, _ROWW), jnp.float32)
     try:
         jax.jit(call).lower(seq, seq, seq, seq, row, row).compile()
         return True
@@ -653,6 +666,13 @@ def _make_flash(bh: int, t: int, d: int, causal: bool, dtype_name: str,
             out_specs=(blk(outer), row(outer)),
             scratch_shapes=[acc_scratch, row_scratch, row_scratch],
             interpret=use_interpret(),
+            # same per-generation allowance the one-pass backward gets
+            # (a limit, not a reservation): at the default <=1024 edges
+            # the working set fits Mosaic's 16 MiB default anyway, but
+            # a 2048-row tuning edge's f32 score block alone is 16 MiB
+            # and needs the raised ceiling to compile at all
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=_vmem_limit_bytes()),
         )(qp, kp, vp)
         return o, lse, (qp, kp, vp)
 
@@ -694,6 +714,14 @@ def _make_flash(bh: int, t: int, d: int, causal: bool, dtype_name: str,
             )(kp, vp, qp, dop, lse, delta)
             dq = dq.astype(in_dtype)
         else:
+            # same per-generation allowance as the fwd call: the
+            # default path never exceeds _SPLIT_BLOCK_MAX (where the
+            # 16 MiB default suffices), but an explicit large-block
+            # override that the bh-exact preflight demotes to this
+            # split must not become the compile error the one-pass
+            # fallback exists to prevent
+            split_params = pltpu.CompilerParams(
+                vmem_limit_bytes=_vmem_limit_bytes())
             dq = pl.pallas_call(
                 functools.partial(_dq_kernel, block, t, scale, causal,
                                   strict, n_blk),
@@ -704,6 +732,7 @@ def _make_flash(bh: int, t: int, d: int, causal: bool, dtype_name: str,
                 out_specs=blk(outer),
                 scratch_shapes=[acc_scratch],
                 interpret=use_interpret(),
+                compiler_params=split_params,
             )(qp, kp, vp, dop, lse, delta)
             dk, dv = pl.pallas_call(
                 functools.partial(_dkv_kernel, block, t, scale, causal,
@@ -718,6 +747,7 @@ def _make_flash(bh: int, t: int, d: int, causal: bool, dtype_name: str,
                 out_specs=(blk(outer), blk(outer)),
                 scratch_shapes=[acc_scratch, acc_scratch],
                 interpret=use_interpret(),
+                compiler_params=split_params,
             )(kp, vp, qp, dop, lse, delta)
         trim = lambda x: x[:, :t, :d]
         return trim(dq), trim(dk), trim(dv)
@@ -736,7 +766,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     elsewhere).
     """
     b, t, h, d = q.shape
-    block, onepass = _resolve_block(t, d, q.dtype)
+    block, onepass = _resolve_block(t, d, q.dtype, bh=b * h)
     fn = _make_flash(b * h, t, d, causal, str(q.dtype), block,
                      onepass=onepass)
 
@@ -767,7 +797,7 @@ def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
         raise ValueError("strict=True refines the causal mask and "
                          "requires causal=True")
     b, t, h, d = q.shape
-    block, onepass = _resolve_block(t, d, q.dtype)
+    block, onepass = _resolve_block(t, d, q.dtype, bh=b * h)
     fn = _make_flash(b * h, t, d, causal, str(q.dtype), block,
                      with_lse=True, strict=strict,
                      onepass=onepass)
