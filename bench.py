@@ -71,6 +71,22 @@ def _seq_len() -> int:
     return int(os.environ.get("SLT_BENCH_SEQ", str(SEQ_LEN)))
 
 
+def _bench_d_model() -> int:
+    """Transformer-leg width (SLT_BENCH_DMODEL, default 256). One
+    parse site: the plan builder and the leg record must never read
+    different values. Multiples of 128 only — heads scale with width
+    so head_dim stays exactly the 128-lane tile, the shape every
+    recorded flash_block was resolved for."""
+    d = int(os.environ.get("SLT_BENCH_DMODEL", "256"))
+    if d % 128:
+        raise SystemExit(
+            f"SLT_BENCH_DMODEL={d} is not a multiple of 128: heads "
+            "scale with width to keep head_dim at the 128-lane tile, "
+            "and a non-multiple would silently benchmark a different "
+            "kernel shape than the record describes")
+    return d
+
+
 def _active_flash_block(model: str, attn: str):
     """The block edge a flash-kernel leg actually ran with (env
     override, else _resolve_block's choice for this leg's shape) —
@@ -247,10 +263,18 @@ def measure_fused(quick: bool) -> dict:
         # TPU-shaped dimensions: head_dim = d_model/heads = 128 fills the
         # 128-lane tile exactly — the factory default (64/4 -> D=16) pads
         # every attention matmul's lane dim 8x on both the dense and
-        # flash paths, which benchmarks the padding, not the math
+        # flash paths, which benchmarks the padding, not the math.
+        # SLT_BENCH_DMODEL scales width; heads scale with it so
+        # head_dim stays 128 (d512 -> 4 heads etc.), keeping every
+        # leg's attention matmuls MXU-shaped while varying bh. The
+        # 128-divisibility is load-bearing (the recorded flash_block
+        # is resolved for head_dim 128), so a width that breaks it is
+        # refused, not silently measured wrong.
         from split_learning_tpu.models.transformer import transformer_plan
-        tkw = dict(mode=mode, dtype=np.dtype(dtype), d_model=256,
-                   num_heads=2, max_len=max(2048, _seq_len()))
+        d_model = _bench_d_model()
+        tkw = dict(mode=mode, dtype=np.dtype(dtype), d_model=d_model,
+                   num_heads=d_model // 128,
+                   max_len=max(2048, _seq_len()))
         plan = transformer_plan(attn=attn, **tkw)
     elif model == "vit":
         # same TPU-shaped trunk as the transformer leg (head_dim 128):
@@ -343,6 +367,7 @@ def measure_fused(quick: bool) -> dict:
         "attn": attn,
         "batch": batch,
         "seq_len": _seq_len() if model == "transformer" else None,
+        "d_model": _bench_d_model() if model == "transformer" else None,
         # the block edge the flash kernel actually ran with, frozen at
         # measurement time: assemblers must never re-derive it from a
         # later _pick_block (whose constant is exactly what sweep
