@@ -191,3 +191,19 @@ def test_headline_route_priority():
         assert bench.headline_route(cpu_valid) == "publish"
     finally:
         bench._tpu_intended = real
+
+
+def test_bench_d_model_guard(monkeypatch):
+    """SLT_BENCH_DMODEL must be a multiple of 128: heads scale with
+    width so head_dim stays the 128-lane tile the recorded flash_block
+    is resolved for — a non-multiple would silently benchmark a
+    different kernel shape than the record describes."""
+    sys.path.insert(0, REPO)
+    from bench import _bench_d_model
+    monkeypatch.delenv("SLT_BENCH_DMODEL", raising=False)
+    assert _bench_d_model() == 256
+    monkeypatch.setenv("SLT_BENCH_DMODEL", "1024")
+    assert _bench_d_model() == 1024
+    monkeypatch.setenv("SLT_BENCH_DMODEL", "320")
+    with pytest.raises(SystemExit):
+        _bench_d_model()
