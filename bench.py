@@ -87,6 +87,19 @@ def _bench_d_model() -> int:
     return d
 
 
+def transformer_trunk_kwargs(mode: str, dtype) -> dict:
+    """The bench transformer trunk's plan kwargs, shared with every
+    consumer that claims to build "the same trunk as the bench legs"
+    (scripts/profile_fused_tpu.py): width from the one
+    :func:`_bench_d_model` parse site, heads scaled so head_dim stays
+    the 128-lane tile, the same max_len floor."""
+    import numpy as np
+    d_model = _bench_d_model()
+    return dict(mode=mode, dtype=np.dtype(dtype), d_model=d_model,
+                num_heads=d_model // 128,
+                max_len=max(2048, _seq_len()))
+
+
 def _active_flash_block(model: str, attn: str):
     """The block edge a flash-kernel leg actually ran with (env
     override, else _resolve_block's choice for this leg's shape) —
@@ -271,10 +284,7 @@ def measure_fused(quick: bool) -> dict:
         # is resolved for head_dim 128), so a width that breaks it is
         # refused, not silently measured wrong.
         from split_learning_tpu.models.transformer import transformer_plan
-        d_model = _bench_d_model()
-        tkw = dict(mode=mode, dtype=np.dtype(dtype), d_model=d_model,
-                   num_heads=d_model // 128,
-                   max_len=max(2048, _seq_len()))
+        tkw = transformer_trunk_kwargs(mode, dtype)
         plan = transformer_plan(attn=attn, **tkw)
     elif model == "vit":
         # same TPU-shaped trunk as the transformer leg (head_dim 128):

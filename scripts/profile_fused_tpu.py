@@ -1,14 +1,20 @@
 #!/usr/bin/env python
-"""On-chip op-level profile of the fused split-CNN step.
+"""On-chip op-level profile of a fused training step.
 
 SURVEY.md §5 (tracing/profiling) promises jax.profiler traces; this
-script turns one into a committed, reviewable artifact: run the fused
-headline workload (split CNN, batch 64) on the default backend under
+script turns one into a committed, reviewable artifact: run a fused
+workload on the default backend under
 ``utils.profiling.device_trace``, parse the Perfetto trace the profiler
 writes, and emit the top ops by total device time plus the traced
-steps/sec. Output: ``artifacts/tpu_profile_<date>.json`` (committed when
-produced on the chip) and one stdout JSON line for the opportunistic
-window runner (scripts/tpu_window_runner.py).
+steps/sec. Models: the split CNN headline (default) or the bench
+transformer trunk via ``SLT_PROFILE_MODEL=transformer``, configured
+by the SAME env knobs as the bench legs (``SLT_BENCH_SEQ`` /
+``SLT_BENCH_DMODEL`` / ``SLT_BENCH_ATTN`` / ``SLT_BENCH_DTYPE``) so
+profiling the leg you just benchmarked takes the same exports. Output:
+``artifacts/tpu_profile_<date>.json`` for the CNN, or
+``tpu_profile_transformer_<attn>_T<seq>_d<width>_<date>.json``
+(committed when produced on the chip), plus one stdout JSON line for
+the opportunistic window runner (scripts/tpu_window_runner.py).
 
 The trace file itself (MBs, binary) stays out of git — the summary is
 the evidence: which XLA fusions the step spends its time in, and how
@@ -81,43 +87,92 @@ def main() -> None:
     from split_learning_tpu.utils.profiling import device_trace
 
     batch = int(os.environ.get("SLT_PROFILE_BATCH", "64"))
-    ds = synthetic("mnist", n_train=batch, n_test=8, seed=0)
-    x = np.asarray(ds.train.x[:batch])
-    y = np.asarray(ds.train.y[:batch])
+    model = os.environ.get("SLT_PROFILE_MODEL", "split_cnn")
+    # the bench legs' own env names, so profiling the leg you just
+    # benchmarked takes the SAME exports — a divergent knob here would
+    # silently profile a different program than the leg it claims to
+    # corroborate
+    attn = os.environ.get("SLT_BENCH_ATTN", "flash")
+    dtype = os.environ.get("SLT_BENCH_DTYPE", "bfloat16")
+    seq = d_model = None
+    if model == "transformer":
+        # the bench transformer trunk, from the one shared builder
+        # (bench.transformer_trunk_kwargs): profiles WHERE the
+        # flash/dense step spends its device time, complementing the
+        # steps/sec legs
+        from bench import _seq_len, transformer_trunk_kwargs
+        from split_learning_tpu.models.transformer import transformer_plan
+        tkw = transformer_trunk_kwargs("split", dtype)
+        seq = _seq_len()   # the same parse the trunk builder used
+        d_model = tkw["d_model"]
+        plan = transformer_plan(attn=attn, **tkw)
+        rs = np.random.RandomState(0)
+        x = rs.randint(0, 256, (batch, seq)).astype(np.int32)
+        y = rs.randint(0, 10, (batch,)).astype(np.int32)
+    elif model == "split_cnn":
+        ds = synthetic("mnist", n_train=batch, n_test=8, seed=0)
+        x = np.asarray(ds.train.x[:batch])
+        y = np.asarray(ds.train.y[:batch])
+        plan = get_plan(mode="split")
+    else:
+        # bench.py convention: a bad knob value is refused, never
+        # silently measured (and here mislabeled) as something else
+        raise SystemExit(f"SLT_PROFILE_MODEL={model}: only split_cnn "
+                         "and transformer are profilable")
 
     cfg = Config(mode="split", batch_size=batch, lr=0.01)
-    plan = get_plan(mode="split")
     trainer = FusedSplitTrainer(plan, cfg, jax.random.PRNGKey(0), x)
     device = trainer.state.step.devices().pop()
 
+    loss = None
     for _ in range(WARMUP):
-        trainer.train_step_async(x, y)
-    jax.block_until_ready(trainer.state.params)
+        loss = trainer.train_step_async(x, y)
+    # drain warmup with a data-dependent transfer, NOT
+    # block_until_ready (early-returns through the tunnel): warmup
+    # steps still executing when the trace opens would pollute the
+    # traced window's op counts and steps/sec
+    float(loss)
 
     log_dir = os.environ.get("SLT_PROFILE_DIR") or os.path.join(
         "/tmp", f"slt_profile_{os.getpid()}")
-    t0 = time.perf_counter()
     with device_trace(log_dir):
+        t0 = time.perf_counter()
+        loss = None
         for _ in range(TRACED):
-            trainer.train_step_async(x, y)
-        jax.block_until_ready(trainer.state.params)
-    wall = time.perf_counter() - t0
+            loss = trainer.train_step_async(x, y)
+        # close with a host transfer of a data-dependent scalar:
+        # through the axon tunnel block_until_ready returns early
+        # (the bench.py lesson — rounds 1-2 published dispatch
+        # latency as throughput), and the float() cannot complete
+        # until the whole donated-state chain has executed
+        float(loss)
+        # ...and the clock closes BEFORE the with-block exits:
+        # stop_trace serializes the whole Perfetto trace (measured
+        # 70 s for a 50-step transformer trace) and must never ride
+        # the steps/sec denominator
+        wall = time.perf_counter() - t0
 
     trace_path = newest_trace(log_dir)
     summary = {
-        "what": ("jax.profiler trace summary of the fused split-CNN "
+        "what": (f"jax.profiler trace summary of the fused {model} "
                  "step (top ops by total time per trace process)"),
         "date": time.strftime("%Y-%m-%d"),
         "platform": device.platform,
         "device_kind": getattr(device, "device_kind", device.platform),
+        "model": model,
+        "attn": attn if model == "transformer" else None,
+        "seq_len": seq,
+        "d_model": d_model,
         "batch": batch,
         "traced_steps": TRACED,
         "traced_steps_per_sec": round(TRACED / wall, 2),
         "trace_file": trace_path,
         "top_ops": summarize_trace(trace_path) if trace_path else None,
     }
+    stem = ("tpu_profile" if model == "split_cnn"
+            else f"tpu_profile_{model}_{attn}_T{seq}_d{d_model}")
     out_path = os.path.join(REPO, "artifacts",
-                            f"tpu_profile_{time.strftime('%Y-%m-%d')}.json")
+                            f"{stem}_{time.strftime('%Y-%m-%d')}.json")
     on_tpu = device.platform == "tpu"
     if on_tpu:
         with open(out_path, "w") as f:
