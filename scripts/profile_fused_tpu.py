@@ -8,11 +8,12 @@ workload on the default backend under
 writes, and emit the top ops by total device time plus the traced
 steps/sec. Models: the split CNN headline (default) or the bench
 transformer trunk via ``SLT_PROFILE_MODEL=transformer``, configured
-by the SAME env knobs as the bench legs (``SLT_BENCH_SEQ`` /
-``SLT_BENCH_DMODEL`` / ``SLT_BENCH_ATTN`` / ``SLT_BENCH_DTYPE``) so
-profiling the leg you just benchmarked takes the same exports. Output:
+by the SAME env knobs AND defaults as the bench legs
+(``SLT_BENCH_SEQ`` / ``SLT_BENCH_DMODEL`` / ``SLT_BENCH_ATTN`` /
+``SLT_BENCH_DTYPE`` / ``SLT_BENCH_BATCH``) so profiling the leg you
+just benchmarked takes the same exports. Output:
 ``artifacts/tpu_profile_<date>.json`` for the CNN, or
-``tpu_profile_transformer_<attn>_T<seq>_d<width>_<date>.json``
+``tpu_profile_transformer_<attn>_<dtype>_T<seq>_d<width>_<date>.json``
 (committed when produced on the chip), plus one stdout JSON line for
 the opportunistic window runner (scripts/tpu_window_runner.py).
 
@@ -43,7 +44,11 @@ def newest_trace(log_dir: str) -> str | None:
     return max(paths, default=None)
 
 
-def summarize_trace(path: str, top_n: int = 15) -> dict:
+def summarize_trace(path: str, top_n: int = 30) -> dict:
+    # 30, not 15: a wide-model step fragments the trunk into many
+    # mid-sized fusions that pushed half the mha.* kernels below a
+    # 15-op cut (seen at d1024), and the mha share is exactly what
+    # the artifact exists to show
     """Chrome-trace summary: per process (pid), top events by total
     duration. Device processes carry the XLA op timeline; host
     processes carry Python/runtime frames."""
@@ -86,14 +91,15 @@ def main() -> None:
     from split_learning_tpu.utils import Config
     from split_learning_tpu.utils.profiling import device_trace
 
-    batch = int(os.environ.get("SLT_PROFILE_BATCH", "64"))
     model = os.environ.get("SLT_PROFILE_MODEL", "split_cnn")
-    # the bench legs' own env names, so profiling the leg you just
-    # benchmarked takes the SAME exports — a divergent knob here would
+    # the bench legs' own env names AND defaults, so profiling the leg
+    # you just benchmarked takes the SAME exports — a divergent knob
+    # (or a divergent default on a shared name, which is worse) would
     # silently profile a different program than the leg it claims to
     # corroborate
-    attn = os.environ.get("SLT_BENCH_ATTN", "flash")
-    dtype = os.environ.get("SLT_BENCH_DTYPE", "bfloat16")
+    batch = int(os.environ.get("SLT_BENCH_BATCH", "64"))
+    attn = os.environ.get("SLT_BENCH_ATTN", "full")
+    dtype = os.environ.get("SLT_BENCH_DTYPE", "float32")
     seq = d_model = None
     if model == "transformer":
         # the bench transformer trunk, from the one shared builder
@@ -161,6 +167,7 @@ def main() -> None:
         "device_kind": getattr(device, "device_kind", device.platform),
         "model": model,
         "attn": attn if model == "transformer" else None,
+        "dtype": dtype if model == "transformer" else None,
         "seq_len": seq,
         "d_model": d_model,
         "batch": batch,
@@ -170,7 +177,7 @@ def main() -> None:
         "top_ops": summarize_trace(trace_path) if trace_path else None,
     }
     stem = ("tpu_profile" if model == "split_cnn"
-            else f"tpu_profile_{model}_{attn}_T{seq}_d{d_model}")
+            else f"tpu_profile_{model}_{attn}_{dtype}_T{seq}_d{d_model}")
     out_path = os.path.join(REPO, "artifacts",
                             f"{stem}_{time.strftime('%Y-%m-%d')}.json")
     on_tpu = device.platform == "tpu"
