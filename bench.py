@@ -72,7 +72,8 @@ def _seq_len() -> int:
 
 
 def _bench_d_model() -> int:
-    """Transformer-leg width (SLT_BENCH_DMODEL, default 256). One
+    """Attention-family leg width — transformer AND ViT —
+    (SLT_BENCH_DMODEL, default 256). One
     parse site: the plan builder and the leg record must never read
     different values. Multiples of 128 only — heads scale with width
     so head_dim stays exactly the 128-lane tile, the shape every
@@ -288,10 +289,12 @@ def measure_fused(quick: bool) -> dict:
         plan = transformer_plan(attn=attn, **tkw)
     elif model == "vit":
         # same TPU-shaped trunk as the transformer leg (head_dim 128):
-        # 32x32/patch-4 images -> 64 patch tokens
+        # 32x32/patch-4 images -> 64 patch tokens; width from the same
+        # SLT_BENCH_DMODEL knob (heads scale so head_dim stays 128)
         from split_learning_tpu.models.vit import vit_plan
-        vkw = dict(mode=mode, dtype=np.dtype(dtype), d_model=256,
-                   num_heads=2)
+        vd = _bench_d_model()
+        vkw = dict(mode=mode, dtype=np.dtype(dtype), d_model=vd,
+                   num_heads=vd // 128)
         plan = vit_plan(attn=attn, **vkw)
     else:
         plan = get_plan(model=model, mode=mode, dtype=dtype)
@@ -377,7 +380,8 @@ def measure_fused(quick: bool) -> dict:
         "attn": attn,
         "batch": batch,
         "seq_len": _seq_len() if model == "transformer" else None,
-        "d_model": _bench_d_model() if model == "transformer" else None,
+        "d_model": (_bench_d_model() if model in ("transformer", "vit")
+                    else None),
         # the block edge the flash kernel actually ran with, frozen at
         # measurement time: assemblers must never re-derive it from a
         # later _pick_block (whose constant is exactly what sweep

@@ -92,7 +92,11 @@ MUST_LAND = [
     # 3. first on-chip number for the round-4 ViT family
     {"id": "vit_b256_bf16.q", "role": "fused",
      "env": {"SLT_BENCH_MODEL": "vit", "SLT_BENCH_BATCH": "256",
-             "SLT_BENCH_DTYPE": "bfloat16"},
+             "SLT_BENCH_DTYPE": "bfloat16",
+             # pinned: the leg id means the d256 model; an ambient
+             # SLT_BENCH_DMODEL export (used by the d-width legs)
+             # must never silently change what this id measures
+             "SLT_BENCH_DMODEL": "256"},
      "quick": True, "timeout": 900, "expected_s": 240},
     # 4. dense T=1024 confirmation: resolve the round-4 SUSPECT (2.61
     #    steps/s, 16x below the round-3 twin) — confirm or retire
