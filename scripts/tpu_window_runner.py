@@ -154,6 +154,12 @@ EXPLORATORY = [
     _t_leg(256, 64, "flash", True, 900, expected_s=240),
     # long-context ceiling refresh on the preflight-gated kernels
     _t_leg(16384, 16, "flash", True, 1700, expected_s=420),
+    # full-length provenance upgrades (10x the timed steps of the .q
+    # twins; the long-context assembler ranks full over quick, so
+    # these displace the quick records in the published artifact when
+    # they land consistent)
+    _t_leg(1024, 64, "flash", False, 1200, expected_s=300),
+    _t_leg(4096, 16, "flash", False, 1500, expected_s=360),
 ]
 
 LEGS = MUST_LAND + EXPLORATORY
@@ -280,7 +286,11 @@ def run_assemblers() -> None:
 
 def main():
     st = load_state()
-    log(f"runner up; {len(st['done'])}/{len(LEGS)} legs already done; "
+    # count only done ids still in LEGS: the round-keyed done-list
+    # accumulates retired leg ids (e.g. decode.tight), which made
+    # this line overstate completion
+    done_here = len(set(st["done"]) & {leg["id"] for leg in LEGS})
+    log(f"runner up; {done_here}/{len(LEGS)} legs already done; "
         f"deadline in {(DEADLINE - time.time()) / 3600:.1f}h")
     while True:
         if time.time() > DEADLINE:
