@@ -1,0 +1,57 @@
+"""artifacts/README.md is the claim-to-artifact index; an artifact the
+index does not mention is unreviewable evidence, and a mentioned file
+that no longer exists is a dangling citation. Date-stamped series are
+indexed by their stem pattern, so new dated runs don't require an
+index edit."""
+
+import os
+import re
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ART = os.path.join(REPO, "artifacts")
+
+
+def _index_text():
+    with open(os.path.join(ART, "README.md")) as f:
+        return f.read()
+
+
+def _dateless(name: str) -> str:
+    """Collapse a date-stamped artifact name to its series stem."""
+    return re.sub(r"_\d{4}-\d{2}-\d{2}", "_*", name)
+
+
+def test_every_artifact_is_indexed():
+    text = _index_text()
+    missing = []
+    for name in sorted(os.listdir(ART)):
+        if name == "README.md" or name.startswith("."):
+            continue
+        stem = _dateless(name)
+        # a file is indexed if its exact name, its dated-series stem,
+        # or its wildcard form appears
+        date = re.search(r"\d{4}-\d{2}-\d{2}", name)
+        forms = {name, stem, stem.replace("_*", "_<date>")}
+        if date:
+            forms.add(name.replace(date.group(0), "*"))
+            # prefix form: `tpu_profile_transformer_*` covers the
+            # per-shape trace family
+            parts = name.split("_")
+            for i in range(2, len(parts)):
+                forms.add("_".join(parts[:i]) + "_*")
+        if not any(f in text for f in forms):
+            missing.append(name)
+    assert not missing, f"artifacts not mentioned in the index: {missing}"
+
+
+def test_no_dangling_exact_citations():
+    """Every exact (non-wildcard) artifact filename the index cites
+    must exist."""
+    text = _index_text()
+    cited = re.findall(r"`([\w.\-]+\.(?:json|jsonl))`", text)
+    dangling = [c for c in cited
+                if "*" not in c and not os.path.exists(
+                    os.path.join(ART, c))]
+    assert not dangling, f"index cites missing artifacts: {dangling}"
