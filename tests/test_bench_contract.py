@@ -207,3 +207,25 @@ def test_bench_d_model_guard(monkeypatch):
     monkeypatch.setenv("SLT_BENCH_DMODEL", "320")
     with pytest.raises(SystemExit):
         _bench_d_model()
+
+
+def test_transformer_trunk_kwargs_contract(monkeypatch):
+    """The shared trunk builder (bench.transformer_trunk_kwargs) is
+    what both the legs and the profiler build from: heads must scale
+    with width so head_dim stays the 128-lane tile, and the max_len
+    floor must track the seq knob."""
+    import numpy as np
+    sys.path.insert(0, REPO)
+    from bench import transformer_trunk_kwargs
+    monkeypatch.delenv("SLT_BENCH_DMODEL", raising=False)
+    monkeypatch.delenv("SLT_BENCH_SEQ", raising=False)
+    kw = transformer_trunk_kwargs("split", "bfloat16")
+    assert kw["d_model"] == 256 and kw["num_heads"] == 2
+    assert kw["d_model"] // kw["num_heads"] == 128
+    assert kw["max_len"] == 2048
+    assert kw["dtype"] == np.dtype("bfloat16")
+    monkeypatch.setenv("SLT_BENCH_DMODEL", "1024")
+    monkeypatch.setenv("SLT_BENCH_SEQ", "8192")
+    kw = transformer_trunk_kwargs("split", "float32")
+    assert kw["num_heads"] == 8 and kw["d_model"] // kw["num_heads"] == 128
+    assert kw["max_len"] == 8192
