@@ -38,11 +38,17 @@ sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 _ID = re.compile(r"^T(\d+)\.b(\d+)\.(flash|full)\.(q|full)$")
-# A sweep leg pinned at what is NOW the default edge is the same
-# config a main flash leg would run today, so it qualifies as a flash
+# A sweep leg pinned at the edge a main flash leg actually runs with is
+# the same config that leg would re-measure, so it qualifies as a flash
 # candidate (that is how the adopted-edge numbers publish without
-# re-burning chip time on identical re-measurements). Edges that
-# don't match today's default stay sweep-only.
+# re-burning chip time on identical re-measurements). The comparison
+# edge is the main leg's RECORDED ``flash_block`` at the same
+# (seq, batch) when one exists — the runtime entry is `_resolve_block`,
+# which can cap below `_pick_block`'s static default (one-pass-refused
+# shapes), so keying promotion on the static default alone could admit
+# a sweep edge the main leg never compiles. Only when no main flash
+# record carries the field (pre-2026-08-01 jsonl) do we fall back to
+# `_pick_block`'s default. Edges matching neither stay sweep-only.
 _SWEEP_ID = re.compile(r"^sweep\.T(\d+)\.b(\d+)\.flash\.blk(\d+)$")
 
 
@@ -79,11 +85,32 @@ def load_records():
         return [json.loads(line) for line in f if line.strip()]
 
 
+def _recorded_blocks(records):
+    """(seq, batch) -> the ``flash_block`` the newest ok main flash leg
+    recorded — the edge `_resolve_block` actually compiled, which is
+    what sweep promotion must match (not the static default)."""
+    out = {}
+    for rec in records:
+        if rec.get("status") != "ok":
+            continue
+        m = _ID.match(rec.get("leg", ""))
+        if not m or m.group(3) != "flash":
+            continue
+        blk = (rec.get("result") or {}).get("flash_block")
+        if blk is None:
+            continue
+        key = (int(m.group(1)), int(m.group(2)))
+        if key not in out or rec.get("ts", 0) > out[key][0]:
+            out[key] = (rec.get("ts", 0), int(blk))
+    return {k: v[1] for k, v in out.items()}
+
+
 def assemble(records):
     # (seq, attn) -> (rank, leg_dict); rank orders candidates:
     # status first (a gate-passing "ok" must never be displaced by a
     # later invalid/oom attempt), then full-over-quick, then recency
     status_rank = {"ok": 2, "oom": 1, "invalid": 0}
+    recorded = _recorded_blocks(records)
     best = {}
     for rec in records:
         if rec.get("status") not in status_rank:
@@ -98,8 +125,13 @@ def assemble(records):
             if not m:
                 continue
             seq, batch, blk = (int(g) for g in m.groups())
-            if blk != _default_block(seq):
-                continue   # non-default edge: sweep-artifact-only
+            # the main leg's recorded runtime edge when evidence exists
+            # (see the _SWEEP_ID comment), else the static default
+            main_edge = recorded.get((seq, batch), None)
+            if main_edge is None:
+                main_edge = _default_block(seq)
+            if blk != main_edge:
+                continue   # off the main leg's edge: sweep-artifact-only
             attn_key, is_full = "flash", False
         if rec["status"] == "oom":
             leg = {"model": "transformer", "mode": "split", "attn": attn_key,

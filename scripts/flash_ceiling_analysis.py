@@ -41,16 +41,18 @@ from split_learning_tpu.utils.backend import reexec_pinned_cpu  # noqa: E402
 def _newest_artifact() -> str:
     """The newest assembled long-context artifact — the same glob
     discipline tests/test_long_context_artifact.py pins, so the
-    analysis always reads the numbers the repo currently publishes."""
+    analysis always reads the numbers the repo currently publishes.
+    Naming assumption the sorted()[-1] relies on: the assemblers write
+    ``bench_tpu_transformer_<YYYY-MM-DD>.json``, so lexicographic order
+    IS date order. Resolved lazily from main() — importing this module
+    in an artifact-free checkout (fresh clone, tests) must be safe; the
+    SystemExit fires only when an actual run finds nothing to analyze."""
     import glob
     paths = sorted(glob.glob(os.path.join(
         REPO, "artifacts", "bench_tpu_transformer_*.json")))
     if not paths:
         raise SystemExit("no assembled bench_tpu_transformer artifact")
     return paths[-1]
-
-
-ARTIFACT = _newest_artifact()
 
 
 def _v5e_peak() -> float:
@@ -96,10 +98,11 @@ def bench_plan_flops(t: int, batch: int):
 
 
 def main() -> int:
+    artifact = _newest_artifact()
     t, batch = 1024, 64
     total, attn_dense, n_layers = bench_plan_flops(t, batch)
 
-    with open(ARTIFACT) as f:
+    with open(artifact) as f:
         art = json.load(f)
     legs = {(l.get("seq_len"), l.get("attn")): l for l in art["legs"]}
     flash = legs.get((t, "flash"))
@@ -108,7 +111,7 @@ def main() -> int:
     # must never headline a number the assembler quarantined
     if (flash is None or flash.get("status") != "ok"
             or not flash.get("valid") or "suspect" in flash):
-        raise SystemExit(f"no clean T={t} flash leg in {ARTIFACT}")
+        raise SystemExit(f"no clean T={t} flash leg in {artifact}")
     # dense comparator: prefer the same artifact's clean dense leg
     # (the 08-01 confirmation retired the round-4 SUSPECT read);
     # fall back to the round-3 artifact for older assemblies
@@ -116,7 +119,7 @@ def main() -> int:
     dense = legs.get((t, "full"))
     if dense and dense.get("valid") and "suspect" not in dense:
         dense_sps = dense["steps_per_sec"]
-        dense_src = os.path.relpath(ARTIFACT, REPO)
+        dense_src = os.path.relpath(artifact, REPO)
     else:
         r3 = os.path.join(REPO, "artifacts",
                           "bench_tpu_transformer_2026-07-30.json")
@@ -171,7 +174,7 @@ def main() -> int:
         "provenance": {
             "date": time.strftime("%Y-%m-%d"),
             "command": "scripts/flash_ceiling_analysis.py",
-            "measured_from": os.path.relpath(ARTIFACT, REPO),
+            "measured_from": os.path.relpath(artifact, REPO),
             "shape": {"seq_len": t, "batch": batch, "d_model": 256,
                       "heads": 2, "head_dim": 128, "layers": n_layers},
         },

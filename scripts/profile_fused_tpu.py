@@ -38,6 +38,29 @@ WARMUP = 20
 TRACED = 50
 
 
+def profile_batch() -> int:
+    """The profiled batch, from the bench legs' own knob
+    (``SLT_BENCH_BATCH``). ``SLT_PROFILE_BATCH`` is the knob's
+    pre-unification name: honored as a deprecated fallback (with a
+    warning) so old invocations keep profiling the shape they asked
+    for, and refused outright when both are set and disagree — the
+    silent alternative would profile a different shape than the leg it
+    claims to corroborate."""
+    bench = os.environ.get("SLT_BENCH_BATCH")
+    legacy = os.environ.get("SLT_PROFILE_BATCH")
+    if legacy is not None:
+        if bench is not None and int(bench) != int(legacy):
+            raise SystemExit(
+                f"SLT_PROFILE_BATCH={legacy} conflicts with "
+                f"SLT_BENCH_BATCH={bench}: drop the deprecated "
+                "SLT_PROFILE_BATCH (the bench knob is authoritative)")
+        print("[profile] SLT_PROFILE_BATCH is deprecated; use "
+              "SLT_BENCH_BATCH (same default, shared with the bench "
+              "legs)", file=sys.stderr)
+        return int(legacy)
+    return int(bench) if bench is not None else 64
+
+
 def newest_trace(log_dir: str) -> str | None:
     paths = glob.glob(os.path.join(log_dir, "plugins", "profile",
                                    "*", "*.trace.json.gz"))
@@ -97,7 +120,7 @@ def main() -> None:
     # (or a divergent default on a shared name, which is worse) would
     # silently profile a different program than the leg it claims to
     # corroborate
-    batch = int(os.environ.get("SLT_BENCH_BATCH", "64"))
+    batch = profile_batch()
     attn = os.environ.get("SLT_BENCH_ATTN", "full")
     dtype = os.environ.get("SLT_BENCH_DTYPE", "float32")
     seq = d_model = None

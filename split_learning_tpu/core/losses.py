@@ -17,5 +17,13 @@ def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
 
 
+def per_example_cross_entropy(logits: jax.Array,
+                              labels: jax.Array) -> jax.Array:
+    """Unreduced ``[batch]`` CE — the coalesced server step needs the
+    per-example vector so one batched dispatch can hand each client its
+    own segment-mean loss (runtime/server.py _dispatch_group)."""
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+
+
 def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))

@@ -819,8 +819,15 @@ def cmd_serve(args) -> int:
             "mnist" if cfg.dataset == "synthetic" else cfg.dataset,
             (28, 28, 1))
         sample = np.zeros((cfg.batch_size,) + shape, np.float32)
-    runtime = ServerRuntime(plan, cfg, jax.random.PRNGKey(cfg.seed), sample,
-                            strict_steps=not args.allow_out_of_order)
+    try:
+        runtime = ServerRuntime(plan, cfg, jax.random.PRNGKey(cfg.seed),
+                                sample,
+                                strict_steps=not args.allow_out_of_order,
+                                coalesce_max=args.coalesce_max,
+                                coalesce_window_ms=args.coalesce_window_ms)
+    except ValueError as e:  # e.g. --coalesce-max outside split mode
+        print(f"[error] {e}", file=sys.stderr)
+        return 2
 
     # the server party owns its half's persistence (the client cannot
     # checkpoint it across HTTP): periodic saves + resume with the step
@@ -954,6 +961,7 @@ def cmd_serve(args) -> int:
         print("[serve] shutting down")
         server.stop()
     finally:
+        runtime.close()  # flush + join the coalescer, if one is running
         if ckptr is not None:
             # saves are async — make the in-flight checkpoint durable
             # before the process exits, or a resume comes back behind the
@@ -1255,6 +1263,17 @@ def main(argv: Optional[list] = None) -> int:
                     help="accept out-of-order client steps (required by "
                          "pipelined clients, --pipeline-depth > 1; disables "
                          "the replay-refusing strict step handshake)")
+    ps.add_argument("--coalesce-max", dest="coalesce_max", type=int,
+                    default=1,
+                    help="split mode: batch up to N concurrent split-step "
+                         "requests into one server dispatch (group-mean "
+                         "SGD update — see README 'Request coalescing' "
+                         "for the semantics trade-off); 1 = serialized")
+    ps.add_argument("--coalesce-window-ms", dest="coalesce_window_ms",
+                    type=float, default=2.0,
+                    help="how long a coalescing group waits for peers "
+                         "after its first request before flushing partial "
+                         "(only with --coalesce-max > 1)")
     ps.set_defaults(fn=cmd_serve)
 
     pe = sub.add_parser("eval", help="evaluate a checkpoint on the test split")

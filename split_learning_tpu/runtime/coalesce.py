@@ -1,0 +1,200 @@
+"""Server-side request coalescing — dynamic batching of split-step traffic.
+
+The serving fix for the multi-client flat-throughput problem (BASELINE.md
+config 3): each client's step is a small jitted dispatch under the server
+lock, so server throughput is flat in N and per-dispatch overhead dominates
+exactly where the accelerator should be amortizing it. Here concurrent
+``split_step`` calls enqueue and block on a future; one flusher thread
+stacks up to ``max_group`` same-shape requests (or whatever arrived within
+``window_s``) into ONE batched dispatch over the concatenated batch.
+
+Semantics (documented trade-off, README "Request coalescing"): the group
+applies a SINGLE server SGD update on the group-mean loss instead of N
+sequential updates — each client still receives the gradient of its OWN
+segment-mean loss (the group gradient rescaled by group/segment size, exact
+for per-example losses), so the client-side math is unchanged and a group
+of one reproduces the serialized semantics. A group of one is also what a
+window flush with a single waiter produces, which is why ``max_group=1``
+servers skip this module entirely (bit-for-bit serialized path).
+
+This is the queue half; the batched math lives in
+:meth:`ServerRuntime._dispatch_group` (runtime/server.py), injected as
+``dispatch`` so the coalescer stays free of jax and trivially testable.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional, Tuple
+
+import numpy as np
+
+from split_learning_tpu.transport.base import TransportStats
+
+
+def pow2_bucket(n: int) -> int:
+    """Smallest power of two >= n — group batches pad up to these buckets
+    so the jit cache sees O(log max_batch) distinct shapes, not one entry
+    per arrival pattern."""
+    if n < 1:
+        raise ValueError(f"bucket size must be positive (got {n})")
+    return 1 << (n - 1).bit_length()
+
+
+@dataclass
+class CoalesceRequest:
+    """One enqueued split step waiting for its group to flush."""
+
+    acts: np.ndarray
+    labels: np.ndarray
+    step: int
+    client_id: int
+    done: threading.Event = field(default_factory=threading.Event)
+    result: Optional[Tuple[np.ndarray, float]] = None
+    error: Optional[BaseException] = None
+
+    def shape_key(self) -> tuple:
+        """Requests coalesce only when everything but the batch row count
+        matches — mixing trailing shapes or dtypes in one concatenate
+        would be a silent shape error or an implicit cast."""
+        return (self.acts.shape[1:], self.acts.dtype.str,
+                self.labels.shape[1:], self.labels.dtype.str)
+
+
+class RequestCoalescer:
+    """FIFO queue + flusher thread turning concurrent requests into groups.
+
+    ``dispatch(group, flush_reason)`` must resolve every request in the
+    group (set ``result`` or ``error`` and fire ``done``); the coalescer
+    guarantees each request is handed to exactly one dispatch call, in
+    arrival order within a shape class. Requests whose shape differs from
+    the group head's are left queued for the next group, so a mixed-shape
+    burst degrades to per-shape groups instead of failing.
+
+    Counters (all under ``stats.counters``, reported by the server's
+    /health): ``groups_flushed``, ``requests_coalesced``, ``flush_full`` /
+    ``flush_window`` (why each group closed), plus the dispatcher's own
+    ``compile_count``. ``stats.record`` times each flush, so the p50/p99
+    the summary reports are per-group dispatch latencies.
+    """
+
+    def __init__(self, dispatch: Callable[[List[CoalesceRequest], str], None],
+                 max_group: int, window_s: float) -> None:
+        if max_group < 2:
+            raise ValueError(
+                f"coalescing needs max_group >= 2 (got {max_group}); "
+                "max_group=1 is the serialized path — don't build a "
+                "coalescer for it")
+        if window_s < 0:
+            raise ValueError(f"window must be >= 0 (got {window_s})")
+        self._dispatch = dispatch
+        self.max_group = max_group
+        self.window_s = window_s
+        self.stats = TransportStats()
+        self._queue: List[CoalesceRequest] = []
+        self._cond = threading.Condition()
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name="slt-coalescer", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------------ #
+    def submit(self, acts: np.ndarray, labels: np.ndarray, step: int,
+               client_id: int, timeout: float = 120.0
+               ) -> Tuple[np.ndarray, float]:
+        """Enqueue one request and block until its group's dispatch
+        resolves it. Server-side errors (ProtocolError included) re-raise
+        in the caller's thread, so the transport-facing contract is
+        identical to the serialized path."""
+        req = CoalesceRequest(np.asarray(acts), np.asarray(labels),
+                              step, client_id)
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("coalescer is closed")
+            self._queue.append(req)
+            self._cond.notify_all()
+        if not req.done.wait(timeout=timeout):
+            raise TimeoutError(
+                f"coalesced split_step for client {client_id} step {step} "
+                f"not flushed within {timeout}s")
+        if req.error is not None:
+            raise req.error
+        assert req.result is not None
+        return req.result
+
+    # ------------------------------------------------------------------ #
+    def _collect_group(self) -> Optional[Tuple[List[CoalesceRequest], str]]:
+        """Block for a head request, then gather same-shape peers until
+        the group is full or the window since the head's arrival closes.
+        Returns None only at shutdown."""
+        with self._cond:
+            while not self._queue and not self._closed:
+                self._cond.wait()
+            if not self._queue:
+                return None  # closed and drained
+            head = self._queue[0]
+            key = head.shape_key()
+            deadline = time.monotonic() + self.window_s
+
+            def take_matching(group: List[CoalesceRequest]) -> None:
+                remaining = []
+                for r in self._queue:
+                    if len(group) < self.max_group and r.shape_key() == key:
+                        group.append(r)
+                    else:
+                        remaining.append(r)
+                self._queue = remaining
+
+            group: List[CoalesceRequest] = []
+            take_matching(group)
+            while len(group) < self.max_group:
+                budget = deadline - time.monotonic()
+                if budget <= 0:
+                    break
+                self._cond.wait(timeout=budget)
+                take_matching(group)
+            reason = "full" if len(group) >= self.max_group else "window"
+            return group, reason
+
+    def _run(self) -> None:
+        while True:
+            got = self._collect_group()
+            if got is None:
+                return
+            group, reason = got
+            t0 = time.perf_counter()
+            try:
+                self._dispatch(group, reason)
+            except BaseException as exc:  # noqa: BLE001 — must not kill
+                # the flusher: every waiter gets the failure, the thread
+                # lives on for the next group
+                for r in group:
+                    if not r.done.is_set():
+                        r.error = exc
+                        r.done.set()
+            self.stats.record(time.perf_counter() - t0)
+            self.stats.incr("groups_flushed")
+            self.stats.incr("requests_coalesced", len(group))
+            self.stats.incr(f"flush_{reason}")
+
+    # ------------------------------------------------------------------ #
+    def counters(self) -> dict:
+        """Snapshot for /health: raw counters plus the derived mean
+        occupancy (requests per flushed group — the number the bench leg
+        publishes)."""
+        with self.stats._lock:
+            c = dict(self.stats.counters)
+        groups = c.get("groups_flushed", 0)
+        c["mean_occupancy"] = (
+            c.get("requests_coalesced", 0) / groups if groups else 0.0)
+        return c
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop accepting requests, flush what is queued, join the
+        flusher. Idempotent."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout=timeout)

@@ -15,6 +15,7 @@ per-step psum over the ``data`` mesh axis) see
 
 from __future__ import annotations
 
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import jax
@@ -34,16 +35,24 @@ class MultiClientSplitRunner:
                  transport_factory: Callable[[int], Transport],
                  num_clients: Optional[int] = None,
                  sync_bottoms_every: int = 0,
-                 logger: Optional[Any] = None) -> None:
+                 logger: Optional[Any] = None,
+                 concurrent: bool = False) -> None:
         """transport_factory(client_id) -> a Transport for that client.
         sync_bottoms_every: if > 0, FedAvg the client bottom stages every
-        that many rounds (0 = fully personal bottoms)."""
+        that many rounds (0 = fully personal bottoms).
+        concurrent: submit each round's per-client steps from a thread
+        pool instead of round-robin — what actually puts concurrent
+        traffic in front of a coalescing server (ServerRuntime
+        coalesce_max > 1). Round-robin stays the default: it is the
+        deterministic relay schedule the interleaving tests pin."""
         n = num_clients if num_clients is not None else cfg.num_clients
         if n < 1:
             raise ValueError("need at least one client")
         self.cfg = cfg
         self.sync_bottoms_every = sync_bottoms_every
         self.logger = logger
+        self.concurrent = concurrent
+        self._pool: Optional[ThreadPoolExecutor] = None
         self.clients: List[SplitClientTrainer] = [
             SplitClientTrainer(
                 plan, cfg, jax.random.fold_in(rng, i) if n > 1 else rng,
@@ -55,25 +64,48 @@ class MultiClientSplitRunner:
 
     def train_round(self, batches_per_client: Sequence[Tuple[np.ndarray, np.ndarray]]
                     ) -> List[float]:
-        """One interleaved round: each client takes one step in turn."""
+        """One round: each client takes one step — in turn (default), or
+        all in flight at once (``concurrent=True``). Either way every
+        client's step lands before the round returns, so per-client step
+        counters stay sequential and the strict handshake holds."""
         if len(batches_per_client) != len(self.clients):
             raise ValueError(
                 f"expected {len(self.clients)} batches, "
                 f"got {len(batches_per_client)}")
-        losses = []
-        for i, (client, (x, y)) in enumerate(
-                zip(self.clients, batches_per_client)):
+
+        def one(i: int, client: SplitClientTrainer,
+                x: np.ndarray, y: np.ndarray) -> float:
             step = self._steps[i]
             loss = client.train_step(x, y, step)
             self._steps[i] += 1
             if loss is not None and self.logger is not None:
                 self.logger.log_metric(f"loss_client{i}", loss, step=step)
-            losses.append(loss)
+            return loss
+
+        if self.concurrent and len(self.clients) > 1:
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=len(self.clients),
+                    thread_name_prefix="slt-client")
+            futures = [
+                self._pool.submit(one, i, client, x, y)
+                for i, (client, (x, y)) in enumerate(
+                    zip(self.clients, batches_per_client))]
+            losses = [f.result() for f in futures]
+        else:
+            losses = [one(i, client, x, y)
+                      for i, (client, (x, y)) in enumerate(
+                          zip(self.clients, batches_per_client))]
         self._rounds += 1
         if (self.sync_bottoms_every
                 and self._rounds % self.sync_bottoms_every == 0):
             self.sync_bottoms()
         return losses
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
 
     def sync_bottoms(self) -> None:
         """FedAvg the initialized client bottom stages (optimizer state

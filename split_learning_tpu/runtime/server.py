@@ -26,8 +26,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from split_learning_tpu.core.losses import cross_entropy
+from split_learning_tpu.core.losses import (
+    cross_entropy, per_example_cross_entropy)
 from split_learning_tpu.core.stage import SplitPlan
+from split_learning_tpu.runtime.coalesce import (
+    CoalesceRequest, RequestCoalescer, pow2_bucket)
 from split_learning_tpu.runtime.state import (
     TrainState, apply_grads, make_state, make_tx)
 from split_learning_tpu.utils.config import Config
@@ -51,7 +54,14 @@ class ServerRuntime:
     transitions happen under one lock, and the math itself is pure."""
 
     def __init__(self, plan: SplitPlan, cfg: Config, rng: jax.Array,
-                 sample_input: np.ndarray, strict_steps: bool = True) -> None:
+                 sample_input: np.ndarray, strict_steps: bool = True,
+                 coalesce_max: int = 1,
+                 coalesce_window_ms: float = 2.0) -> None:
+        """coalesce_max > 1 turns on request coalescing (classic split
+        mode only): concurrent split_step calls that arrive within
+        ``coalesce_window_ms`` of each other batch into one dispatch, up
+        to ``coalesce_max`` per group (runtime/coalesce.py). 1 = the
+        serialized path, bit-for-bit — the coalescer is never built."""
         self.plan = plan
         self.cfg = cfg
         self.mode = cfg.mode
@@ -71,6 +81,13 @@ class ServerRuntime:
         all_params = plan.init(rng, jnp.asarray(sample_input))
         self._tx = make_tx(cfg)
 
+        self._coalescer: Optional[RequestCoalescer] = None
+        if coalesce_max > 1 and cfg.mode != "split":
+            raise ValueError(
+                f"coalesce_max={coalesce_max} is split-mode only (the "
+                "batched group step computes the loss server-side); mode "
+                f"is {cfg.mode!r}")
+
         if cfg.mode == "federated":
             # federated server keeps the full model (ref src/model_def.py:56-57)
             self.state = make_state(tuple(all_params), self._tx)
@@ -83,6 +100,14 @@ class ServerRuntime:
             self.state = make_state(all_params[self.server_stage], self._tx)
             self._agg = None
             self._build_jitted()
+            if coalesce_max > 1:
+                # distinct padded group shapes compiled so far — the
+                # pow2 buckets bound this at O(log max_group_rows), and
+                # its size is the compile_count counter /health reports
+                self._coalesce_shapes: set = set()
+                self._coalescer = RequestCoalescer(
+                    self._dispatch_group, coalesce_max,
+                    coalesce_window_ms / 1e3)
         # residuals for the U-shaped two-hop step, keyed by step
         self._u_residual: Dict[int, Any] = {}
 
@@ -105,6 +130,26 @@ class ServerRuntime:
                 return new_state, g_acts, loss
 
             self._split_step = jax.jit(step_fn, donate_argnums=(0,))
+
+            # coalesced group step: one dispatch over a concatenated
+            # (pow2-padded) group. ``weights`` is 1/num_real on real rows
+            # and 0 on padding, so the scalar objective is the group-mean
+            # loss and padded rows contribute exactly nothing to either
+            # gradient; the per-example vector comes back so the caller
+            # can hand each client its own segment-mean loss.
+            def group_step_fn(state: TrainState, acts, labels, weights):
+                def loss_fn(params, acts):
+                    logits = stage.apply(params, acts)
+                    per_ex = per_example_cross_entropy(logits, labels)
+                    return jnp.sum(per_ex * weights), per_ex
+                (_, per_ex), (g_params, g_acts) = jax.value_and_grad(
+                    loss_fn, argnums=(0, 1), has_aux=True)(
+                        state.params, acts)
+                new_state = apply_grads(tx, state, g_params)
+                return new_state, g_acts, per_ex
+
+            self._coalesced_step = jax.jit(group_step_fn,
+                                           donate_argnums=(0,))
         else:
             # U-shaped trunk: forward produces features; backward receives
             # d(loss)/d(features) from the client head and returns
@@ -143,6 +188,12 @@ class ServerRuntime:
             # mode guard ≡ HTTP 400 (ref src/server_part.py:31-36)
             raise ProtocolError(
                 f"split_step called in mode {self.mode!r}", status=400)
+        if self._coalescer is not None:
+            # block on the group's future; the handshake runs at
+            # dispatch-admission time so a replayed step 409s its own
+            # client without poisoning the group
+            return self._coalescer.submit(activations, labels, step,
+                                          client_id)
         with self._lock:
             self._check_step(step, client_id)
             self.state, g_acts, loss = self._split_step(
@@ -156,6 +207,61 @@ class ServerRuntime:
             if self.on_step is not None:
                 self.on_step(acked)
             return np.asarray(g_acts), float(loss)
+
+    def _dispatch_group(self, group: "list[CoalesceRequest]",
+                        reason: str) -> None:
+        """Flusher callback (runtime/coalesce.py): one batched dispatch
+        for a same-shape group. Applies a SINGLE SGD update on the
+        group-mean loss; each client receives the gradient of its OWN
+        segment-mean loss (the group gradient rescaled by group/segment
+        rows — exact, because the loss is per-example) and its
+        segment-mean loss, so a group of one reproduces the serialized
+        semantics and the client-side math never changes."""
+        with self._lock:
+            admitted = []
+            for r in group:
+                try:
+                    self._check_step(r.step, r.client_id)
+                    admitted.append(r)
+                except ProtocolError as exc:
+                    r.error = exc
+                    r.done.set()
+            if not admitted:
+                return
+            sizes = [int(r.acts.shape[0]) for r in admitted]
+            total = sum(sizes)
+            padded = pow2_bucket(total)
+            acts = np.concatenate([r.acts for r in admitted], axis=0)
+            labels = np.concatenate([r.labels for r in admitted], axis=0)
+            if padded > total:
+                acts = np.concatenate(
+                    [acts, np.zeros((padded - total,) + acts.shape[1:],
+                                    acts.dtype)])
+                labels = np.concatenate(
+                    [labels, np.zeros((padded - total,) + labels.shape[1:],
+                                      labels.dtype)])
+            weights = np.zeros((padded,), np.float32)
+            weights[:total] = 1.0 / total
+            sig = (acts.shape, acts.dtype.str, labels.dtype.str)
+            if sig not in self._coalesce_shapes:
+                self._coalesce_shapes.add(sig)
+                self._coalescer.stats.incr("compile_count")
+            self.state, g_acts, per_ex = self._coalesced_step(
+                self.state, jnp.asarray(acts), jnp.asarray(labels),
+                jnp.asarray(weights))
+            g_acts = np.asarray(g_acts)
+            per_ex = np.asarray(per_ex)
+            off = 0
+            for r, b in zip(admitted, sizes):
+                seg = (g_acts[off:off + b] * (total / b)).astype(
+                    g_acts.dtype, copy=False)
+                r.result = (seg, float(per_ex[off:off + b].mean()))
+                off += b
+                acked = max(self._last_step.get(r.client_id, -1), r.step)
+                self._last_step[r.client_id] = acked
+                if self.on_step is not None:
+                    self.on_step(acked)
+                r.done.set()
 
     def predict(self, activations: np.ndarray,
                 client_id: int = 0) -> np.ndarray:
@@ -272,12 +378,23 @@ class ServerRuntime:
         with self._lock:
             step = max(self._last_step.values(), default=-1)
             step = max(step, self._step_floor)
-        return {"status": "healthy", "mode": self.mode,
+        info = {"status": "healthy", "mode": self.mode,
                 "model_type": model_type, "step": step,
                 # pipelined clients (depth > 1) need this False: with W
                 # lanes in flight, arrival order is a thread race and the
                 # strict handshake would 409 nondeterministically
                 "strict_steps": self.strict_steps}
+        if self._coalescer is not None:
+            info["coalescing"] = {
+                "coalesce_max": self._coalescer.max_group,
+                "coalesce_window_ms": self._coalescer.window_s * 1e3,
+                **self._coalescer.counters()}
+        return info
+
+    def close(self) -> None:
+        """Flush and join the coalescer (no-op on serialized servers)."""
+        if self._coalescer is not None:
+            self._coalescer.close()
 
 
 class FedAvgAggregator:

@@ -47,6 +47,10 @@ class TransportStats:
     bytes_sent: int = 0
     bytes_received: int = 0
     total_seconds: float = 0.0
+    # free-form event counters (e.g. the server coalescer's
+    # groups_flushed / requests_coalesced / flush_full / flush_window /
+    # compile_count) — merged() sums them, summary() reports them
+    counters: Dict[str, float] = dataclasses.field(default_factory=dict)
     _latencies: list = dataclasses.field(default_factory=list)
     _lock: threading.Lock = dataclasses.field(default_factory=threading.Lock)
 
@@ -62,6 +66,10 @@ class TransportStats:
         with self._lock:
             self.bytes_sent += sent
             self.bytes_received += received
+
+    def incr(self, name: str, by: float = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + by
 
     def percentile(self, q: float) -> float:
         with self._lock:
@@ -81,10 +89,12 @@ class TransportStats:
                 m.bytes_received += s.bytes_received
                 m.total_seconds += s.total_seconds
                 m._latencies.extend(s._latencies)
+                for k, v in s.counters.items():
+                    m.counters[k] = m.counters.get(k, 0) + v
         return m
 
     def summary(self) -> Dict[str, float]:
-        return {
+        out = {
             "round_trips": self.round_trips,
             "p50_ms": self.percentile(50) * 1e3,
             "p99_ms": self.percentile(99) * 1e3,
@@ -93,6 +103,9 @@ class TransportStats:
             "bytes_sent": self.bytes_sent,
             "bytes_received": self.bytes_received,
         }
+        with self._lock:
+            out.update(self.counters)
+        return out
 
 
 class Transport(abc.ABC):

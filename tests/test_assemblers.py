@@ -176,3 +176,40 @@ def test_sweep_leg_at_default_edge_promoted(longctx, monkeypatch):
                 seq_len=2048, attn="flash", batch=64)
     legs = longctx.assemble([main, at_default, full])
     assert legs[0]["steps_per_sec"] == 18.5
+
+
+def test_sweep_promotion_follows_recorded_main_edge(longctx, monkeypatch):
+    """When a main flash leg RECORDS the block it compiled with
+    (flash_block in its result), sweep promotion keys on that runtime
+    edge — `_resolve_block` can cap below `_pick_block`'s static default
+    (one-pass-refused shapes), and promoting a sweep leg at the static
+    default would then publish a config the main leg never ran. The
+    static default stays the fallback for pre-field records."""
+    monkeypatch.setattr(longctx, "_default_block", lambda seq: 1024)
+    main = _rec("T2048.b64.flash.q", ts=1, steps_per_sec=18.0,
+                seq_len=2048, attn="flash", batch=64, flash_block=512)
+    at_recorded = _rec("sweep.T2048.b64.flash.blk512", ts=2,
+                       steps_per_sec=19.5, seq_len=2048, attn="flash",
+                       batch=64)
+    at_static = _rec("sweep.T2048.b64.flash.blk1024", ts=3,
+                     steps_per_sec=99.0, seq_len=2048, attn="flash",
+                     batch=64)
+    legs = longctx.assemble([main, at_recorded, at_static])
+    assert len(legs) == 1
+    # the recorded-edge sweep promotes; the static-default one (newest,
+    # fastest) matches an edge the main leg never compiled and stays out
+    assert legs[0]["steps_per_sec"] == 19.5
+
+    # the newest ok main record defines the edge
+    newer = _rec("T2048.b64.flash.full", ts=5, steps_per_sec=18.5,
+                 seq_len=2048, attn="flash", batch=64, flash_block=1024)
+    legs = longctx.assemble([main, newer, at_recorded, at_static])
+    assert legs[0]["steps_per_sec"] == 18.5  # full main leg outranks all
+    # ...and blk1024 now matches the recorded edge while blk512 does not
+    blocks = longctx._recorded_blocks([main, newer])
+    assert blocks == {(2048, 64): 1024}
+
+    # an invalid/oom main record never defines the edge
+    bad = {"leg": "T2048.b64.flash.q", "status": "invalid", "ts": 9,
+           "result": {"valid": False, "flash_block": 256}}
+    assert longctx._recorded_blocks([main, bad]) == {(2048, 64): 512}

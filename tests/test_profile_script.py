@@ -58,3 +58,30 @@ def test_summarize_trace_groups_by_process(mod, trace_dir):
         assert totals == sorted(totals, reverse=True)
         for o in ops:
             assert o["count"] >= 1 and o["mean_us"] > 0
+
+
+def test_profile_batch_env_unification(mod, monkeypatch, capsys):
+    """SLT_PROFILE_BATCH is the knob's pre-unification name: honored
+    alone (with a deprecation warning), refused when it disagrees with
+    SLT_BENCH_BATCH — silently profiling a different shape than the
+    bench leg it claims to corroborate is the failure mode."""
+    monkeypatch.delenv("SLT_BENCH_BATCH", raising=False)
+    monkeypatch.delenv("SLT_PROFILE_BATCH", raising=False)
+    assert mod.profile_batch() == 64  # the bench legs' shared default
+
+    monkeypatch.setenv("SLT_BENCH_BATCH", "32")
+    assert mod.profile_batch() == 32
+
+    monkeypatch.delenv("SLT_BENCH_BATCH")
+    monkeypatch.setenv("SLT_PROFILE_BATCH", "16")
+    assert mod.profile_batch() == 16
+    assert "deprecated" in capsys.readouterr().err
+
+    # agreement is tolerated (a transition-period invocation exporting
+    # both identically keeps working)
+    monkeypatch.setenv("SLT_BENCH_BATCH", "16")
+    assert mod.profile_batch() == 16
+
+    monkeypatch.setenv("SLT_BENCH_BATCH", "32")
+    with pytest.raises(SystemExit, match="conflicts"):
+        mod.profile_batch()
