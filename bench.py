@@ -154,6 +154,31 @@ def _data(n_steps: int, model: str):
     return x, y
 
 
+def _traced_phase_breakdown(run_traced_steps, export_path: str | None = None
+                            ) -> dict:
+    """Per-leg phase breakdown (ISSUE: every bench leg records where its
+    step time goes). Enables the obs tracer, runs a few extra steps via
+    the callback, and returns the per-phase summary + the north-star
+    transport fraction. Always AFTER the timed window — the published
+    number keeps the zero-overhead-off hot path — and safe to enable
+    globally because every role owns a fresh subprocess."""
+    from split_learning_tpu import obs
+    tr = obs.enable()
+    try:
+        run_traced_steps()
+    finally:
+        obs.disable()
+    out = {
+        "phases": tr.phase_summary(),
+        "transport_fraction": tr.fraction("transport"),
+        "note": ("measured on a few post-window traced steps, not the "
+                 "timed window (tracing stays off while timing)"),
+    }
+    if export_path:
+        out["trace_file"] = tr.export_chrome(export_path)
+    return out
+
+
 def measure_baseline(quick: bool) -> dict:
     """Reference-architecture path: HTTP loopback split step on CPU."""
     import jax
@@ -178,6 +203,9 @@ def measure_baseline(quick: bool) -> dict:
         for i in range(warmup, warmup + steps):
             client.train_step(x[i], y[i], i)
         dt = time.perf_counter() - t0
+        phases = _traced_phase_breakdown(lambda: [
+            client.train_step(x[j % (warmup + steps)], y[j % (warmup + steps)],
+                              warmup + steps + j) for j in range(3)])
     finally:
         transport.close()
         server.stop()
@@ -185,6 +213,7 @@ def measure_baseline(quick: bool) -> dict:
         "steps_per_sec": steps / dt,
         "roundtrip_p50_ms": transport.stats.percentile(50) * 1e3,
         "platform": "cpu+http-loopback",
+        "phases": phases,
     }
 
 
@@ -423,6 +452,11 @@ def measure_fused(quick: bool) -> dict:
                       "f32 run over bf16 peak: utilization upper bound"),
         "steps_per_sec_ceiling_at_peak": (
             peak / flops_step if peak else None),
+        # one XLA program, no transport boundary: the obs span taxonomy
+        # (client_fwd / wire / queue_wait / ...) has nothing to attach to
+        "phases": None,
+        "phases_note": ("fused step is a single jitted program; no "
+                        "client/transport/server phases exist to trace"),
     }
     leg["valid"], leg["invalid_reason"] = validate_leg(leg)
     return leg
@@ -483,6 +517,9 @@ def measure_dp(quick: bool) -> dict:
         "steps_per_sec_1_client": steps / dt_1,
         f"steps_per_sec_{n_clients}_clients": steps / dt_n,
         "loss_max_abs_diff_vs_1_client": diff,
+        "phases": None,
+        "phases_note": ("fused DP step is a single jitted program; no "
+                        "client/transport/server phases exist to trace"),
         "valid": diff <= parity_tol,
         "invalid_reason": None if diff <= parity_tol else (
             f"DP-{n_clients} loss series diverges from 1-client by {diff} "
@@ -528,6 +565,9 @@ def measure_wire(quick: bool) -> dict:
             out[f"p50_ms_{compress}"] = s["p50_ms"]
             out[f"bytes_per_step_{compress}"] = (
                 (s["bytes_sent"] + s["bytes_received"]) / steps)
+            out[f"phases_{compress}"] = _traced_phase_breakdown(lambda: [
+                client.train_step(x[j % (steps + 2)], y[j % (steps + 2)],
+                                  steps + 2 + j) for j in range(3)])
         finally:
             transport.close()
             server.stop()
@@ -613,6 +653,23 @@ def measure_pipelined(quick: bool) -> dict:
     out["steps_per_sec_sync"] = sync
     out[f"steps_per_sec_depth{depth}"] = depth_w
     out["pipelining_speedup"] = depth_w / sync
+
+    def _traced_pipelined():
+        runtime = ServerRuntime(plan, cfg, jax.random.PRNGKey(0), x[0],
+                                strict_steps=False)
+        server = SplitHTTPServer(runtime).start()
+        lane0 = HttpTransport(server.url)
+        piped = PipelinedSplitClientTrainer(
+            plan, cfg, jax.random.PRNGKey(0), lane0, depth=depth,
+            transport_factory=lambda: HttpTransport(server.url))
+        try:
+            piped.train(lambda: iter(batches[:4]), epochs=1)
+        finally:
+            piped.close()
+            lane0.close()
+            server.stop()
+
+    out["phases"] = _traced_phase_breakdown(_traced_pipelined)
 
     # --- injected-wire-latency scenario -------------------------------
     # Loopback has no wire, so the scenario above cannot show the
@@ -768,6 +825,26 @@ def measure_coalesced(quick: bool) -> dict:
         np.asarray(loss_series(1)) - np.asarray(loss_series(n_clients)))))
     parity_tol = 1e-4
 
+    def _traced_coalesced():
+        server = ServerRuntime(plan, cfg, jax.random.PRNGKey(0), x[0, 0],
+                               coalesce_max=n_clients,
+                               coalesce_window_ms=5.0)
+        runner = MultiClientSplitRunner(
+            plan, cfg, jax.random.PRNGKey(1),
+            lambda i: LocalTransport(server),
+            num_clients=n_clients, concurrent=True)
+        try:
+            for r in range(2):
+                runner.train_round(list(zip(x[r], y[r])))
+        finally:
+            runner.close()
+            server.close()
+
+    # SLT_TRACE=path additionally exports the traced steps as a
+    # Perfetto-loadable Chrome trace (scripts/trace_report.py reads it)
+    phases = _traced_phase_breakdown(_traced_coalesced,
+                                     export_path=os.environ.get("SLT_TRACE"))
+
     occupancy = (co["requests_coalesced"] / co["groups_flushed"]
                  if co and co.get("groups_flushed") else 0.0)
     speedup = sps_coalesced / sps_serialized
@@ -799,6 +876,7 @@ def measure_coalesced(quick: bool) -> dict:
         "steps_per_sec_serialized": sps_serialized,
         "steps_per_sec_coalesced": sps_coalesced,
         "speedup_vs_serialized": speedup,
+        "phases": phases,
         "coalescing": co,
         "mean_occupancy": occupancy,
         "loopback_raw": {

@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Summarize a Chrome-trace file (obs/trace.py export) into the
+north-star compute-vs-wire fraction table.
+
+Input: the trace written by ``slt train --trace PATH`` /
+``slt serve --trace PATH`` / ``Tracer.export_chrome`` — a Chrome trace
+event array, one event per line. Parsing is tolerant: a partially
+written file (live run, crashed run) loads line-by-line, so the report
+can run against a job that is still training.
+
+Output: per-phase count/total/mean/p50/p90 table; the client-level
+phase mix (client_fwd / transport / client_bwd / opt_apply — the same
+denominator as ``PhaseProfiler.fraction``, so ``transport_fraction``
+here reproduces ``fraction('transport')`` on the same run); the
+transport decomposition (encode / wire / server queue_wait + dispatch);
+and a per-step accounting check (client phases summed vs the measured
+``step_total`` wall clock — the 10%-agreement acceptance gate of the
+tracing PR).
+
+Run: python scripts/trace_report.py artifacts/trace.json [--json]
+
+Stdlib-only (no jax, no numpy): usable on any box the trace file lands
+on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List
+
+# must match obs/trace.py CLIENT_PHASES (kept literal: this script runs
+# standalone, without the package importable)
+CLIENT_PHASES = ("client_fwd", "transport", "client_bwd", "opt_apply")
+TRANSPORT_SUB = ("encode", "wire", "queue_wait", "dispatch")
+
+
+def load_events(path: str) -> List[Dict[str, Any]]:
+    """Whole-file JSON array first; fall back to per-line parsing (a
+    live/truncated export: strip array brackets and trailing commas,
+    skip any line that does not parse)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        data = json.loads(text)
+        if isinstance(data, dict):  # {"traceEvents": [...]} container
+            data = data.get("traceEvents", [])
+        return [e for e in data if isinstance(e, dict)]
+    except json.JSONDecodeError:
+        pass
+    events = []
+    for line in text.splitlines():
+        line = line.strip().rstrip(",")
+        if line in ("", "[", "]"):
+            continue
+        try:
+            ev = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # torn tail line of a live file
+        if isinstance(ev, dict):
+            events.append(ev)
+    return events
+
+
+def _percentile(sorted_xs: List[float], q: float) -> float:
+    if not sorted_xs:
+        return 0.0
+    idx = (len(sorted_xs) - 1) * q / 100.0
+    lo = int(idx)
+    hi = min(lo + 1, len(sorted_xs) - 1)
+    return sorted_xs[lo] + (sorted_xs[hi] - sorted_xs[lo]) * (idx - lo)
+
+
+def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    spans = [e for e in events if e.get("ph") == "X"]
+    by_phase: Dict[str, List[float]] = {}
+    for e in spans:
+        by_phase.setdefault(e.get("name", "?"), []).append(
+            float(e.get("dur", 0.0)) / 1e6)  # µs -> s
+
+    table = {}
+    for name, xs in sorted(by_phase.items()):
+        xs = sorted(xs)
+        table[name] = {
+            "count": len(xs),
+            "total_s": sum(xs),
+            "mean_ms": sum(xs) / len(xs) * 1e3,
+            "p50_ms": _percentile(xs, 50) * 1e3,
+            "p90_ms": _percentile(xs, 90) * 1e3,
+        }
+
+    totals = {name: row["total_s"] for name, row in table.items()}
+    denom = sum(totals.get(p, 0.0) for p in CLIENT_PHASES)
+    client_mix = {p: (totals.get(p, 0.0) / denom if denom else 0.0)
+                  for p in CLIENT_PHASES}
+    tsub = {p: totals.get(p, 0.0) for p in TRANSPORT_SUB}
+
+    # accounting check: per step (trace_id), client phases vs step_total
+    per_step: Dict[str, Dict[str, float]] = {}
+    for e in spans:
+        tid = (e.get("args") or {}).get("trace_id")
+        if tid is None:
+            continue
+        slot = per_step.setdefault(tid, {})
+        name = e.get("name", "?")
+        slot[name] = slot.get(name, 0.0) + float(e.get("dur", 0.0)) / 1e6
+    ratios = []
+    for slot in per_step.values():
+        wall = slot.get("step_total", 0.0)
+        if wall <= 0:
+            continue
+        ratios.append(sum(slot.get(p, 0.0) for p in CLIENT_PHASES) / wall)
+    coverage = sum(ratios) / len(ratios) if ratios else None
+
+    return {
+        "events": len(events),
+        "spans": len(spans),
+        "steps_with_wall_clock": len(ratios),
+        "phases": table,
+        "client_phase_mix": client_mix,
+        "transport_fraction": client_mix.get("transport", 0.0),
+        "transport_decomposition_s": tsub,
+        "span_sum_over_wall_clock": coverage,
+    }
+
+
+def render(rep: Dict[str, Any]) -> str:
+    lines = []
+    lines.append(f"{'phase':<12} {'count':>6} {'total_s':>9} "
+                 f"{'mean_ms':>9} {'p50_ms':>9} {'p90_ms':>9}")
+    for name, row in rep["phases"].items():
+        lines.append(
+            f"{name:<12} {row['count']:>6d} {row['total_s']:>9.4f} "
+            f"{row['mean_ms']:>9.3f} {row['p50_ms']:>9.3f} "
+            f"{row['p90_ms']:>9.3f}")
+    lines.append("")
+    lines.append("client phase mix (compute vs wire, the north-star split):")
+    for name, frac in rep["client_phase_mix"].items():
+        lines.append(f"  {name:<12} {frac:>7.1%}")
+    lines.append(f"  -> transport fraction: "
+                 f"{rep['transport_fraction']:.3f} "
+                 f"(== PhaseProfiler.fraction('transport'))")
+    lines.append("")
+    lines.append("transport decomposition (total seconds):")
+    for name, s in rep["transport_decomposition_s"].items():
+        lines.append(f"  {name:<12} {s:>9.4f}")
+    cov = rep["span_sum_over_wall_clock"]
+    if cov is not None:
+        lines.append("")
+        lines.append(
+            f"accounting: client spans sum to {cov:.1%} of step_total "
+            f"wall clock over {rep['steps_with_wall_clock']} steps "
+            f"(acceptance gate: within 10%)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="Chrome-trace file (obs export)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON instead of the table")
+    args = ap.parse_args(argv)
+    events = load_events(args.trace)
+    if not events:
+        print(f"[trace_report] no events parsed from {args.trace}",
+              file=sys.stderr)
+        return 1
+    rep = summarize(events)
+    try:
+        print(json.dumps(rep, indent=2) if args.json else render(rep))
+    except BrokenPipeError:  # | head
+        return 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -370,6 +370,13 @@ def cmd_train(args) -> int:
     phase_prof = PhaseProfiler() if profile_dir else None
     trace_ctx = device_trace(profile_dir)
 
+    # --trace: per-step span tracing (obs/) — orthogonal to --profile-dir
+    # (host-side spans vs the XLA device trace); off by default and
+    # zero-overhead when off
+    from split_learning_tpu import obs
+    trace_path = getattr(args, "trace", None)
+    step_tracer = obs.enable() if trace_path else None
+
     t0 = time.time()
     n_steps = 0
     final_loss = float("nan")
@@ -739,12 +746,18 @@ def cmd_train(args) -> int:
     if phase_prof is not None and phase_prof.summary():
         print(f"[profile] {json.dumps(phase_prof.summary())}", file=sys.stderr)
         frac = phase_prof.fraction("transport")
-        if frac == frac:  # not NaN: MPMD split path with phase accounting
+        if frac > 0:  # 0.0 = no transport phase (fused/single-program)
             print(f"[profile] transport fraction: {frac:.3f}",
                   file=sys.stderr)
     if profile_dir:
         print(f"[profile] XLA trace written to {profile_dir} "
               "(view in TensorBoard/Perfetto)", file=sys.stderr)
+    if step_tracer is not None:
+        obs.disable()
+        out_path = step_tracer.export_chrome(trace_path)
+        print(f"[trace] {len(step_tracer.spans())} spans -> {out_path} "
+              "(Perfetto-loadable; summarize with scripts/trace_report.py)",
+              file=sys.stderr)
 
     dt = time.time() - t0
     if n_steps and dt > 0:
@@ -952,6 +965,14 @@ def cmd_serve(args) -> int:
 
         runtime.on_step = on_step
 
+    trace_path = getattr(args, "trace", None)
+    step_tracer = None
+    if trace_path:
+        from split_learning_tpu import obs
+        step_tracer = obs.enable()
+        print(f"[serve] tracing on: /metrics histograms live; Chrome "
+              f"trace -> {trace_path} on shutdown", file=sys.stderr)
+
     server = SplitHTTPServer(runtime, host=args.host, port=args.port).start()
     print(f"[serve] mode={cfg.mode} listening on {server.url}")
     try:
@@ -962,6 +983,12 @@ def cmd_serve(args) -> int:
         server.stop()
     finally:
         runtime.close()  # flush + join the coalescer, if one is running
+        if step_tracer is not None:
+            from split_learning_tpu import obs
+            obs.disable()
+            step_tracer.export_chrome(trace_path)
+            print(f"[trace] Chrome trace written to {trace_path}",
+                  file=sys.stderr)
         if ckptr is not None:
             # saves are async — make the in-flight checkpoint durable
             # before the process exits, or a resume comes back behind the
@@ -1194,6 +1221,11 @@ def main(argv: Optional[list] = None) -> int:
     pt.add_argument("--profile-dir", dest="profile_dir", default=None,
                     help="write a jax.profiler XLA trace here and report "
                          "per-phase (compute vs transport) wall-clock")
+    pt.add_argument("--trace", default=None, metavar="PATH",
+                    help="per-step span tracing (obs/): write a Chrome-"
+                         "trace JSON here on exit (Perfetto-loadable; "
+                         "summarize with scripts/trace_report.py). Off = "
+                         "zero overhead")
     pt.add_argument("--scan-steps", dest="scan_steps", type=int, default=0,
                     help="fused transport: batch N steps per device "
                          "dispatch via lax.scan (per-step losses still "
@@ -1274,6 +1306,12 @@ def main(argv: Optional[list] = None) -> int:
                     help="how long a coalescing group waits for peers "
                          "after its first request before flushing partial "
                          "(only with --coalesce-max > 1)")
+    ps.add_argument("--trace", default=None, metavar="PATH",
+                    help="per-step span tracing (obs/): serve live "
+                         "queue-wait/dispatch histograms on GET /metrics "
+                         "and write a Chrome trace here on shutdown. "
+                         "Off = zero overhead (/metrics stays up but "
+                         "histograms stay empty)")
     ps.set_defaults(fn=cmd_serve)
 
     pe = sub.add_parser("eval", help="evaluate a checkpoint on the test split")

@@ -1,0 +1,23 @@
+"""Cross-layer observability: per-step tracing, latency histograms,
+Prometheus /metrics, Chrome-trace export.
+
+Usage (in-process)::
+
+    from split_learning_tpu import obs
+    tracer = obs.enable()            # zero overhead until this call
+    ... run traced steps ...
+    tracer.export_chrome("trace.json")   # Perfetto-loadable
+    print(tracer.phase_summary())
+    obs.disable()
+
+Over HTTP the server exposes ``GET /metrics`` (Prometheus text); in
+process, ``ServerRuntime.metrics()`` returns the same snapshot as a
+dict. See obs/trace.py for the span taxonomy and the
+zero-overhead-when-off contract.
+"""
+
+from split_learning_tpu.obs.metrics import (  # noqa: F401
+    DEFAULT_BUCKETS, Histogram, Registry, render_prometheus)
+from split_learning_tpu.obs.trace import (  # noqa: F401
+    CLIENT_PHASES, CTX, Tracer, disable, enable, enabled, get_tracer,
+    maybe_enable_from_env)

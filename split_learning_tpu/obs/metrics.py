@@ -1,0 +1,157 @@
+"""Fixed-bucket latency histograms + Prometheus text exposition.
+
+The aggregation half of the obs subsystem (see obs/trace.py for the
+span half): spans land in per-phase :class:`Histogram`\\ s inside a
+:class:`Registry`, one registry per party. The server's registry backs
+both ``GET /metrics`` (transport/http.py) and the in-process
+``ServerRuntime.metrics()`` snapshot; :func:`render_prometheus` turns a
+snapshot into the text exposition format (version 0.0.4) any Prometheus
+scraper parses.
+
+Buckets are fixed at construction (no dynamic rebinning — cumulative
+bucket counts must stay monotone across scrapes), spanning 100 µs to
+10 s: the split-step phase range from in-process LocalTransport calls
+to a slow WAN round trip.
+
+Everything here is stdlib-only and lock-cheap; nothing in this module
+runs unless tracing is enabled (obs/trace.py gates every call site).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Any, Dict, Iterable, Optional
+
+# upper bounds (``le``) in seconds; +Inf is implicit
+DEFAULT_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class Histogram:
+    """Cumulative fixed-bucket histogram (Prometheus semantics)."""
+
+    __slots__ = ("buckets", "_counts", "sum", "count", "_lock")
+
+    def __init__(self, buckets: Iterable[float] = DEFAULT_BUCKETS) -> None:
+        self.buckets = tuple(float(b) for b in buckets)
+        if not self.buckets or list(self.buckets) != sorted(set(self.buckets)):
+            raise ValueError(
+                f"buckets must be non-empty and strictly increasing "
+                f"(got {self.buckets})")
+        self._counts = [0] * (len(self.buckets) + 1)  # last slot = +Inf
+        self.sum = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        # first bucket whose upper bound is >= v; past-the-end = +Inf slot
+        i = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            self._counts[i] += 1
+            self.sum += v
+            self.count += 1
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Cumulative per-``le`` counts (monotone non-decreasing, the
+        invariant the /metrics tests pin), plus sum and count."""
+        with self._lock:
+            raw = list(self._counts)
+            total, s = self.count, self.sum
+        cumulative = []
+        acc = 0
+        for c in raw:
+            acc += c
+            cumulative.append(acc)
+        return {"buckets": self.buckets, "cumulative": cumulative,
+                "sum": s, "count": total}
+
+
+class Registry:
+    """Named histograms / counters / gauges for one party."""
+
+    def __init__(self, buckets: Iterable[float] = DEFAULT_BUCKETS) -> None:
+        self._buckets = tuple(buckets)
+        self._hist: Dict[str, Histogram] = {}
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            h = self._hist.get(name)
+            if h is None:
+                h = self._hist[name] = Histogram(self._buckets)
+            return h
+
+    def observe(self, name: str, value: float) -> None:
+        self.histogram(name).observe(value)
+
+    def incr(self, name: str, by: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + by
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Plain-dict snapshot: feed to :func:`render_prometheus` or
+        return from ``ServerRuntime.metrics()`` as-is. Includes the
+        derived per-phase fraction gauges (share of summed histogram
+        time per phase — the north-star compute-vs-wire split)."""
+        with self._lock:
+            hists = dict(self._hist)
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+        snap_h = {name: h.snapshot() for name, h in sorted(hists.items())}
+        total = sum(h["sum"] for h in snap_h.values())
+        fractions = {name: (h["sum"] / total if total > 0 else 0.0)
+                     for name, h in snap_h.items()}
+        return {"histograms": snap_h, "counters": counters,
+                "gauges": gauges, "phase_fractions": fractions}
+
+
+def _sanitize(name: str) -> str:
+    out = "".join(c if (c.isalnum() or c in "_:") else "_" for c in name)
+    return out if not out[:1].isdigit() else "_" + out
+
+
+def _fmt(v: float) -> str:
+    return f"{float(v):.9g}"
+
+
+def render_prometheus(snapshot: Dict[str, Any], prefix: str = "slt") -> str:
+    """Snapshot (from :meth:`Registry.snapshot`) -> Prometheus text
+    exposition (version 0.0.4). Histogram names gain a ``_seconds``
+    unit suffix; phase fractions render as one gauge with a ``phase``
+    label."""
+    lines = []
+    for name, h in sorted(snapshot.get("histograms", {}).items()):
+        metric = f"{prefix}_{_sanitize(name)}_seconds"
+        lines.append(f"# HELP {metric} Latency of the {name} phase.")
+        lines.append(f"# TYPE {metric} histogram")
+        for le, cum in zip(h["buckets"], h["cumulative"]):
+            lines.append(f'{metric}_bucket{{le="{_fmt(le)}"}} {cum}')
+        lines.append(f'{metric}_bucket{{le="+Inf"}} {h["count"]}')
+        lines.append(f"{metric}_sum {_fmt(h['sum'])}")
+        lines.append(f"{metric}_count {h['count']}")
+    fractions = snapshot.get("phase_fractions", {})
+    if fractions:
+        metric = f"{prefix}_phase_fraction"
+        lines.append(f"# HELP {metric} Share of summed phase time.")
+        lines.append(f"# TYPE {metric} gauge")
+        for name, frac in sorted(fractions.items()):
+            lines.append(
+                f'{metric}{{phase="{_sanitize(name)}"}} {_fmt(frac)}')
+    for name, v in sorted(snapshot.get("counters", {}).items()):
+        metric = f"{prefix}_{_sanitize(name)}"
+        lines.append(f"# TYPE {metric} counter")
+        lines.append(f"{metric} {_fmt(v)}")
+    for name, v in sorted(snapshot.get("gauges", {}).items()):
+        metric = f"{prefix}_{_sanitize(name)}"
+        lines.append(f"# TYPE {metric} gauge")
+        lines.append(f"{metric} {_fmt(v)}")
+    return "\n".join(lines) + "\n"

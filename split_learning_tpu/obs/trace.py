@@ -1,0 +1,207 @@
+"""Per-step distributed tracing across the split-learning parties.
+
+One split step is a chain the reference can't see into (SURVEY.md §5
+tracing): client forward -> encode -> wire -> server queue-wait (incl.
+the coalescer window) -> jitted dispatch -> wire back -> client
+backward -> optimizer apply. This module assigns each step a trace ID,
+propagates it through the ``Transport`` payload metadata (``trace_id``
+key; the server echoes its span timings back as ``server_spans``), and
+records every phase as a span:
+
+- client party: ``client_fwd``, ``encode``, ``wire``, ``transport``
+  (the whole transport call — by construction the same boundary
+  ``PhaseProfiler``'s 'transport' phase times, so scripts/trace_report.py
+  reproduces ``fraction('transport')``), ``client_bwd``, ``opt_apply``,
+  ``step_total``.
+- server party: ``queue_wait`` (lock wait; enqueue -> group pickup
+  under coalescing, which includes the window wait), ``dispatch``
+  (jitted step + host materialization).
+
+Spans aggregate into the per-party :class:`~.metrics.Registry`
+histograms and export as Chrome-trace-format events (one JSON event
+per line, Perfetto-loadable) via :meth:`Tracer.export_chrome`.
+
+ZERO-OVERHEAD-OFF CONTRACT: the global tracer defaults to ``None`` and
+every instrumentation site is gated on ``get_tracer() is None`` — with
+tracing off no span is allocated, no lock taken, no payload key added
+(the wire format is bit-for-bit the untraced one). Propagation between
+threads uses the ``CTX`` thread-local: the client trainer sets
+``CTX.trace_id`` around its transport call; the server side (same
+thread for LocalTransport, the HTTP handler thread otherwise) adopts
+it and writes ``CTX.server_spans`` back.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from split_learning_tpu.obs.metrics import Registry
+
+
+class _Ctx(threading.local):
+    """Per-thread propagation slots (None = nothing in flight)."""
+    trace_id: Optional[str] = None
+    server_spans: Optional[Dict[str, float]] = None
+
+
+CTX = _Ctx()
+
+# Chrome-trace process ids: one synthetic "process" per party
+PARTY_PIDS = {"client": 1, "server": 2}
+
+# the client-level phases that tile a step — the denominator of the
+# compute-vs-wire fraction (encode/wire are sub-phases of transport and
+# queue_wait/dispatch belong to the server party; counting either would
+# double-book)
+CLIENT_PHASES = ("client_fwd", "transport", "client_bwd", "opt_apply")
+
+
+class Tracer:
+    """Collects spans; aggregates them into a Registry; exports Chrome
+    trace events. Thread-safe (spans arrive from client worker threads,
+    HTTP handler threads, and the coalescer flusher at once)."""
+
+    def __init__(self, registry: Optional[Registry] = None,
+                 max_spans: int = 200_000) -> None:
+        self.registry = registry if registry is not None else Registry()
+        # bounded: a long-running traced server must not grow without
+        # limit — oldest spans fall off, histograms keep the full tally
+        self._spans: deque = deque(maxlen=max_spans)
+        self._lock = threading.Lock()
+        self._seq = itertools.count()
+        self._t0 = time.perf_counter()
+
+    # -------------------------------------------------------------- #
+    def new_trace_id(self, client_id: int = 0, step: int = -1) -> str:
+        return f"c{client_id}-s{step}-{next(self._seq):06x}"
+
+    def record(self, name: str, t_start: float, duration: float, *,
+               trace_id: Optional[str] = None, party: str = "client",
+               tid: int = 0, step: int = -1) -> None:
+        """One span. ``t_start`` is a ``time.perf_counter()`` reading;
+        ``duration`` in seconds (may be shorter than the wall interval —
+        e.g. ``wire`` is round-trip minus server-reported time)."""
+        with self._lock:
+            self._spans.append((name, party, int(tid), int(step),
+                                trace_id, float(t_start), float(duration)))
+        self.registry.observe(name, duration)
+
+    # -------------------------------------------------------------- #
+    def spans(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            raw = list(self._spans)
+        return [{"name": n, "party": p, "tid": t, "step": s,
+                 "trace_id": tr, "t_start": t0, "duration": d}
+                for n, p, t, s, tr, t0, d in raw]
+
+    def phase_summary(self) -> Dict[str, Dict[str, float]]:
+        """Per-phase stats in the PhaseProfiler.summary() shape."""
+        by_name: Dict[str, list] = {}
+        for sp in self.spans():
+            by_name.setdefault(sp["name"], []).append(sp["duration"])
+        out = {}
+        for name, xs in by_name.items():
+            arr = np.asarray(xs)
+            out[name] = {
+                "count": int(arr.size),
+                "total_s": float(arr.sum()),
+                "mean_ms": float(arr.mean() * 1e3),
+                "p50_ms": float(np.percentile(arr, 50) * 1e3),
+                "p90_ms": float(np.percentile(arr, 90) * 1e3),
+            }
+        return out
+
+    def fraction(self, name: str) -> float:
+        """Share of ``name`` in the client-level phase total — the same
+        quantity as ``PhaseProfiler.fraction(name)`` over a run where
+        both were enabled. 0.0 when nothing was recorded."""
+        totals: Dict[str, float] = {}
+        for sp in self.spans():
+            totals[sp["name"]] = totals.get(sp["name"], 0.0) + sp["duration"]
+        denom = sum(totals.get(p, 0.0) for p in CLIENT_PHASES)
+        return totals.get(name, 0.0) / denom if denom > 0 else 0.0
+
+    # -------------------------------------------------------------- #
+    def chrome_events(self) -> List[Dict[str, Any]]:
+        """Chrome trace event objects (``ph: "X"`` complete events, µs
+        timestamps relative to tracer start, one pid per party)."""
+        events: List[Dict[str, Any]] = [
+            {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": f"slt-{party}"}}
+            for party, pid in sorted(PARTY_PIDS.items())
+        ]
+        for sp in self.spans():
+            events.append({
+                "name": sp["name"], "cat": sp["party"], "ph": "X",
+                "ts": max(sp["t_start"] - self._t0, 0.0) * 1e6,
+                "dur": sp["duration"] * 1e6,
+                "pid": PARTY_PIDS.get(sp["party"], 0), "tid": sp["tid"],
+                "args": {"trace_id": sp["trace_id"], "step": sp["step"]},
+            })
+        return events
+
+    def export_chrome(self, path: str) -> str:
+        """Write the Chrome-trace JSON array, one event per line (valid
+        JSON and line-parseable; Perfetto/chrome://tracing load it
+        directly). Returns ``path``."""
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        events = self.chrome_events()
+        with open(path, "w") as f:
+            f.write("[\n")
+            for i, ev in enumerate(events):
+                tail = "," if i < len(events) - 1 else ""
+                f.write(json.dumps(ev) + tail + "\n")
+            f.write("]\n")
+            f.flush()
+        return path
+
+
+# ------------------------------------------------------------------ #
+# the global switch — None means OFF and is the default
+# ------------------------------------------------------------------ #
+_tracer: Optional[Tracer] = None
+_switch_lock = threading.Lock()
+
+
+def enable(registry: Optional[Registry] = None,
+           max_spans: int = 200_000) -> Tracer:
+    """Install (and return) a fresh global tracer. Call sites pick it
+    up on their next step; no restart needed."""
+    global _tracer
+    with _switch_lock:
+        _tracer = Tracer(registry=registry, max_spans=max_spans)
+        return _tracer
+
+
+def disable() -> Optional[Tracer]:
+    """Turn tracing off; returns the tracer that was active (so callers
+    can still export/summarize what it collected)."""
+    global _tracer
+    with _switch_lock:
+        t, _tracer = _tracer, None
+        return t
+
+
+def get_tracer() -> Optional[Tracer]:
+    return _tracer
+
+
+def enabled() -> bool:
+    return _tracer is not None
+
+
+def maybe_enable_from_env() -> Optional[Tracer]:
+    """Honor ``SLT_TRACE`` (any non-empty value; a path means "export
+    the Chrome trace there on exit" — the caller owns the export)."""
+    if os.environ.get("SLT_TRACE") and not enabled():
+        return enable()
+    return get_tracer() if enabled() else None

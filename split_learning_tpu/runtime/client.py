@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import time
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 import jax
@@ -36,6 +37,7 @@ import numpy as np
 
 from split_learning_tpu.core.losses import cross_entropy
 from split_learning_tpu.core.stage import SplitPlan, stage_backward
+from split_learning_tpu.obs import trace as obs_trace
 from split_learning_tpu.runtime.state import (
     TrainState, apply_grads, make_state, make_tx)
 from split_learning_tpu.transport.base import Transport, TransportError
@@ -111,28 +113,54 @@ class SplitClientTrainer:
     def train_step(self, x: np.ndarray, y: np.ndarray,
                    step: int) -> Optional[float]:
         """One split step; returns the loss, or None if the batch was
-        dropped under the 'skip' policy."""
+        dropped under the 'skip' policy.
+
+        Tracing (obs/trace.py): with the global tracer off (`tr is
+        None`, the default) every instrumentation branch below is dead —
+        no clock reads, no allocations, the untraced hot path. With it
+        on, the step gets a trace id (propagated to the server through
+        the transport via CTX) and spans client_fwd / transport /
+        client_bwd / opt_apply / step_total; the extra block_until_ready
+        syncs exist only so span boundaries measure device work, and are
+        the documented tracing overhead."""
         prof = self.profiler
         phase = self._phase
+        tr = obs_trace.get_tracer()
 
         self.ensure_init(x)
+        tid = tr.new_trace_id(self.client_id, step) if tr is not None else None
+        t_step0 = time.perf_counter() if tr is not None else 0.0
         with phase("compute_fwd"):
             acts = self._fwd(self.state.params, jnp.asarray(x))
             acts_host = np.asarray(acts)
+        if tr is not None:
+            tr.record("client_fwd", t_step0,
+                      time.perf_counter() - t_step0, trace_id=tid,
+                      tid=self.client_id, step=step)
 
         attempt = 0
         while True:
             try:
-                with phase("transport"):
-                    g_acts, loss = self.transport.split_step(
-                        acts_host, np.asarray(y), step, self.client_id)
+                if tid is not None:
+                    obs_trace.CTX.trace_id = tid
+                t_tr0 = time.perf_counter() if tr is not None else 0.0
+                try:
+                    with phase("transport"):
+                        g_acts, loss = self.transport.split_step(
+                            acts_host, np.asarray(y), step, self.client_id)
+                finally:
+                    if tid is not None:
+                        obs_trace.CTX.trace_id = None
+                if tr is not None:
+                    tr.record("transport", t_tr0,
+                              time.perf_counter() - t_tr0, trace_id=tid,
+                              tid=self.client_id, step=step)
                 break
             except TransportError:
                 attempt += 1
                 if (self.failure_policy == FailurePolicy.RETRY
                         and attempt <= self.max_retries):
                     if self.retry_backoff > 0:
-                        import time
                         time.sleep(self.retry_backoff * 2 ** (attempt - 1))
                     continue
                 if self.failure_policy == FailurePolicy.SKIP:
@@ -143,11 +171,26 @@ class SplitClientTrainer:
                 raise
 
         with phase("compute_bwd"):
+            t_b0 = time.perf_counter() if tr is not None else 0.0
             g_params = self._bwd(self.state.params, jnp.asarray(x),
                                  jnp.asarray(g_acts))
+            if tr is not None:
+                jax.block_until_ready(g_params)
+                t_b1 = time.perf_counter()
+                tr.record("client_bwd", t_b0, t_b1 - t_b0, trace_id=tid,
+                          tid=self.client_id, step=step)
+            t_o0 = time.perf_counter() if tr is not None else 0.0
             self.state = apply_grads(self._tx, self.state, g_params)
-            if prof is not None:  # sync only when timing accuracy matters
+            if prof is not None or tr is not None:
+                # sync only when timing accuracy matters
                 jax.block_until_ready(self.state.params)
+            if tr is not None:
+                tr.record("opt_apply", t_o0, time.perf_counter() - t_o0,
+                          trace_id=tid, tid=self.client_id, step=step)
+        if tr is not None:
+            tr.record("step_total", t_step0,
+                      time.perf_counter() - t_step0, trace_id=tid,
+                      tid=self.client_id, step=step)
         return loss
 
     def train(self, data_iter: Callable[[], Iterable[Tuple[np.ndarray, np.ndarray]]],

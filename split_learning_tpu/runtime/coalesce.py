@@ -54,6 +54,13 @@ class CoalesceRequest:
     done: threading.Event = field(default_factory=threading.Event)
     result: Optional[Tuple[np.ndarray, float]] = None
     error: Optional[BaseException] = None
+    # obs (obs/trace.py), set by submit() only while tracing is enabled:
+    # the caller's trace id, the enqueue timestamp (queue_wait =
+    # enqueue -> group pickup, window wait included), and the dispatcher's
+    # span timings written back for the waiter to surface
+    trace_id: Optional[str] = None
+    t_enqueue: Optional[float] = None
+    server_spans: Optional[dict] = None
 
     def shape_key(self) -> tuple:
         """Requests coalesce only when everything but the batch row count
@@ -102,14 +109,22 @@ class RequestCoalescer:
 
     # ------------------------------------------------------------------ #
     def submit(self, acts: np.ndarray, labels: np.ndarray, step: int,
-               client_id: int, timeout: float = 120.0
+               client_id: int, timeout: float = 120.0,
+               trace_id: Optional[str] = None,
+               t_enqueue: Optional[float] = None
                ) -> Tuple[np.ndarray, float]:
         """Enqueue one request and block until its group's dispatch
         resolves it. Server-side errors (ProtocolError included) re-raise
         in the caller's thread, so the transport-facing contract is
-        identical to the serialized path."""
+        identical to the serialized path.
+
+        ``trace_id``/``t_enqueue`` (obs): set by the runtime only while
+        tracing is on; the dispatcher's span timings come back via
+        ``req.server_spans`` and are republished on this caller thread's
+        CTX so the transport can return them to the client."""
         req = CoalesceRequest(np.asarray(acts), np.asarray(labels),
-                              step, client_id)
+                              step, client_id, trace_id=trace_id,
+                              t_enqueue=t_enqueue)
         with self._cond:
             if self._closed:
                 raise RuntimeError("coalescer is closed")
@@ -119,6 +134,11 @@ class RequestCoalescer:
             raise TimeoutError(
                 f"coalesced split_step for client {client_id} step {step} "
                 f"not flushed within {timeout}s")
+        if req.server_spans is not None:
+            # lazy import: keeps the untraced module surface jax- and
+            # obs-free for the pure queue unit tests
+            from split_learning_tpu.obs import trace as obs_trace
+            obs_trace.CTX.server_spans = req.server_spans
         if req.error is not None:
             raise req.error
         assert req.result is not None

@@ -36,7 +36,8 @@ class MultiClientSplitRunner:
                  num_clients: Optional[int] = None,
                  sync_bottoms_every: int = 0,
                  logger: Optional[Any] = None,
-                 concurrent: bool = False) -> None:
+                 concurrent: bool = False,
+                 profiler: Optional[Any] = None) -> None:
         """transport_factory(client_id) -> a Transport for that client.
         sync_bottoms_every: if > 0, FedAvg the client bottom stages every
         that many rounds (0 = fully personal bottoms).
@@ -44,7 +45,10 @@ class MultiClientSplitRunner:
         pool instead of round-robin — what actually puts concurrent
         traffic in front of a coalescing server (ServerRuntime
         coalesce_max > 1). Round-robin stays the default: it is the
-        deterministic relay schedule the interleaving tests pin."""
+        deterministic relay schedule the interleaving tests pin.
+        profiler: one PhaseProfiler shared by every client (it is
+        thread-safe, so concurrent=True rounds aggregate correctly) —
+        the pooled compute-vs-transport split across the fleet."""
         n = num_clients if num_clients is not None else cfg.num_clients
         if n < 1:
             raise ValueError("need at least one client")
@@ -56,7 +60,7 @@ class MultiClientSplitRunner:
         self.clients: List[SplitClientTrainer] = [
             SplitClientTrainer(
                 plan, cfg, jax.random.fold_in(rng, i) if n > 1 else rng,
-                transport_factory(i), client_id=i)
+                transport_factory(i), client_id=i, profiler=profiler)
             for i in range(n)
         ]
         self._steps = [0] * n

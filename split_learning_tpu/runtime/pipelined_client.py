@@ -34,6 +34,7 @@ batch-drop semantics; wrap the transport in retries if the link flakes.
 
 from __future__ import annotations
 
+import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable, Iterable, List, Optional, Tuple
 
@@ -42,6 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from split_learning_tpu.core.stage import stage_backward
+from split_learning_tpu.obs import trace as obs_trace
 from split_learning_tpu.runtime.client import StepRecord
 from split_learning_tpu.runtime.state import (
     TrainState, apply_grads, make_state, make_tx)
@@ -101,17 +103,44 @@ class PipelinedSplitClientTrainer:
         # copy the labels: the lane thread serializes them up to depth-1
         # batches later, and np.asarray of a caller-recycled buffer would
         # hand it different data (same hazard as x, fixed in train())
-        return self._pool.submit(
-            transport.split_step, acts, np.array(y, copy=True), step,
-            self.client_id)
+        y_copy = np.array(y, copy=True)
+        tr = obs_trace.get_tracer()
+        if tr is None:  # untraced hot path: submit the bare call
+            return self._pool.submit(
+                transport.split_step, acts, y_copy, step, self.client_id)
+
+        # traced: the trace id must ride the LANE thread's CTX (thread-
+        # local), so wrap the call; the tid doubles as the Chrome-trace
+        # row, making the W-deep overlap visible per lane
+        tid = tr.new_trace_id(self.client_id, step)
+
+        def call():
+            obs_trace.CTX.trace_id = tid
+            t0 = time.perf_counter()
+            try:
+                out = transport.split_step(acts, y_copy, step,
+                                           self.client_id)
+            finally:
+                obs_trace.CTX.trace_id = None
+            tr.record("transport", t0, time.perf_counter() - t0,
+                      trace_id=tid, tid=lane, step=step)
+            return out
+
+        return self._pool.submit(call)
 
     def _apply(self, entry) -> float:
         """Apply one completed exchange (in step order): remat backward
         under the params the forward used, update current state."""
         params_then, xd, future = entry
         g_acts, loss = future.result()
+        tr = obs_trace.get_tracer()
+        t0 = time.perf_counter() if tr is not None else 0.0
         g_params = self._bwd(params_then, xd, jnp.asarray(g_acts))
         self.state = apply_grads(self._tx, self.state, g_params)
+        if tr is not None:
+            jax.block_until_ready(self.state.params)
+            tr.record("client_bwd", t0, time.perf_counter() - t0,
+                      tid=self.client_id)
         return loss
 
     def train(self, data_iter: Callable[[], Iterable[Tuple[np.ndarray, np.ndarray]]],
@@ -134,8 +163,14 @@ class PipelinedSplitClientTrainer:
                 # buffer: the remat backward re-reads it up to depth-1
                 # batches later, and a loader that recycles one numpy
                 # buffer per batch would silently hand it different data
+                tr = obs_trace.get_tracer()
+                t_f0 = time.perf_counter() if tr is not None else 0.0
                 xd = jnp.asarray(x)
                 acts = np.asarray(self._fwd(self.state.params, xd))
+                if tr is not None:
+                    tr.record("client_fwd", t_f0,
+                              time.perf_counter() - t_f0,
+                              tid=self.client_id, step=step)
                 lane = step % self.depth
                 window.append((self.state.params, xd,
                                self._submit(lane, acts, y, step), step))

@@ -20,6 +20,7 @@ silently desyncing after a client restart.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -29,6 +30,8 @@ import numpy as np
 from split_learning_tpu.core.losses import (
     cross_entropy, per_example_cross_entropy)
 from split_learning_tpu.core.stage import SplitPlan
+from split_learning_tpu.obs import trace as obs_trace
+from split_learning_tpu.obs.metrics import Registry
 from split_learning_tpu.runtime.coalesce import (
     CoalesceRequest, RequestCoalescer, pow2_bucket)
 from split_learning_tpu.runtime.state import (
@@ -71,6 +74,10 @@ class ServerRuntime:
         # checkpointing off it
         self.on_step: Optional[Any] = None
         self._lock = threading.RLock()
+        # obs (PR 2): queue-wait / dispatch histograms behind GET
+        # /metrics and self.metrics(). Allocated at init (never on the
+        # step path); populated only while tracing is enabled.
+        self._metrics = Registry()
         # per-client step handshake (multi-client split: SURVEY.md config 3);
         # _step_floor is a global minimum installed by resume_from so that
         # EVERY client — known or not — must resume at or after the
@@ -188,16 +195,28 @@ class ServerRuntime:
             # mode guard ≡ HTTP 400 (ref src/server_part.py:31-36)
             raise ProtocolError(
                 f"split_step called in mode {self.mode!r}", status=400)
+        # obs: tr stays None by default, and every timing site below is
+        # gated on it — the untraced serialized path takes no extra
+        # locks and allocates nothing (the zero-overhead-off contract)
+        tr = obs_trace.get_tracer()
         if self._coalescer is not None:
             # block on the group's future; the handshake runs at
             # dispatch-admission time so a replayed step 409s its own
             # client without poisoning the group
-            return self._coalescer.submit(activations, labels, step,
-                                          client_id)
+            if tr is None:
+                return self._coalescer.submit(activations, labels, step,
+                                              client_id)
+            return self._coalescer.submit(
+                activations, labels, step, client_id,
+                trace_id=obs_trace.CTX.trace_id,
+                t_enqueue=time.perf_counter())
+        t_q0 = time.perf_counter() if tr is not None else 0.0
         with self._lock:
+            t_d0 = time.perf_counter() if tr is not None else 0.0
             self._check_step(step, client_id)
             self.state, g_acts, loss = self._split_step(
                 self.state, jnp.asarray(activations), jnp.asarray(labels))
+            g_host, loss_f = np.asarray(g_acts), float(loss)
             # max(): with strict_steps off (pipelined clients) steps can
             # arrive out of order, and the acknowledged step — what /health
             # reports and checkpoints are labeled with — must never regress
@@ -206,7 +225,30 @@ class ServerRuntime:
             self._last_step[client_id] = acked
             if self.on_step is not None:
                 self.on_step(acked)
-            return np.asarray(g_acts), float(loss)
+            if tr is not None:
+                # queue_wait = lock wait; dispatch = jitted step + host
+                # materialization (g_host/loss_f force the transfer)
+                self._record_server_spans(
+                    tr, t_q0, t_d0 - t_q0, t_d0,
+                    time.perf_counter() - t_d0,
+                    obs_trace.CTX.trace_id, step, client_id)
+            return g_host, loss_f
+
+    def _record_server_spans(self, tr, t_q0: float, qw: float,
+                             t_d0: float, dw: float,
+                             trace_id: Optional[str], step: int,
+                             client_id: int) -> None:
+        """Record one step's server-party spans into the tracer and the
+        /metrics histograms, and publish them to CTX.server_spans so the
+        transport can hand them back to the client (wire accounting)."""
+        tr.record("queue_wait", t_q0, qw, trace_id=trace_id,
+                  party="server", tid=client_id, step=step)
+        tr.record("dispatch", t_d0, dw, trace_id=trace_id,
+                  party="server", tid=client_id, step=step)
+        self._metrics.observe("queue_wait", qw)
+        self._metrics.observe("dispatch", dw)
+        self._metrics.incr("split_steps_total")
+        obs_trace.CTX.server_spans = {"queue_wait": qw, "dispatch": dw}
 
     def _dispatch_group(self, group: "list[CoalesceRequest]",
                         reason: str) -> None:
@@ -217,6 +259,10 @@ class ServerRuntime:
         rows — exact, because the loss is per-example) and its
         segment-mean loss, so a group of one reproduces the serialized
         semantics and the client-side math never changes."""
+        tr = obs_trace.get_tracer()
+        # group pickup time: each request's queue_wait (enqueue -> here)
+        # includes the coalescer window wait by construction
+        t_pick = time.perf_counter() if tr is not None else 0.0
         with self._lock:
             admitted = []
             for r in group:
@@ -246,11 +292,13 @@ class ServerRuntime:
             if sig not in self._coalesce_shapes:
                 self._coalesce_shapes.add(sig)
                 self._coalescer.stats.incr("compile_count")
+            t_d0 = time.perf_counter() if tr is not None else 0.0
             self.state, g_acts, per_ex = self._coalesced_step(
                 self.state, jnp.asarray(acts), jnp.asarray(labels),
                 jnp.asarray(weights))
             g_acts = np.asarray(g_acts)
             per_ex = np.asarray(per_ex)
+            dw = time.perf_counter() - t_d0 if tr is not None else 0.0
             off = 0
             for r, b in zip(admitted, sizes):
                 seg = (g_acts[off:off + b] * (total / b)).astype(
@@ -261,6 +309,19 @@ class ServerRuntime:
                 self._last_step[r.client_id] = acked
                 if self.on_step is not None:
                     self.on_step(acked)
+                if tr is not None and r.t_enqueue is not None:
+                    # per-request queue wait (incl. window); the batched
+                    # dispatch is one event shared by the whole group
+                    qw = max(t_pick - r.t_enqueue, 0.0)
+                    r.server_spans = {"queue_wait": qw, "dispatch": dw}
+                    tr.record("queue_wait", r.t_enqueue, qw,
+                              trace_id=r.trace_id, party="server",
+                              tid=r.client_id, step=r.step)
+                    tr.record("dispatch", t_d0, dw, trace_id=r.trace_id,
+                              party="server", tid=r.client_id, step=r.step)
+                    self._metrics.observe("queue_wait", qw)
+                    self._metrics.observe("dispatch", dw)
+                    self._metrics.incr("split_steps_total")
                 r.done.set()
 
     def predict(self, activations: np.ndarray,
@@ -390,6 +451,19 @@ class ServerRuntime:
                 "coalesce_window_ms": self._coalescer.window_s * 1e3,
                 **self._coalescer.counters()}
         return info
+
+    def metrics(self) -> Dict[str, Any]:
+        """In-process equivalent of ``GET /metrics``: the histogram/
+        counter/gauge snapshot (obs/metrics.py Registry.snapshot shape),
+        enriched with scrape-time state — the acked step and, on
+        coalescing servers, the coalescer counters. Runs entirely off
+        the step path (the lock is taken only here, at scrape time)."""
+        snap = self._metrics.snapshot()
+        h = self.health()
+        snap["gauges"]["acked_step"] = float(h["step"])
+        for k, v in h.get("coalescing", {}).items():
+            snap["counters"][f"coalesce_{k}"] = float(v)
+        return snap
 
     def close(self) -> None:
         """Flush and join the coalescer (no-op on serialized servers)."""

@@ -80,13 +80,18 @@ class StdoutLogger(MetricLogger):
 
     def log_metric(self, key: str, value: float, step: int) -> None:
         if step % self.every == 0:
-            print(f"[step {step}] {key}: {value:.4f}", file=self.stream)
+            print(f"[step {step}] {key}: {value:.4f}", file=self.stream,
+                  flush=True)
 
     def log_params(self, params: Dict[str, Any]) -> None:
-        print(f"[params] {params}", file=self.stream)
+        print(f"[params] {params}", file=self.stream, flush=True)
 
 
 class JsonlLogger(MetricLogger):
+    """One JSON record per line, flushed per record: a reader (e.g. a
+    trace-report run against a live training job) always sees whole
+    lines, never a partially-buffered record."""
+
     def __init__(self, path: str, experiment: str = "", run_name: str = "") -> None:
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         self._f = open(path, "a", buffering=1)
@@ -98,11 +103,13 @@ class JsonlLogger(MetricLogger):
             "ts": time.time(), "experiment": self.experiment,
             "run": self.run_name, "key": key,
             "value": float(value), "step": int(step)}) + "\n")
+        self._f.flush()
 
     def log_params(self, params: Dict[str, Any]) -> None:
         self._f.write(json.dumps({
             "ts": time.time(), "experiment": self.experiment,
             "run": self.run_name, "params": params}) + "\n")
+        self._f.flush()
 
     def close(self) -> None:
         self._f.close()

@@ -71,6 +71,18 @@ class TransportStats:
         with self._lock:
             self.counters[name] = self.counters.get(name, 0) + by
 
+    def record_span(self, name: str, seconds: float) -> None:
+        """Fold one obs span (obs/trace.py) into the counters dict as
+        ``span_<name>_s`` / ``span_<name>_n`` — no schema change, so
+        merged() pools per-phase totals across lanes and summary()
+        reports them alongside the round-trip stats. Only called when
+        tracing is enabled."""
+        with self._lock:
+            self.counters[f"span_{name}_s"] = (
+                self.counters.get(f"span_{name}_s", 0.0) + seconds)
+            self.counters[f"span_{name}_n"] = (
+                self.counters.get(f"span_{name}_n", 0) + 1)
+
     def percentile(self, q: float) -> float:
         with self._lock:
             if not self._latencies:

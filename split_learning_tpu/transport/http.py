@@ -22,16 +22,21 @@ logic, N wire formats (SURVEY.md §7 layering).
 from __future__ import annotations
 
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 import requests
 
+from split_learning_tpu.obs import trace as obs_trace
 from split_learning_tpu.transport import codec
 from split_learning_tpu.transport.base import Transport, TransportError, timed
 
 CRC_HEADER = "X-SLT-CRC32"
+# ops that carry a per-step trace id when tracing is on (predict and
+# aggregate are outside the step span taxonomy)
+_TRACED_PATHS = ("/forward_pass", "/u_forward", "/u_backward")
 
 
 class SplitHTTPServer:
@@ -61,6 +66,17 @@ class SplitHTTPServer:
             def do_GET(self):
                 if self.path == "/health":
                     self._reply(200, codec.encode(outer.runtime.health()))
+                elif self.path == "/metrics":
+                    # Prometheus text exposition, served alongside
+                    # /health (scrape-time snapshot — never touches the
+                    # step hot path)
+                    from split_learning_tpu.obs.metrics import (
+                        render_prometheus)
+                    snap = (outer.runtime.metrics()
+                            if hasattr(outer.runtime, "metrics") else {})
+                    self._reply(
+                        200, render_prometheus(snap).encode("utf-8"),
+                        ctype="text/plain; version=0.0.4; charset=utf-8")
                 else:
                     self._reply(404, codec.encode({"error": "not found"}))
 
@@ -78,9 +94,17 @@ class SplitHTTPServer:
                         self._reply(400, codec.encode(
                             {"error": "frame checksum mismatch"}))
                         return
+                tid = None
                 try:
                     req = codec.decompress_tree(codec.decode(raw))
                     cid = int(req.get("client_id", 0))
+                    tid = req.get("trace_id")
+                    if tid is not None:
+                        # adopt the client's trace id on this handler
+                        # thread so the runtime's server spans join the
+                        # same per-step trace; echoed back below
+                        obs_trace.CTX.trace_id = str(tid)
+                        obs_trace.CTX.server_spans = None
                     # reply with the same wire compression the client used
                     q8 = req.get("compress") == "int8"
                     pack = codec.q8_compress if q8 else (lambda a: a)
@@ -88,35 +112,45 @@ class SplitHTTPServer:
                         grads, loss = outer.runtime.split_step(
                             req["activations"], req["labels"],
                             int(req["step"]), cid)
-                        body = codec.encode(
-                            {"grads": pack(grads), "loss": loss,
-                             "step": req["step"]})
+                        resp = {"grads": pack(grads), "loss": loss,
+                                "step": req["step"]}
                     elif self.path == "/u_forward":
                         feats = outer.runtime.u_forward(
                             req["activations"], int(req["step"]), cid)
-                        body = codec.encode({"features": pack(feats)})
+                        resp = {"features": pack(feats)}
                     elif self.path == "/u_backward":
                         g = outer.runtime.u_backward(
                             req["feat_grads"], int(req["step"]), cid)
-                        body = codec.encode({"grads": pack(g)})
+                        resp = {"grads": pack(g)}
                     elif self.path == "/predict":
                         out = outer.runtime.predict(req["activations"], cid)
-                        body = codec.encode({"outputs": pack(out)})
+                        resp = {"outputs": pack(out)}
                     elif self.path == "/aggregate_weights":
                         n_ex = req.get("num_examples")
                         agg = outer.runtime.aggregate(
                             req["model_state"], int(req["epoch"]),
                             float(req["loss"]), int(req["step"]),
                             int(n_ex) if n_ex is not None else None)
-                        body = codec.encode({"model_state": agg})
+                        resp = {"model_state": agg}
                     else:
                         self._reply(404, codec.encode({"error": "not found"}))
                         return
-                    self._reply(200, body)
+                    if tid is not None and obs_trace.CTX.server_spans:
+                        # server-side timings ride back in the payload so
+                        # the client can split wire time out of the
+                        # round trip (wire = round_trip - server total)
+                        resp["server_spans"] = obs_trace.CTX.server_spans
+                    self._reply(200, codec.encode(resp))
                 except ProtocolError as exc:
                     self._reply(exc.status, codec.encode({"error": str(exc)}))
                 except Exception as exc:  # noqa: BLE001 — server must not die
                     self._reply(500, codec.encode({"error": str(exc)}))
+                finally:
+                    if tid is not None:
+                        # handler threads serve many requests over one
+                        # keep-alive connection: never leak a trace id
+                        obs_trace.CTX.trace_id = None
+                        obs_trace.CTX.server_spans = None
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self._httpd.server_address
@@ -164,9 +198,23 @@ class HttpTransport(Transport):
 
     def _post(self, path: str, payload: Dict[str, Any]) -> Dict[str, Any]:
         from split_learning_tpu.runtime.server import ProtocolError
+        # tracing (obs/trace.py): with the tracer off this method is
+        # bit-for-bit the untraced wire — no trace_id key, no extra
+        # timing calls. With it on, the trace id travels in the payload
+        # and the server echoes its span timings back as server_spans.
+        tr = obs_trace.get_tracer()
+        tid = None
+        if tr is not None and path in _TRACED_PATHS:
+            tid = obs_trace.CTX.trace_id or tr.new_trace_id(
+                int(payload.get("client_id", 0)),
+                int(payload.get("step", -1)))
+            payload = dict(payload, trace_id=tid)
         if self.compress != "none":
             payload = dict(payload, compress=self.compress)
+        t_enc0 = time.perf_counter() if tid is not None else 0.0
         body = codec.encode(payload)
+        enc_s = time.perf_counter() - t_enc0 if tid is not None else 0.0
+        t_wire0 = time.perf_counter() if tid is not None else 0.0
         try:
             resp = self._session.post(
                 f"{self.base_url}{path}", data=body, timeout=self.timeout,
@@ -174,6 +222,7 @@ class HttpTransport(Transport):
                          CRC_HEADER: str(codec.checksum(body))})
         except requests.RequestException as exc:
             raise TransportError(f"POST {path} failed: {exc}") from exc
+        t_wire1 = time.perf_counter() if tid is not None else 0.0
         self.stats.add_bytes(sent=len(body), received=len(resp.content))
         resp_crc = resp.headers.get(CRC_HEADER)
         if resp_crc is not None:
@@ -189,7 +238,25 @@ class HttpTransport(Transport):
         if resp.status_code != 200:
             raise TransportError(
                 f"POST {path} -> {resp.status_code}: {resp.content[:200]!r}")
-        return codec.decompress_tree(codec.decode(resp.content))
+        t_dec0 = time.perf_counter() if tid is not None else 0.0
+        out = codec.decompress_tree(codec.decode(resp.content))
+        if tid is not None:
+            enc_s += time.perf_counter() - t_dec0  # client codec, both ways
+            srv = out.pop("server_spans", None) or {}
+            step = int(payload.get("step", -1))
+            cid = int(payload.get("client_id", 0))
+            wire = max((t_wire1 - t_wire0) - sum(srv.values()), 0.0)
+            tr.record("encode", t_enc0, enc_s,
+                      trace_id=tid, party="client", tid=cid, step=step)
+            tr.record("wire", t_wire0, wire,
+                      trace_id=tid, party="client", tid=cid, step=step)
+            self.stats.record_span("encode", enc_s)
+            self.stats.record_span("wire", wire)
+            # server-reported spans fold into this transport's stats so
+            # merged() carries the full cross-party phase breakdown
+            for name, secs in srv.items():
+                self.stats.record_span(str(name), float(secs))
+        return out
 
     def split_step(self, activations: np.ndarray, labels: np.ndarray,
                    step: int, client_id: int = 0) -> Tuple[np.ndarray, float]:

@@ -8,10 +8,12 @@ wire codec so serialization is covered even in-process.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, Tuple
 
 import numpy as np
 
+from split_learning_tpu.obs import trace as obs_trace
 from split_learning_tpu.transport import codec
 from split_learning_tpu.transport.base import Transport, TransportError, timed
 
@@ -43,12 +45,52 @@ class LocalTransport(Transport):
 
     def split_step(self, activations: np.ndarray, labels: np.ndarray,
                    step: int, client_id: int = 0) -> Tuple[np.ndarray, float]:
+        tr = obs_trace.get_tracer()
+        if tr is None:  # the untraced hot path, unchanged
+            with timed(self.stats):
+                acts = self._roundtrip(np.asarray(activations))
+                labs = self._roundtrip(np.asarray(labels))
+                grads, loss = self._call(self.server.split_step, acts, labs,
+                                         step, client_id)
+                return self._roundtrip(grads), float(loss)
+        return self._split_step_traced(tr, activations, labels, step,
+                                       client_id)
+
+    def _split_step_traced(self, tr, activations, labels, step, client_id):
+        """Traced variant: in-process, so the server reads CTX.trace_id
+        directly (same thread) and writes CTX.server_spans back; "wire"
+        here is pure call overhead (server time subtracted), the
+        in-process floor the HTTP wire numbers compare against."""
         with timed(self.stats):
-            acts = self._roundtrip(np.asarray(activations))
-            labs = self._roundtrip(np.asarray(labels))
-            grads, loss = self._call(self.server.split_step, acts, labs,
-                                     step, client_id)
-            return self._roundtrip(grads), float(loss)
+            tid = obs_trace.CTX.trace_id or tr.new_trace_id(client_id, step)
+            prev = obs_trace.CTX.trace_id
+            obs_trace.CTX.trace_id = tid
+            obs_trace.CTX.server_spans = None
+            try:
+                t0 = time.perf_counter()
+                acts = self._roundtrip(np.asarray(activations))
+                labs = self._roundtrip(np.asarray(labels))
+                t1 = time.perf_counter()
+                grads, loss = self._call(self.server.split_step, acts, labs,
+                                         step, client_id)
+                t2 = time.perf_counter()
+                out = self._roundtrip(grads), float(loss)
+                t3 = time.perf_counter()
+                enc_s = (t1 - t0) + (t3 - t2)  # codec both ways
+                srv = obs_trace.CTX.server_spans or {}
+                wire = max((t2 - t1) - sum(srv.values()), 0.0)
+                tr.record("encode", t0, enc_s, trace_id=tid,
+                          party="client", tid=client_id, step=step)
+                tr.record("wire", t1, wire, trace_id=tid,
+                          party="client", tid=client_id, step=step)
+                self.stats.record_span("encode", enc_s)
+                self.stats.record_span("wire", wire)
+                for name, secs in srv.items():
+                    self.stats.record_span(str(name), float(secs))
+                return out
+            finally:
+                obs_trace.CTX.trace_id = prev
+                obs_trace.CTX.server_spans = None
 
     def u_forward(self, activations: np.ndarray, step: int,
                   client_id: int = 0) -> np.ndarray:

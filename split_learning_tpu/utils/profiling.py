@@ -13,6 +13,7 @@ Two layers:
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from collections import defaultdict
 from typing import Dict, Iterator, Optional
@@ -21,10 +22,16 @@ import numpy as np
 
 
 class PhaseProfiler:
-    """Accumulates wall-clock per named phase across steps."""
+    """Accumulates wall-clock per named phase across steps.
+
+    Thread-safe: one profiler may be shared across the thread-pool
+    workers of ``MultiClientSplitRunner(concurrent=True)`` (each
+    ``phase()`` exit appends under a lock; the defaultdict alone is not
+    safe against concurrent first-touch of a phase name)."""
 
     def __init__(self) -> None:
         self._samples: Dict[str, list] = defaultdict(list)
+        self._lock = threading.Lock()
 
     @contextlib.contextmanager
     def phase(self, name: str) -> Iterator[None]:
@@ -32,30 +39,41 @@ class PhaseProfiler:
         try:
             yield
         finally:
-            self._samples[name].append(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self._samples[name].append(dt)
 
     def summary(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            items = [(name, list(xs)) for name, xs in self._samples.items()]
         out = {}
-        for name, xs in self._samples.items():
+        for name, xs in items:
             arr = np.asarray(xs)
             out[name] = {
                 "count": int(arr.size),
                 "total_s": float(arr.sum()),
                 "mean_ms": float(arr.mean() * 1e3),
                 "p50_ms": float(np.percentile(arr, 50) * 1e3),
+                "p90_ms": float(np.percentile(arr, 90) * 1e3),
                 "p99_ms": float(np.percentile(arr, 99) * 1e3),
             }
         return out
 
     def fraction(self, name: str) -> float:
         """Share of total accounted time spent in ``name`` — e.g.
-        fraction('transport') answers the north-star question directly."""
-        totals = {k: sum(v) for k, v in self._samples.items()}
+        fraction('transport') answers the north-star question directly.
+        Returns 0.0 when no samples are recorded (an empty profiler has
+        spent no accounted time anywhere, so every share is zero — not
+        the NaN it used to return, which poisoned downstream
+        arithmetic)."""
+        with self._lock:
+            totals = {k: sum(v) for k, v in self._samples.items()}
         denom = sum(totals.values())
-        return totals.get(name, 0.0) / denom if denom else float("nan")
+        return totals.get(name, 0.0) / denom if denom else 0.0
 
     def reset(self) -> None:
-        self._samples.clear()
+        with self._lock:
+            self._samples.clear()
 
 
 @contextlib.contextmanager

@@ -158,6 +158,29 @@ def test_jsonl_logger_and_factory(tmp_path):
         make_logger(Config(tracking="carrier-pigeon"))
 
 
+def test_jsonl_logger_flushes_each_record(tmp_path):
+    """Every record is flushed as a whole line while the logger is still
+    open — a live reader (trace report against a running job) never sees
+    a partially-buffered record. log_params round-trips too."""
+    path = str(tmp_path / "live.jsonl")
+    lg = JsonlLogger(path, experiment="E", run_name="r")
+    try:
+        lg.log_params({"lr": 0.01, "mode": "split", "clients": 4})
+        lg.log_metric("loss", 2.25, step=7)
+        # read back WITHOUT closing the logger
+        lines = open(path).read().splitlines()
+        assert len(lines) == 2
+        params = json.loads(lines[0])
+        assert params["params"] == {"lr": 0.01, "mode": "split",
+                                    "clients": 4}
+        assert params["experiment"] == "E" and params["run"] == "r"
+        rec = json.loads(lines[1])
+        assert rec["key"] == "loss" and rec["value"] == 2.25
+        assert rec["step"] == 7
+    finally:
+        lg.close()
+
+
 def test_multi_logger(capsys):
     lg = MultiLogger([StdoutLogger(every=1)])
     lg.log_metric("loss", 2.0, step=0)

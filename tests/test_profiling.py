@@ -1,5 +1,6 @@
 """Phase profiler: the compute-vs-transport split the north star is about."""
 
+import threading
 import time
 
 import jax
@@ -21,9 +22,50 @@ def test_phase_profiler_accounting():
     s = prof.summary()
     assert s["a"]["count"] == 1
     assert s["b"]["mean_ms"] > s["a"]["mean_ms"]
+    # p90 rides between the median and the tail in every summary row
+    for row in s.values():
+        assert row["p50_ms"] <= row["p90_ms"] <= row["p99_ms"]
     assert 0.5 < prof.fraction("b") < 1.0
     prof.reset()
     assert prof.summary() == {}
+
+
+def test_phase_profiler_empty_fraction_is_zero():
+    """An empty profiler has spent no accounted time anywhere, so every
+    share is 0.0 — NOT the NaN it used to return, which poisoned any
+    downstream arithmetic (and made `frac == frac` guards necessary)."""
+    prof = PhaseProfiler()
+    assert prof.fraction("transport") == 0.0
+    # also after reset, and for a never-recorded name on a non-empty one
+    with prof.phase("compute_fwd"):
+        pass
+    assert prof.fraction("never_recorded") == 0.0
+    prof.reset()
+    assert prof.fraction("transport") == 0.0
+
+
+def test_phase_profiler_thread_safe():
+    """One profiler shared across MultiClientSplitRunner's worker threads:
+    concurrent first-touch of phase names and concurrent appends must
+    lose no samples."""
+    prof = PhaseProfiler()
+    n_threads, per_thread = 8, 200
+
+    def hammer(i):
+        for j in range(per_thread):
+            with prof.phase(f"phase_{j % 5}"):
+                pass
+            prof.fraction("phase_0")  # concurrent reads too
+
+    threads = [threading.Thread(target=hammer, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    s = prof.summary()
+    assert set(s) == {f"phase_{k}" for k in range(5)}
+    assert sum(row["count"] for row in s.values()) == n_threads * per_thread
 
 
 def test_split_trainer_reports_transport_fraction():
