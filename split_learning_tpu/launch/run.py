@@ -609,11 +609,14 @@ def cmd_train(args) -> int:
         transport_factory = None
         if args.transport == "http":
             from split_learning_tpu.transport.http import HttpTransport
+            density = getattr(args, "compress_density", 0.1)
             transport = HttpTransport(cfg.server_url,
-                                      compress=args.compress or "none")
+                                      compress=args.compress or "none",
+                                      density=density)
             if depth > 1:  # one connection per in-flight lane
                 transport_factory = lambda: HttpTransport(  # noqa: E731
-                    cfg.server_url, compress=args.compress or "none")
+                    cfg.server_url, compress=args.compress or "none",
+                    density=density)
             # readiness barrier: the reference's client starts blind and
             # silently drops every pre-server batch (SURVEY.md §3.4)
             info = transport.wait_ready(timeout=args.wait_server)
@@ -635,7 +638,12 @@ def cmd_train(args) -> int:
             # for a depth-W window, so strictness follows the depth
             server = ServerRuntime(plan, cfg, jax.random.PRNGKey(cfg.seed),
                                    sample, strict_steps=depth <= 1)
-            transport = LocalTransport(server)
+            # --compress plumbs here too (wire emulation through the real
+            # codec) so compressed-path runs don't need sockets; None
+            # keeps the legacy direct path bit-for-bit
+            transport = LocalTransport(
+                server, compress=args.compress,
+                density=getattr(args, "compress_density", 0.1))
         if cfg.mode == "split":
             if depth > 1:
                 if phase_prof is not None:
@@ -973,7 +981,9 @@ def cmd_serve(args) -> int:
         print(f"[serve] tracing on: /metrics histograms live; Chrome "
               f"trace -> {trace_path} on shutdown", file=sys.stderr)
 
-    server = SplitHTTPServer(runtime, host=args.host, port=args.port).start()
+    server = SplitHTTPServer(runtime, host=args.host, port=args.port,
+                             compress=args.compress or "none",
+                             density=args.compress_density).start()
     print(f"[serve] mode={cfg.mode} listening on {server.url}")
     try:
         while True:
@@ -1263,9 +1273,17 @@ def main(argv: Optional[list] = None) -> int:
                     help="on a raw-file miss, download the canonical "
                          "distribution into --data-dir (sha256-verified; "
                          "default stays hermetic/offline)")
-    pt.add_argument("--compress", choices=["none", "int8"], default=None,
+    pt.add_argument("--compress", choices=["none", "int8", "topk8"],
+                    default=None,
                     help="wire compression of the cut-layer tensors "
-                         "(http transport only)")
+                         "(http transport only): int8 = dense 4x "
+                         "quantization; topk8 = top-k sparsification + "
+                         "int8 with error feedback (~17x at the default "
+                         "density — see README 'Wire compression')")
+    pt.add_argument("--compress-density", dest="compress_density",
+                    type=float, default=0.1,
+                    help="topk8 only: fraction of cut-layer elements "
+                         "shipped per step (default 0.1)")
     pt.add_argument("--pipeline-depth", dest="pipeline_depth", type=int,
                     default=1,
                     help="split mode, local/http transports: keep up to N "
@@ -1306,6 +1324,14 @@ def main(argv: Optional[list] = None) -> int:
                     help="how long a coalescing group waits for peers "
                          "after its first request before flushing partial "
                          "(only with --coalesce-max > 1)")
+    ps.add_argument("--compress", choices=["none", "int8", "topk8"],
+                    default=None,
+                    help="default wire compression for replies to clients "
+                         "that do not pick one themselves (a request's own "
+                         "compress key always wins)")
+    ps.add_argument("--compress-density", dest="compress_density",
+                    type=float, default=0.1,
+                    help="topk8 only: default reply density (default 0.1)")
     ps.add_argument("--trace", default=None, metavar="PATH",
                     help="per-step span tracing (obs/): serve live "
                          "queue-wait/dispatch histograms on GET /metrics "

@@ -6,4 +6,5 @@ the cut-layer tensor and frame checksumming — compiled from
 """
 
 from split_learning_tpu.native.codec import (  # noqa: F401
-    available, build_error, crc32, q8_dequantize, q8_quantize)
+    available, build_error, crc32, q8_dequantize, q8_quantize,
+    topk8_scatter, topk8_select)

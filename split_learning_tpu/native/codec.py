@@ -93,6 +93,16 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.slt_q8_dequantize_f32.argtypes = [
             ctypes.POINTER(ctypes.c_int8), ctypes.c_int64, ctypes.c_float,
             ctypes.POINTER(ctypes.c_float), ctypes.c_int]
+        lib.slt_topk8_select_f32.restype = None
+        lib.slt_topk8_select_f32.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int]
+        lib.slt_topk8_scatter_f32.restype = None
+        lib.slt_topk8_scatter_f32.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int8),
+            ctypes.c_int64, ctypes.c_float, ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int]
         lib.slt_crc32.restype = ctypes.c_uint32
         lib.slt_crc32.argtypes = [
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64, ctypes.c_uint32]
@@ -136,6 +146,47 @@ def q8_dequantize(q: np.ndarray, scale: float, n_threads: int = 0
     qc = np.ascontiguousarray(q, np.int8)
     out = np.empty(qc.shape, np.float32)
     lib.slt_q8_dequantize_f32(
+        qc.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        ctypes.c_int64(qc.size), ctypes.c_float(scale),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_int(n_threads))
+    return out
+
+
+def topk8_select(arr: np.ndarray, k: int, n_threads: int = 0
+                 ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Flat float32 array -> (ascending int32 indices of the top-k
+    magnitudes, gathered values); None if the native path is unavailable
+    or the input isn't float32. Selection rule (threshold + lowest-index
+    ties) matches codec._topk8_select_numpy exactly."""
+    lib = _load()
+    if lib is None or arr.dtype != np.float32:
+        return None
+    a = np.ascontiguousarray(arr).reshape(-1)
+    k = int(k)
+    idx = np.empty(k if k < a.size else a.size, np.int32)
+    vals = np.empty(idx.size, np.float32)
+    lib.slt_topk8_select_f32(
+        a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_int64(a.size), ctypes.c_int64(k),
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_int(n_threads))
+    return idx, vals
+
+
+def topk8_scatter(idx: np.ndarray, q: np.ndarray, scale: float, n: int,
+                  n_threads: int = 0) -> Optional[np.ndarray]:
+    """(indices, int8 values, scale) -> dense float32 vector of length n
+    with q*scale scattered at idx, zeros elsewhere."""
+    lib = _load()
+    if lib is None:
+        return None
+    ic = np.ascontiguousarray(idx, np.int64).reshape(-1)
+    qc = np.ascontiguousarray(q, np.int8).reshape(-1)
+    out = np.zeros(int(n), np.float32)
+    lib.slt_topk8_scatter_f32(
+        ic.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         qc.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
         ctypes.c_int64(qc.size), ctypes.c_float(scale),
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),

@@ -118,6 +118,106 @@ void slt_q8_dequantize_f32(const int8_t* src, int64_t n, float scale,
   });
 }
 
+// Top-k-|x| selection for the topk8 sparse wire mode. Deterministic
+// selection rule, shared bit-for-bit with the NumPy fallback
+// (_topk8_select_numpy in transport/codec.py): every element strictly
+// above the k-th-largest magnitude, then threshold ties in ascending
+// index order until exactly k survive; output indices ascending.
+//
+// Parallel scheme: abs pass -> nth_element for the threshold -> per-chunk
+// counts of (>thr) and (==thr) -> prefix sums give each chunk a disjoint
+// write window (chunk c starts at gt_pre[c] + min(eq_pre[c], need)), so
+// chunks write their ascending in-chunk survivors concurrently with no
+// atomics and the concatenation is globally ascending.
+void slt_topk8_select_f32(const float* src, int64_t n, int64_t k,
+                          int32_t* idx_out, float* vals_out, int n_threads) {
+  if (k >= n) {
+    for (int64_t i = 0; i < n; ++i) {
+      idx_out[i] = static_cast<int32_t>(i);
+      vals_out[i] = src[i];
+    }
+    return;
+  }
+  std::vector<float> absv(n);
+  int t = clamp_threads(n_threads, n, 1 << 16);
+  parallel_for(n, t, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) absv[i] = std::fabs(src[i]);
+  });
+  std::vector<float> part(absv);
+  std::nth_element(part.begin(), part.begin() + (k - 1), part.end(),
+                   std::greater<float>());
+  const float thr = part[k - 1];
+
+  int64_t chunk = (n + t - 1) / t;
+  std::vector<int64_t> gt_pre(t + 1, 0), eq_pre(t + 1, 0);
+  {
+    std::vector<std::thread> pool;
+    auto count = [&](int c, int64_t lo, int64_t hi) {
+      int64_t gt = 0, eq = 0;
+      for (int64_t i = lo; i < hi; ++i) {
+        if (absv[i] > thr) ++gt;
+        else if (absv[i] == thr) ++eq;
+      }
+      gt_pre[c + 1] = gt;
+      eq_pre[c + 1] = eq;
+    };
+    for (int c = 1; c < t; ++c) {
+      int64_t lo = c * chunk, hi = std::min(n, lo + chunk);
+      if (lo >= hi) break;
+      pool.emplace_back(count, c, lo, hi);
+    }
+    count(0, 0, std::min(n, chunk));
+    for (auto& th : pool) th.join();
+  }
+  for (int c = 0; c < t; ++c) {
+    gt_pre[c + 1] += gt_pre[c];
+    eq_pre[c + 1] += eq_pre[c];
+  }
+  const int64_t need = k - gt_pre[t];  // ties to keep, lowest-index first
+  {
+    std::vector<std::thread> pool;
+    auto write = [&](int c, int64_t lo, int64_t hi) {
+      int64_t out = gt_pre[c] + std::min(eq_pre[c], need);
+      int64_t tie_rank = eq_pre[c];
+      for (int64_t i = lo; i < hi; ++i) {
+        float a = absv[i];
+        if (a > thr) {
+          idx_out[out] = static_cast<int32_t>(i);
+          vals_out[out] = src[i];
+          ++out;
+        } else if (a == thr) {
+          if (tie_rank < need) {
+            idx_out[out] = static_cast<int32_t>(i);
+            vals_out[out] = src[i];
+            ++out;
+          }
+          ++tie_rank;
+        }
+      }
+    };
+    for (int c = 1; c < t; ++c) {
+      int64_t lo = c * chunk, hi = std::min(n, lo + chunk);
+      if (lo >= hi) break;
+      pool.emplace_back(write, c, lo, hi);
+    }
+    write(0, 0, std::min(n, chunk));
+    for (auto& th : pool) th.join();
+  }
+}
+
+// Sparse dequantize-scatter: dst (pre-zeroed, n floats) gets
+// dst[idx[i]] = q[i] * scale. Indices are unique by construction
+// (selection output), so parallel writes are disjoint.
+void slt_topk8_scatter_f32(const int64_t* idx, const int8_t* q, int64_t k,
+                           float scale, float* dst, int n_threads) {
+  int t = clamp_threads(n_threads, k, 1 << 16);
+  parallel_for(k, t, [=](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      dst[idx[i]] = static_cast<float>(q[i]) * scale;
+    }
+  });
+}
+
 // CRC-32 (IEEE 802.3, reflected polynomial 0xEDB88320), identical to
 // zlib.crc32. NOT on the wire hot path — the Python side uses zlib (which
 // is copy-free and GIL-releasing); this exists as the parity reference for

@@ -1,0 +1,118 @@
+"""Top-k magnitude sparsification of the cut-layer payload (in-jit side).
+
+The topk8 wire mode ships the top ``density`` fraction of the 5.28 MiB
+cut-layer tensor as int8 — ~17x fewer bytes than fp32 at the default
+density 0.1 (see transport/codec.py for the wire format and the
+error-feedback story). This module is the device-side counterpart,
+mirroring the q8 split of labor: the bandwidth-bound elementwise passes
+(magnitude, gather-quantize, scatter-decode) are Pallas kernels /
+device-resident ops, while the k-selection itself runs in XLA's
+``lax.top_k`` — a tuned sort-based reduction that Pallas cannot beat with
+a hand-rolled kernel at these sizes, just as q8 leaves the host wire path
+to native/slt_codec.cc.
+
+Selection semantics match the host paths (transport/codec.py NumPy,
+native/slt_codec.cc): top-k by |x|, ties broken toward lower indices
+(``lax.top_k`` is stable in exactly this way), int8 survivors quantized
+with the q8 scale math — the global |max| always survives, so the scale
+equals dense q8's. Parity is pinned by tests/test_topk.py.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from split_learning_tpu.ops.common import LANE, use_interpret
+from split_learning_tpu.ops.quantize import (
+    _BLOCK_ROWS, _pad_rows_to_grid, _to_tiles, quantize_int8)
+
+
+def _mag_kernel(x_ref, m_ref):
+    """Elementwise |x| — padding rows are zeros (see _to_tiles), so they
+    can never win a top-k slot against any real nonzero element."""
+    m_ref[:] = jnp.abs(x_ref[:])
+
+
+def magnitudes(x: jax.Array) -> jax.Array:
+    """x (any shape, float) -> flat f32 |x| of length x.size, computed
+    through the same single-block / row-grid split as quantize_int8."""
+    x2, n = _to_tiles(x)
+    rows = x2.shape[0]
+    if rows <= _BLOCK_ROWS:
+        m2 = pl.pallas_call(
+            _mag_kernel,
+            out_shape=jax.ShapeDtypeStruct(x2.shape, jnp.float32),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            interpret=use_interpret(),
+        )(x2)
+    else:
+        xg, n_blocks = _pad_rows_to_grid(x2)
+        block = pl.BlockSpec((_BLOCK_ROWS, LANE), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+        m2 = pl.pallas_call(
+            _mag_kernel,
+            out_shape=jax.ShapeDtypeStruct(xg.shape, jnp.float32),
+            grid=(n_blocks,),
+            in_specs=[block],
+            out_specs=block,
+            interpret=use_interpret(),
+        )(xg)
+    return m2.reshape(-1)[:n]
+
+
+def topk8_encode(x: jax.Array, k: int
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """x -> (idx int32 [k], q int8 [k], scale f32 scalar).
+
+    Pallas magnitude pass -> lax.top_k selection -> gather -> Pallas q8
+    quantize of the k survivors. k is static (density is a config knob,
+    not data-dependent), so shapes stay jit-stable."""
+    flat = x.reshape(-1).astype(jnp.float32)
+    n = flat.size
+    k = int(k)
+    if not 1 <= k <= n:
+        raise ValueError(f"k must be in [1, {n}] (got {k})")
+    mag = magnitudes(x)
+    _, idx = jax.lax.top_k(mag, k)
+    idx = idx.astype(jnp.int32)
+    vals = jnp.take(flat, idx)
+    qt, scale = quantize_int8(vals)
+    q = qt.reshape(-1)[:k]
+    return idx, q, scale
+
+
+def topk8_decode(idx: jax.Array, q: jax.Array, scale: jax.Array,
+                 shape: Tuple[int, ...], dtype=jnp.float32) -> jax.Array:
+    """(idx, q, scale) -> dense tensor: q*scale scattered at idx, zeros
+    elsewhere — what the receiving party reconstructs from the wire."""
+    n = 1
+    for s in shape:
+        n *= s
+    vals = q.astype(jnp.float32) * jnp.asarray(scale, jnp.float32)
+    flat = jnp.zeros((n,), jnp.float32).at[idx].set(
+        vals, unique_indices=True)
+    return flat.reshape(shape).astype(dtype)
+
+
+def topk8_residual(x: jax.Array, idx: jax.Array, q: jax.Array,
+                   scale: jax.Array) -> jax.Array:
+    """Error-feedback residual: x minus what the receiver reconstructs —
+    the dropped mass plus the survivors' quantization error. Kept on the
+    sender and added back before the next step's selection."""
+    vals = q.astype(jnp.float32) * jnp.asarray(scale, jnp.float32)
+    flat = x.reshape(-1).astype(jnp.float32).at[idx].add(
+        -vals, unique_indices=True)
+    return flat.reshape(x.shape)
+
+
+def topk8_roundtrip(x: jax.Array, k: int) -> jax.Array:
+    """Encode+decode: the transport-visible distortion of one step
+    (before error feedback repays it)."""
+    idx, q, scale = topk8_encode(x, k)
+    return topk8_decode(idx, q, scale, x.shape, x.dtype)

@@ -101,6 +101,17 @@ class SplitClientTrainer:
         self._bwd = jax.jit(
             lambda p, x, g: stage_backward(stage, p, x, g))
 
+    @property
+    def wire_ef(self) -> Optional[Any]:
+        """The transport's up-direction topk8 error-feedback buffer, when
+        the wire mode carries one (HttpTransport/LocalTransport with
+        compress="topk8"; None otherwise). Client-side EF state lives on
+        the transport — it belongs to the wire, not the weights — but is
+        surfaced here so restore logic can reset it alongside the
+        TrainState (a pre-restore residual describes a stream the
+        restored weights never produced)."""
+        return getattr(self.transport, "_ef", None)
+
     def ensure_init(self, sample_x: np.ndarray) -> None:
         if self.state is None:
             # Convention: every party runs plan.init from the shared seed and

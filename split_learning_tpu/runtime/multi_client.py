@@ -112,10 +112,16 @@ class MultiClientSplitRunner:
             self._pool = None
 
     def sync_bottoms(self) -> None:
-        """FedAvg the initialized client bottom stages (optimizer state
-        stays local; uninitialized clients are left untouched)."""
+        """FedAvg the client bottom stages that have actually trained
+        (optimizer state stays local). A client whose state is None or
+        whose step counter never advanced — fresh init, or every batch
+        dropped under the skip policy — is excluded AND left untouched:
+        averaging an untrained init into the round would drag every
+        bottom toward initialization, and overwriting the dropout's
+        params would hide that it never contributed."""
         from split_learning_tpu.runtime.state import fedavg_mean
-        ready = [c for c in self.clients if c.state is not None]
+        ready = [c for c in self.clients
+                 if c.state is not None and int(c.state.step) > 0]
         if len(ready) < 2:
             return
         mean_params = fedavg_mean([c.state.params for c in ready])

@@ -117,6 +117,15 @@ class ServerRuntime:
                     coalesce_window_ms / 1e3)
         # residuals for the U-shaped two-hop step, keyed by step
         self._u_residual: Dict[int, Any] = {}
+        # reply-direction error feedback for the topk8 wire mode, keyed
+        # (client_id, op) by the transports (transport/codec.py TopK8EF —
+        # internally locked, so coalesced groups packing per-client
+        # gradient segments from concurrent handler threads are safe).
+        # Lives on the runtime, not the transport, so it follows the
+        # training state: resume_from resets it with everything else.
+        from split_learning_tpu.transport import codec as _codec
+        self.wire_ef = _codec.TopK8EF()
+        self._wire_totals = [0, 0]  # raw, wire — behind the ratio gauge
 
     # ------------------------------------------------------------------ #
     def _build_jitted(self) -> None:
@@ -424,10 +433,28 @@ class ServerRuntime:
             self._last_step = {}
             self._step_floor = step - 1  # applies to every client_id
             self._u_residual.clear()
+            # error-feedback residuals describe the *pre-restore* stream;
+            # feeding them into post-restore steps would inject stale mass
+            self.wire_ef.reset()
             if self._agg is not None:
                 # drop any pre-restore FedAvg submissions: averaging stale
                 # params into the first post-restore round would corrupt it
                 self._agg = FedAvgAggregator(self._agg.num_clients)
+
+    def note_wire_compression(self, raw_bytes: int, wire_bytes: int) -> None:
+        """Fold one compressed exchange (logical fp32 bytes vs bytes on
+        the wire, both directions — transports call this per request)
+        into the metrics Registry: cumulative byte counters plus the
+        ``wire_compression_ratio`` gauge /metrics exposes."""
+        with self._lock:
+            self._wire_totals[0] += int(raw_bytes)
+            self._wire_totals[1] += int(wire_bytes)
+            self._metrics.incr("wire_raw_bytes", float(raw_bytes))
+            self._metrics.incr("wire_bytes", float(wire_bytes))
+            if self._wire_totals[1] > 0:
+                self._metrics.set_gauge(
+                    "wire_compression_ratio",
+                    self._wire_totals[0] / self._wire_totals[1])
 
     def health(self) -> Dict[str, Any]:
         """≡ GET /health (src/server_part.py:95-102), plus ``step``: the

@@ -71,6 +71,19 @@ class TransportStats:
         with self._lock:
             self.counters[name] = self.counters.get(name, 0) + by
 
+    def record_compression(self, raw_bytes: int, wire_bytes: int) -> None:
+        """Account one compressed payload: logical fp32 bytes vs bytes
+        actually shipped (q8/topk8 leaves only — see
+        codec.compressed_leaf_bytes). summary() derives the cumulative
+        ``compression_ratio`` from the two counters, and the server folds
+        the same totals into the ``wire_compression_ratio`` gauge on
+        /metrics."""
+        with self._lock:
+            self.counters["compress_raw_bytes"] = (
+                self.counters.get("compress_raw_bytes", 0) + raw_bytes)
+            self.counters["compress_wire_bytes"] = (
+                self.counters.get("compress_wire_bytes", 0) + wire_bytes)
+
     def record_span(self, name: str, seconds: float) -> None:
         """Fold one obs span (obs/trace.py) into the counters dict as
         ``span_<name>_s`` / ``span_<name>_n`` — no schema change, so
@@ -117,6 +130,10 @@ class TransportStats:
         }
         with self._lock:
             out.update(self.counters)
+            wire = self.counters.get("compress_wire_bytes", 0)
+            if wire > 0:
+                out["compression_ratio"] = (
+                    self.counters.get("compress_raw_bytes", 0) / wire)
         return out
 
 

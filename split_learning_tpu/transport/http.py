@@ -43,8 +43,21 @@ class SplitHTTPServer:
     """Serves a ServerRuntime over HTTP (stdlib; no FastAPI dependency)."""
 
     def __init__(self, runtime: Any, host: str = "127.0.0.1",
-                 port: int = 0) -> None:
+                 port: int = 0, compress: str = "none",
+                 density: float = 0.1) -> None:
+        """compress/density: server-side *defaults* for reply packing —
+        a request carrying its own ``compress``/``density`` keys always
+        wins (the client picks the wire format; these let ``serve
+        --compress ...`` force one for clients that don't)."""
+        if compress not in ("none", "int8", "topk8"):
+            raise ValueError(f"unknown compression {compress!r}")
         self.runtime = runtime
+        self.default_compress = compress
+        self.default_density = float(density)
+        # reply-direction error feedback: prefer the runtime's buffer
+        # (survives transport restarts, reset by resume_from); this local
+        # one is the fallback for bare runtimes in tests
+        self._wire_ef = codec.TopK8EF()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -96,7 +109,8 @@ class SplitHTTPServer:
                         return
                 tid = None
                 try:
-                    req = codec.decompress_tree(codec.decode(raw))
+                    tree = codec.decode(raw)
+                    req = codec.decompress_tree(tree)
                     cid = int(req.get("client_id", 0))
                     tid = req.get("trace_id")
                     if tid is not None:
@@ -105,9 +119,34 @@ class SplitHTTPServer:
                         # same per-step trace; echoed back below
                         obs_trace.CTX.trace_id = str(tid)
                         obs_trace.CTX.server_spans = None
-                    # reply with the same wire compression the client used
-                    q8 = req.get("compress") == "int8"
-                    pack = codec.q8_compress if q8 else (lambda a: a)
+                    in_raw, in_wire = codec.compressed_leaf_bytes(tree)
+                    # reply with the wire compression the client asked for
+                    # (request keys win over the server's serve-time
+                    # defaults)
+                    mode = req.get("compress") or outer.default_compress
+                    density = float(req.get("density",
+                                            outer.default_density))
+                    if mode == "topk8":
+                        # per-(client, op) error feedback on the reply
+                        # direction — handler threads serving a coalesced
+                        # group pack concurrently, so buffers must never
+                        # be shared across clients (TopK8EF locks)
+                        ef = getattr(outer.runtime, "wire_ef",
+                                     None) or outer._wire_ef
+                        key = (cid, self.path)
+                        if self.path == "/predict":
+                            # inference is stateless: no next step ever
+                            # repays a residual, so feed nothing back
+                            pack = (lambda a: codec.topk8_compress(
+                                np.asarray(a), density)[0])
+                        else:
+                            decay = codec.ef_decay_for(self.path)
+                            pack = (lambda a: ef.compress(
+                                key, np.asarray(a), density, decay=decay))
+                    elif mode == "int8":
+                        pack = codec.q8_compress
+                    else:
+                        pack = (lambda a: a)
                     if self.path == "/forward_pass":
                         grads, loss = outer.runtime.split_step(
                             req["activations"], req["labels"],
@@ -140,6 +179,11 @@ class SplitHTTPServer:
                         # the client can split wire time out of the
                         # round trip (wire = round_trip - server total)
                         resp["server_spans"] = obs_trace.CTX.server_spans
+                    out_raw, out_wire = codec.compressed_leaf_bytes(resp)
+                    if (in_wire or out_wire) and hasattr(
+                            outer.runtime, "note_wire_compression"):
+                        outer.runtime.note_wire_compression(
+                            in_raw + out_raw, in_wire + out_wire)
                     self._reply(200, codec.encode(resp))
                 except ProtocolError as exc:
                     self._reply(exc.status, codec.encode({"error": str(exc)}))
@@ -179,22 +223,43 @@ class HttpTransport(Transport):
     classification instead of silent batch drops."""
 
     def __init__(self, base_url: str, timeout: float = 60.0,
-                 compress: str = "none") -> None:
+                 compress: str = "none", density: float = 0.1) -> None:
         """``compress="int8"`` quantizes the cut-layer tensors on the wire
-        (4x fewer bytes; lossy — see ops/quantize.py). Weights
-        (/aggregate_weights) always travel lossless."""
+        (4x fewer bytes; lossy — see ops/quantize.py). ``"topk8"`` ships
+        only the top ``density`` fraction of magnitudes as int8 with
+        sender-side error feedback (~17x at density 0.1 — see
+        transport/codec.py). Weights (/aggregate_weights) always travel
+        lossless."""
         super().__init__()
-        if compress not in ("none", "int8"):
+        if compress not in ("none", "int8", "topk8"):
             raise ValueError(f"unknown compression {compress!r}")
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self.compress = compress
+        self.density = float(density)
+        # up-direction error feedback, keyed per op (one transport = one
+        # client, so the op name is the whole key)
+        self._ef = codec.TopK8EF()
         self._session = requests.Session()
 
-    def _pack(self, arr: np.ndarray) -> Any:
+    def _pack(self, arr: np.ndarray, key: str = "x") -> Any:
         if self.compress == "int8":
             return codec.q8_compress(np.asarray(arr))
+        if self.compress == "topk8":
+            if key == "predict":
+                # stateless: no later step repays an inference residual
+                return codec.topk8_compress(np.asarray(arr),
+                                            self.density)[0]
+            return self._ef.compress(key, np.asarray(arr), self.density,
+                                     decay=codec.ef_decay_for(key))
         return np.asarray(arr)
+
+    def _rollback(self, key: str) -> None:
+        """A failed POST means the packed tensor never reached the server:
+        undo the error-feedback update so the shipped mass isn't marked
+        delivered (the retry/skip policies re-pack from scratch)."""
+        if self.compress == "topk8":
+            self._ef.rollback(key)
 
     def _post(self, path: str, payload: Dict[str, Any]) -> Dict[str, Any]:
         from split_learning_tpu.runtime.server import ProtocolError
@@ -211,6 +276,11 @@ class HttpTransport(Transport):
             payload = dict(payload, trace_id=tid)
         if self.compress != "none":
             payload = dict(payload, compress=self.compress)
+            if self.compress == "topk8":
+                payload["density"] = self.density
+            raw_b, wire_b = codec.compressed_leaf_bytes(payload)
+            if wire_b:
+                self.stats.record_compression(raw_b, wire_b)
         t_enc0 = time.perf_counter() if tid is not None else 0.0
         body = codec.encode(payload)
         enc_s = time.perf_counter() - t_enc0 if tid is not None else 0.0
@@ -239,7 +309,12 @@ class HttpTransport(Transport):
             raise TransportError(
                 f"POST {path} -> {resp.status_code}: {resp.content[:200]!r}")
         t_dec0 = time.perf_counter() if tid is not None else 0.0
-        out = codec.decompress_tree(codec.decode(resp.content))
+        tree = codec.decode(resp.content)
+        if self.compress != "none":
+            raw_b, wire_b = codec.compressed_leaf_bytes(tree)
+            if wire_b:
+                self.stats.record_compression(raw_b, wire_b)
+        out = codec.decompress_tree(tree)
         if tid is not None:
             enc_s += time.perf_counter() - t_dec0  # client codec, both ways
             srv = out.pop("server_spans", None) or {}
@@ -261,34 +336,46 @@ class HttpTransport(Transport):
     def split_step(self, activations: np.ndarray, labels: np.ndarray,
                    step: int, client_id: int = 0) -> Tuple[np.ndarray, float]:
         with timed(self.stats):
-            out = self._post("/forward_pass", {
-                "activations": self._pack(activations),
-                "labels": np.asarray(labels),
-                "step": step, "client_id": client_id,
-            })
+            try:
+                out = self._post("/forward_pass", {
+                    "activations": self._pack(activations, "acts"),
+                    "labels": np.asarray(labels),
+                    "step": step, "client_id": client_id,
+                })
+            except Exception:
+                self._rollback("acts")
+                raise
             return out["grads"], float(out["loss"])
 
     def u_forward(self, activations: np.ndarray, step: int,
                   client_id: int = 0) -> np.ndarray:
         with timed(self.stats):
-            return self._post("/u_forward", {
-                "activations": self._pack(activations), "step": step,
-                "client_id": client_id,
-            })["features"]
+            try:
+                return self._post("/u_forward", {
+                    "activations": self._pack(activations, "u_acts"),
+                    "step": step, "client_id": client_id,
+                })["features"]
+            except Exception:
+                self._rollback("u_acts")
+                raise
 
     def u_backward(self, feat_grads: np.ndarray, step: int,
                    client_id: int = 0) -> np.ndarray:
         with timed(self.stats):
-            return self._post("/u_backward", {
-                "feat_grads": self._pack(feat_grads), "step": step,
-                "client_id": client_id,
-            })["grads"]
+            try:
+                return self._post("/u_backward", {
+                    "feat_grads": self._pack(feat_grads, "u_grads"),
+                    "step": step, "client_id": client_id,
+                })["grads"]
+            except Exception:
+                self._rollback("u_grads")
+                raise
 
     def predict(self, activations: np.ndarray,
                 client_id: int = 0) -> np.ndarray:
         with timed(self.stats):
             return self._post("/predict", {
-                "activations": self._pack(activations),
+                "activations": self._pack(activations, "predict"),
                 "client_id": client_id,
             })["outputs"]
 

@@ -9,7 +9,7 @@ wire codec so serialization is covered even in-process.
 from __future__ import annotations
 
 import time
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -24,15 +24,61 @@ class LocalTransport(Transport):
     violation (mode mismatch, step replay) that retry/skip policies must
     not mask; anything else becomes TransportError (transient)."""
 
-    def __init__(self, server: Any, through_codec: bool = False) -> None:
+    def __init__(self, server: Any, through_codec: bool = False,
+                 compress: Optional[str] = None,
+                 density: float = 0.1) -> None:
         """server: a ServerRuntime (duck-typed: split_step/u_forward/
-        u_backward/aggregate/health)."""
+        u_backward/aggregate/health).
+
+        compress: None (default) is the legacy direct path — no wire
+        emulation, bit-for-bit what this transport always did. Any of
+        "none"/"int8"/"topk8" switches the step ops to full wire
+        emulation: each direction's payload goes through the real codec
+        (encode -> byte count -> decode -> expand) with that compression
+        applied, exactly like one HTTP hop — so compressed-path parity
+        and convergence tests run in-process, no sockets. ``"none"``
+        emulates the dense fp32 wire (the baseline the bench legs
+        compare against). Weights (aggregate) always travel lossless."""
         super().__init__()
+        if compress not in (None, "none", "int8", "topk8"):
+            raise ValueError(f"unknown compression {compress!r}")
         self.server = server
         self.through_codec = through_codec
+        self.compress = compress
+        self.density = float(density)
+        self._ef = codec.TopK8EF()        # up direction (client-owned)
+        self._down_ef = codec.TopK8EF()   # down fallback for bare servers
 
     def _roundtrip(self, obj: Any) -> Any:
         return codec.decode(codec.encode(obj)) if self.through_codec else obj
+
+    # -- wire emulation (compress != None) ------------------------------
+    def _pack_up(self, arr: np.ndarray, key: Any) -> Any:
+        if self.compress == "int8":
+            return codec.q8_compress(np.asarray(arr))
+        if self.compress == "topk8":
+            return self._ef.compress(key, np.asarray(arr), self.density,
+                                     decay=codec.ef_decay_for(key[0]))
+        return np.asarray(arr)
+
+    def _pack_down(self, arr: np.ndarray, key: Any) -> Any:
+        if self.compress == "int8":
+            return codec.q8_compress(np.asarray(arr))
+        if self.compress == "topk8":
+            # same buffer the HTTP server uses, same (client, op) keying
+            ef = getattr(self.server, "wire_ef", None) or self._down_ef
+            return ef.compress(key, np.asarray(arr), self.density,
+                               decay=codec.ef_decay_for(key[1]))
+        return np.asarray(arr)
+
+    def _wire(self, payload: dict) -> Tuple[dict, int]:
+        """One direction of the emulated wire: real encode, real byte
+        count, real decode + expansion — what HTTP does minus the socket."""
+        body = codec.encode(payload)
+        raw_b, wire_b = codec.compressed_leaf_bytes(payload)
+        if wire_b:
+            self.stats.record_compression(raw_b, wire_b)
+        return codec.decompress_tree(codec.decode(body)), len(body)
 
     def _call(self, fn, *args):
         from split_learning_tpu.runtime.server import ProtocolError
@@ -45,6 +91,9 @@ class LocalTransport(Transport):
 
     def split_step(self, activations: np.ndarray, labels: np.ndarray,
                    step: int, client_id: int = 0) -> Tuple[np.ndarray, float]:
+        if self.compress is not None:
+            return self._split_step_wire(activations, labels, step,
+                                         client_id)
         tr = obs_trace.get_tracer()
         if tr is None:  # the untraced hot path, unchanged
             with timed(self.stats):
@@ -55,6 +104,26 @@ class LocalTransport(Transport):
                 return self._roundtrip(grads), float(loss)
         return self._split_step_traced(tr, activations, labels, step,
                                        client_id)
+
+    def _split_step_wire(self, activations, labels, step, client_id):
+        """Emulated-wire variant: both directions go through the real
+        codec with the configured compression. No rollback on failure —
+        an in-process call that raised still *delivered* the payload
+        (the server decoded it before failing), unlike a lost POST."""
+        with timed(self.stats):
+            req, up = self._wire({
+                "activations": self._pack_up(np.asarray(activations),
+                                             ("acts", client_id)),
+                "labels": np.asarray(labels)})
+            grads, loss = self._call(self.server.split_step,
+                                     req["activations"], req["labels"],
+                                     step, client_id)
+            resp, down = self._wire({
+                "grads": self._pack_down(grads,
+                                         (client_id, "/forward_pass")),
+                "loss": float(loss)})
+            self.stats.add_bytes(sent=up, received=down)
+            return resp["grads"], float(resp["loss"])
 
     def _split_step_traced(self, tr, activations, labels, step, client_id):
         """Traced variant: in-process, so the server reads CTX.trace_id
@@ -95,6 +164,15 @@ class LocalTransport(Transport):
     def u_forward(self, activations: np.ndarray, step: int,
                   client_id: int = 0) -> np.ndarray:
         with timed(self.stats):
+            if self.compress is not None:
+                req, up = self._wire({"activations": self._pack_up(
+                    np.asarray(activations), ("u_acts", client_id))})
+                feats = self._call(self.server.u_forward,
+                                   req["activations"], step, client_id)
+                resp, down = self._wire({"features": self._pack_down(
+                    feats, (client_id, "/u_forward"))})
+                self.stats.add_bytes(sent=up, received=down)
+                return resp["features"]
             feats = self._call(
                 self.server.u_forward,
                 self._roundtrip(np.asarray(activations)), step, client_id)
@@ -103,6 +181,28 @@ class LocalTransport(Transport):
     def predict(self, activations: np.ndarray,
                 client_id: int = 0) -> np.ndarray:
         with timed(self.stats):
+            if self.compress is not None:
+                # inference is stateless on both ends: no error feedback
+                a = np.asarray(activations)
+                if self.compress == "topk8":
+                    packed = codec.topk8_compress(a, self.density)[0]
+                elif self.compress == "int8":
+                    packed = codec.q8_compress(a)
+                else:
+                    packed = a
+                req, up = self._wire({"activations": packed})
+                out = self._call(self.server.predict, req["activations"],
+                                 client_id)
+                if self.compress == "topk8":
+                    packed_out = codec.topk8_compress(
+                        np.asarray(out), self.density)[0]
+                elif self.compress == "int8":
+                    packed_out = codec.q8_compress(np.asarray(out))
+                else:
+                    packed_out = np.asarray(out)
+                resp, down = self._wire({"outputs": packed_out})
+                self.stats.add_bytes(sent=up, received=down)
+                return resp["outputs"]
             out = self._call(self.server.predict,
                              self._roundtrip(np.asarray(activations)),
                              client_id)
@@ -111,6 +211,15 @@ class LocalTransport(Transport):
     def u_backward(self, feat_grads: np.ndarray, step: int,
                    client_id: int = 0) -> np.ndarray:
         with timed(self.stats):
+            if self.compress is not None:
+                req, up = self._wire({"feat_grads": self._pack_up(
+                    np.asarray(feat_grads), ("u_grads", client_id))})
+                g = self._call(self.server.u_backward, req["feat_grads"],
+                               step, client_id)
+                resp, down = self._wire({"grads": self._pack_down(
+                    g, (client_id, "/u_backward"))})
+                self.stats.add_bytes(sent=up, received=down)
+                return resp["grads"]
             g = self._call(
                 self.server.u_backward,
                 self._roundtrip(np.asarray(feat_grads)), step, client_id)

@@ -108,6 +108,129 @@ def test_concurrent_clients_are_race_free():
     assert server._last_step == {i: n_steps - 1 for i in range(n_clients)}
 
 
+def test_round_survives_client_dropout():
+    """A client whose wire dies mid-training (skip policy) must not take
+    the round down with it: the other clients' steps land, the dropped
+    client reports None, and when its wire comes back its handshake
+    resumes — strict_steps accepts the gap (monotonic, not contiguous)."""
+    from split_learning_tpu.transport.base import (
+        FaultInjector, FaultyTransport)
+    from split_learning_tpu.runtime.client import FailurePolicy
+
+    n_clients = 2
+    cfg = Config(mode="split", batch_size=BATCH, num_clients=n_clients)
+    plan = get_plan(mode="split")
+    sample = np.zeros((BATCH, 28, 28, 1), np.float32)
+    server = ServerRuntime(plan, cfg, jax.random.PRNGKey(0), sample)
+    # client 1's wire fails on rounds 2-4; client 0's never does
+    injector = FaultInjector(fail_steps={2, 3, 4})
+    runner = MultiClientSplitRunner(
+        plan, cfg, jax.random.PRNGKey(0),
+        transport_factory=lambda i: (
+            FaultyTransport(LocalTransport(server), injector) if i == 1
+            else LocalTransport(server)),
+        num_clients=n_clients)
+    runner.clients[1].failure_policy = FailurePolicy.SKIP
+
+    results = [runner.train_round(batches(n_clients, seed=r))
+               for r in range(7)]
+    for r, losses in enumerate(results):
+        assert np.isfinite(losses[0])          # healthy client never blocked
+        if r in (2, 3, 4):
+            assert losses[1] is None           # dropped, not raised
+        else:
+            assert np.isfinite(losses[1])
+    assert injector.injected == 3
+    assert runner.clients[1].dropped_batches == 3
+    # handshake resumed across the gap: both clients' last step accepted
+    assert server._last_step == {0: 6, 1: 6}
+
+
+def test_sync_bottoms_skips_uninitialized_clients():
+    """FedAvg must average only clients that have trained: a client that
+    dropped every step (state is None) contributes nothing and is left
+    untouched — averaging in a zeros/None state would skew the fleet."""
+    from split_learning_tpu.transport.base import (
+        FaultInjector, FaultyTransport)
+    from split_learning_tpu.runtime.client import FailurePolicy
+
+    n_clients = 3
+    cfg = Config(mode="split", batch_size=BATCH, num_clients=n_clients)
+    plan = get_plan(mode="split")
+    sample = np.zeros((BATCH, 28, 28, 1), np.float32)
+    server = ServerRuntime(plan, cfg, jax.random.PRNGKey(0), sample)
+    # client 2 fails every step it ever attempts
+    injector = FaultInjector(failure_rate=1.0)
+    runner = MultiClientSplitRunner(
+        plan, cfg, jax.random.PRNGKey(0),
+        transport_factory=lambda i: (
+            FaultyTransport(LocalTransport(server), injector) if i == 2
+            else LocalTransport(server)),
+        num_clients=n_clients, sync_bottoms_every=2)
+    runner.clients[2].failure_policy = FailurePolicy.SKIP
+
+    for r in range(4):
+        losses = runner.train_round(batches(n_clients, seed=r))
+        assert losses[2] is None
+    # the two live clients were averaged together...
+    a, b, c = (jax.tree_util.tree_leaves(runner.clients[i].state.params)
+               for i in (0, 1, 2))
+    for la, lb in zip(a, b):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb))
+    # ...and the dead client (initialized but never stepped) was
+    # excluded from the mean and left untouched
+    assert int(runner.clients[2].state.step) == 0
+    assert any(not np.array_equal(np.asarray(lc), np.asarray(la))
+               for la, lc in zip(a, c))
+
+
+def test_sync_bottoms_single_survivor_is_noop():
+    """With one initialized client, FedAvg has nothing to average — the
+    survivor's params must pass through bit-identical."""
+    server, runner = make(2)
+    runner.train_round(batches(2, seed=0))
+    before = jax.tree_util.tree_leaves(runner.clients[0].state.params)
+    runner.clients[1].state = None  # simulate a never-recovered dropout
+    runner.sync_bottoms()
+    after = jax.tree_util.tree_leaves(runner.clients[0].state.params)
+    for la, lb in zip(before, after):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_dropout_with_topk8_wire_keeps_ef_consistent():
+    """Dropout under the compressed wire: a skipped step must not corrupt
+    the surviving clients' error-feedback state — per-(role, client) EF
+    keys keep each client's residual independent, so client 0 converges
+    while client 1 flaps."""
+    from split_learning_tpu.transport.base import (
+        FaultInjector, FaultyTransport)
+    from split_learning_tpu.runtime.client import FailurePolicy
+
+    n_clients = 2
+    cfg = Config(mode="split", batch_size=BATCH, num_clients=n_clients)
+    plan = get_plan(mode="split")
+    sample = np.zeros((BATCH, 28, 28, 1), np.float32)
+    server = ServerRuntime(plan, cfg, jax.random.PRNGKey(0), sample)
+    injector = FaultInjector(fail_steps={1, 3, 5})
+    runner = MultiClientSplitRunner(
+        plan, cfg, jax.random.PRNGKey(0),
+        transport_factory=lambda i: (
+            FaultyTransport(
+                LocalTransport(server, compress="topk8", density=0.1),
+                injector) if i == 1
+            else LocalTransport(server, compress="topk8", density=0.1)),
+        num_clients=n_clients)
+    runner.clients[1].failure_policy = FailurePolicy.SKIP
+
+    all_losses = []
+    for r in range(10):
+        all_losses.append(runner.train_round(batches(n_clients, seed=r)))
+    c0 = [l[0] for l in all_losses]
+    assert all(np.isfinite(l) for l in c0)
+    assert np.mean(c0[-3:]) < np.mean(c0[:3])  # still learning
+    assert sum(l[1] is None for l in all_losses) == 3
+
+
 @pytest.mark.slow
 def test_multi_client_transformer_lm():
     """Config 3 with the long-context family: two LM clients share one

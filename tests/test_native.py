@@ -78,3 +78,114 @@ def test_multithreaded_consistency(built):
     q8, s8 = native.q8_quantize(a, n_threads=8)
     assert s1 == s8
     np.testing.assert_array_equal(q1, q8)
+
+
+# --------------------------------------------------------------------- #
+# non-finite guard: a single NaN/Inf poisons the absmax scale and the
+# whole tensor decodes as NaN silently — both quantize entry points must
+# refuse loudly, on the native path and the NumPy fallback alike
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("bad", [np.nan, np.inf, -np.inf])
+def test_q8_rejects_non_finite(bad):
+    a = np.ones((4, 7), np.float32)
+    a[2, 3] = bad
+    with pytest.raises(codec.CodecError) as ei:
+        codec.q8_compress(a)
+    assert "[4, 7]" in str(ei.value) and "float32" in str(ei.value)
+
+
+@pytest.mark.parametrize("bad", [np.nan, np.inf])
+def test_topk8_rejects_non_finite(bad):
+    a = np.ones((3, 5), np.float32)
+    a[0, 0] = bad
+    with pytest.raises(codec.CodecError):
+        codec.topk8_compress(a, 0.5)
+
+
+def test_non_finite_guard_covers_numpy_fallback(monkeypatch):
+    """Force the fallback (native.q8_quantize -> None) and check the
+    guard fires before it, identically to the native path."""
+    monkeypatch.setattr(native, "q8_quantize", lambda *a, **kw: None)
+    monkeypatch.setattr(native, "topk8_select", lambda *a, **kw: None)
+    a = np.full((2, 2), np.nan, np.float32)
+    with pytest.raises(codec.CodecError):
+        codec.q8_compress(a)
+    with pytest.raises(codec.CodecError):
+        codec.topk8_compress(a, 0.5)
+    # and the fallback still works on clean input
+    good = np.arange(8, dtype=np.float32).reshape(2, 4)
+    out = codec.q8_decompress(codec.q8_compress(good))
+    assert out.shape == good.shape
+
+
+# --------------------------------------------------------------------- #
+# topk8 select/scatter: the C++ kernels must reproduce the NumPy
+# reference rule exactly (all |v| > thr, then lowest-index ties to k,
+# ascending) — the two ends of a wire may run different paths
+# --------------------------------------------------------------------- #
+def test_topk8_select_matches_numpy(built):
+    rs = np.random.RandomState(4)
+    for n, k in [(100, 10), (2_163_200, 216320), (513 * 128 + 7, 1000),
+                 (50, 50), (17, 1)]:
+        a = (rs.randn(n) * 3).astype(np.float32)
+        nat = native.topk8_select(a, k)
+        assert nat is not None
+        idx_n, vals_n = nat
+        idx_p, vals_p = codec._topk8_select_numpy(a, k)
+        np.testing.assert_array_equal(idx_n, idx_p)
+        np.testing.assert_array_equal(vals_n, vals_p)
+
+
+def test_topk8_select_tie_rule(built):
+    """Heavy ties: many elements share the threshold magnitude; both
+    paths must keep the lowest-index ones."""
+    rs = np.random.RandomState(5)
+    a = rs.choice([-2.0, -1.0, 1.0, 2.0], size=10_000).astype(np.float32)
+    for k in (1, 7, 500, 9_999):
+        nat = native.topk8_select(a, k)
+        assert nat is not None
+        idx_n, vals_n = nat
+        idx_p, vals_p = codec._topk8_select_numpy(a, k)
+        np.testing.assert_array_equal(idx_n, idx_p)
+        np.testing.assert_array_equal(vals_n, vals_p)
+
+
+def test_topk8_select_thread_counts_agree(built):
+    rs = np.random.RandomState(6)
+    a = rs.randn(1_000_000).astype(np.float32)
+    i1, v1 = native.topk8_select(a, 100_000, n_threads=1)
+    i8, v8 = native.topk8_select(a, 100_000, n_threads=8)
+    np.testing.assert_array_equal(i1, i8)
+    np.testing.assert_array_equal(v1, v8)
+
+
+def test_topk8_scatter_matches_numpy(built):
+    rs = np.random.RandomState(7)
+    n, k = 500_000, 50_000
+    idx = np.sort(rs.choice(n, size=k, replace=False)).astype(np.int64)
+    q = rs.randint(-127, 128, k).astype(np.int8)
+    scale = 0.0123
+    nat = native.topk8_scatter(idx, q, scale, n)
+    assert nat is not None
+    ref = np.zeros(n, np.float32)
+    ref[idx] = q.astype(np.float32) * np.float32(scale)
+    np.testing.assert_array_equal(nat, ref)
+
+
+def test_topk8_wire_roundtrip_native_vs_fallback(built, monkeypatch):
+    """Full compress->encode->decode->decompress parity: native on, then
+    forced off — identical wire trees and identical reconstructions."""
+    rs = np.random.RandomState(8)
+    a = (rs.randn(64, 26, 26, 32) * 2).astype(np.float32)
+    packed_nat, res_nat = codec.topk8_compress(a, 0.1)
+    out_nat = codec.decompress_tree(codec.decode(codec.encode(packed_nat)))
+    monkeypatch.setattr(native, "topk8_select", lambda *x, **kw: None)
+    monkeypatch.setattr(native, "topk8_scatter", lambda *x, **kw: None)
+    monkeypatch.setattr(native, "q8_quantize", lambda *x, **kw: None)
+    packed_py, res_py = codec.topk8_compress(a, 0.1)
+    out_py = codec.decompress_tree(codec.decode(codec.encode(packed_py)))
+    assert packed_nat["scale"] == pytest.approx(packed_py["scale"],
+                                                rel=1e-6)
+    np.testing.assert_array_equal(packed_nat["q"], packed_py["q"])
+    np.testing.assert_array_equal(out_nat, out_py)
+    np.testing.assert_allclose(res_nat, res_py, rtol=0, atol=0)

@@ -1,0 +1,120 @@
+"""Device-side topk8 kernels (ops/topk.py) vs the host wire reference.
+
+Three implementations of the same selection rule must agree: the Pallas/
+lax.top_k path here, the NumPy reference in transport/codec.py, and the
+C++ kernel in native/slt_codec.cc (the latter two are parity-tested in
+test_native.py). Kernels run in Mosaic interpreter mode on the CPU test
+mesh; the same code compiles on real TPU.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from split_learning_tpu.ops.topk import (
+    magnitudes,
+    topk8_decode,
+    topk8_encode,
+    topk8_residual,
+    topk8_roundtrip,
+)
+from split_learning_tpu.transport import codec
+
+
+CUT_SHAPE = (64, 26, 26, 32)  # the real cut-layer activation (5.28 MiB)
+
+
+def _host_encode(x: np.ndarray, k: int):
+    """The wire-side reference: codec's selection + q8 scale math."""
+    flat = np.ascontiguousarray(x, np.float32).reshape(-1)
+    idx, vals = codec._topk8_select_numpy(flat, k)
+    scale = max(float(np.max(np.abs(vals))) / 127.0, 1e-12)
+    q = np.clip(np.round(vals / scale), -127, 127).astype(np.int8)
+    return idx, q, scale
+
+
+@pytest.mark.parametrize("shape", [(8, 26, 26, 32), CUT_SHAPE])
+def test_encode_matches_host_reference(rng, shape):
+    """Single-block and gridded (the full cut tensor spans many row
+    blocks): same index set, same scale, survivors within 1 LSB."""
+    x = jax.random.normal(rng, shape, jnp.float32) * 3.0
+    n = int(np.prod(shape))
+    k = max(1, int(math.ceil(0.1 * n)))
+    idx_d, q_d, s_d = topk8_encode(x, k)
+    idx_h, q_h, s_h = _host_encode(np.asarray(x), k)
+    np.testing.assert_array_equal(np.sort(np.asarray(idx_d)), idx_h)
+    assert float(s_d) == pytest.approx(s_h, rel=1e-6)
+    # same positions, so compare values position-by-position
+    order = np.argsort(np.asarray(idx_d))
+    assert int(np.max(np.abs(
+        np.asarray(q_d)[order].astype(np.int32) - q_h.astype(np.int32)))) <= 1
+
+
+def test_magnitudes_is_abs(rng):
+    x = jax.random.normal(rng, CUT_SHAPE, jnp.float32)
+    np.testing.assert_allclose(np.asarray(magnitudes(x)),
+                               np.abs(np.asarray(x)).reshape(-1),
+                               rtol=0, atol=0)
+
+
+def test_tie_break_toward_lower_indices():
+    """lax.top_k's stable tie-breaking matches the host rule: on an
+    all-equal tensor, the first k indices win."""
+    x = jnp.ones((4, 64), jnp.float32)
+    idx, q, scale = topk8_encode(x, 10)
+    np.testing.assert_array_equal(np.sort(np.asarray(idx)),
+                                  np.arange(10, dtype=np.int32))
+    idx_h, _, _ = _host_encode(np.ones((4, 64), np.float32), 10)
+    np.testing.assert_array_equal(np.sort(np.asarray(idx)), idx_h)
+
+
+def test_roundtrip_error_bound(rng):
+    """Survivors reconstruct within half a quantization step; dropped
+    elements decode as exactly zero."""
+    x = jax.random.normal(rng, (16, 26, 26, 32), jnp.float32) * 2.0
+    n = x.size
+    k = int(math.ceil(0.1 * n))
+    out = np.asarray(topk8_roundtrip(x, k))
+    xn = np.asarray(x)
+    idx, _, scale = topk8_encode(x, k)
+    mask = np.zeros(n, bool)
+    mask[np.asarray(idx)] = True
+    flat_x, flat_o = xn.reshape(-1), out.reshape(-1)
+    assert np.all(flat_o[~mask] == 0.0)
+    assert float(np.max(np.abs(flat_o[mask] - flat_x[mask]))) <= (
+        float(scale) * 0.5 + 1e-6)
+
+
+def test_residual_is_exact_complement(rng):
+    """residual + decode == x exactly at survivors (same subtraction),
+    and the residual equals x at dropped positions — nothing is lost."""
+    x = jax.random.normal(rng, (8, 26, 26, 32), jnp.float32)
+    idx, q, scale = topk8_encode(x, 2000)
+    dec = topk8_decode(idx, q, scale, x.shape, x.dtype)
+    res = topk8_residual(x, idx, q, scale)
+    np.testing.assert_allclose(np.asarray(res) + np.asarray(dec),
+                               np.asarray(x), rtol=0, atol=1e-6)
+
+
+def test_encode_under_jit(rng):
+    """Static k keeps shapes jit-stable (density is a config knob)."""
+    x = jax.random.normal(rng, (8, 26, 26, 32), jnp.float32)
+
+    @jax.jit
+    def f(t):
+        return topk8_encode(t, 512)
+
+    idx, q, scale = f(x)
+    assert idx.shape == (512,) and q.shape == (512,)
+    assert q.dtype == jnp.int8 and idx.dtype == jnp.int32
+
+
+def test_encode_rejects_bad_k(rng):
+    x = jax.random.normal(rng, (4, 4), jnp.float32)
+    with pytest.raises(ValueError):
+        topk8_encode(x, 0)
+    with pytest.raises(ValueError):
+        topk8_encode(x, 17)
