@@ -700,6 +700,106 @@ def measure_topk8(quick: bool) -> dict:
     return out
 
 
+def measure_chaos_soak(quick: bool) -> dict:
+    """Robustness soak (transport/chaos.py + the ServerRuntime replay
+    cache): train the same seeded stream twice — once on a clean wire,
+    once under a seeded fault schedule with response-drops (applied
+    server-side, reply lost), duplicated deliveries, and 5xx — with the
+    client on the bounded-retry policy. Exactly-once delivery makes the
+    chaotic run *deterministically equivalent*: a dropped response is
+    recovered from the replay cache (no re-apply), a duplicate is served
+    the cached original, a 5xx retried fresh never applied at all. Gates:
+    zero dropped batches, replay cache actually engaged, faults actually
+    injected, and final training loss within 5% of the fault-free run
+    (it should be bit-near-identical — the 5% gate is the acceptance
+    contract, not the expectation)."""
+    import jax
+    import numpy as np
+
+    from split_learning_tpu.models import get_plan
+    from split_learning_tpu.runtime import ServerRuntime, SplitClientTrainer
+    from split_learning_tpu.runtime.client import FailurePolicy
+    from split_learning_tpu.transport.chaos import ChaosPolicy, ChaosTransport
+    from split_learning_tpu.transport.local import LocalTransport
+    from split_learning_tpu.utils import Config
+
+    steps = 40 if quick else 220
+    tail = 8 if quick else 30
+    spec = "drop_resp=0.10,dup=0.05,http500=0.05"
+    seed = 1234
+    plan = get_plan(mode="split")
+    cfg = Config(mode="split", batch_size=BATCH, decay_steps=steps)
+
+    # same learnable-stream recipe as the topk8 leg: both runs see
+    # identical batches
+    centers = np.random.RandomState(7).randn(10, 28, 28, 1
+                                             ).astype(np.float32) * 2
+    rs = np.random.RandomState(8)
+    data = []
+    for _ in range(steps):
+        yb = rs.randint(0, 10, BATCH)
+        xb = (centers[yb]
+              + 0.4 * rs.randn(BATCH, 28, 28, 1)).astype(np.float32)
+        yb = np.where(rs.rand(BATCH) < 0.15, rs.randint(0, 10, BATCH), yb)
+        data.append((xb, yb.astype(np.int64)))
+
+    out = {"leg": "chaos_soak", "platform": "cpu", "steps": steps,
+           "chaos_spec": spec, "chaos_seed": seed,
+           "valid": True, "invalid_reason": None}
+    finals = {}
+    losses_by_run = {}
+    for run in ("clean", "chaos"):
+        runtime = ServerRuntime(plan, cfg, jax.random.PRNGKey(0),
+                                data[0][0])
+        transport = LocalTransport(runtime)
+        if run == "chaos":
+            policy = ChaosPolicy(spec, seed=seed)
+            transport = ChaosTransport(transport, policy)
+        client = SplitClientTrainer(plan, cfg, jax.random.PRNGKey(0),
+                                    transport,
+                                    failure_policy=FailurePolicy.RETRY,
+                                    max_retries=3, retry_backoff=0.0)
+        losses = []
+        t0 = time.perf_counter()
+        for i, (xb, yb) in enumerate(data):
+            losses.append(client.train_step(xb, yb, i))
+        dt = time.perf_counter() - t0
+        losses_by_run[run] = losses
+        finals[run] = float(np.mean([l for l in losses[-tail:]
+                                     if l is not None]))
+        out[f"final_loss_{run}"] = finals[run]
+        out[f"steps_per_sec_{run}"] = steps / dt
+        if run == "chaos":
+            out["dropped_batches"] = client.dropped_batches
+            out["chaos_injected"] = dict(policy.injected)
+            rc = runtime.replay.counters()
+            out["replay_hits"] = rc["replay_hits"]
+
+    out["loss_parity"] = (abs(finals["chaos"] - finals["clean"])
+                          / max(abs(finals["clean"]), 1e-12))
+    # step-for-step agreement: exactly-once means the fault schedule
+    # changes the wire, never the math
+    pairs = [(a, b) for a, b in zip(losses_by_run["clean"],
+                                    losses_by_run["chaos"])
+             if a is not None and b is not None]
+    out["max_step_loss_diff"] = float(max(abs(a - b) for a, b in pairs))
+    problems = []
+    if out["dropped_batches"] != 0:
+        problems.append(f"dropped_batches={out['dropped_batches']} != 0")
+    if sum(out["chaos_injected"].values()) == 0:
+        problems.append("no faults injected: the soak soaked nothing")
+    if out["replay_hits"] == 0:
+        problems.append("replay_hits=0: the cache never engaged, so "
+                        "drop_resp/dup recovery went untested")
+    if out["loss_parity"] > 0.05:
+        problems.append(f"loss_parity={out['loss_parity']:.4f} > 0.05: "
+                        "the chaotic run diverged from the clean run")
+    if problems:
+        out["valid"] = False
+        out["invalid_reason"] = "; ".join(problems)
+    return out
+
+
 def measure_pipelined(quick: bool) -> dict:
     """The PiPar-style in-flight window (runtime/pipelined_client.py) vs
     the reference's lock-step loop, both over HTTP loopback: steady-state
@@ -1413,8 +1513,8 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--role",
                     choices=["baseline", "fused", "dp", "wire", "topk8",
-                             "pipelined", "coalesced", "decode",
-                             "flash_micro"],
+                             "pipelined", "coalesced", "chaos_soak",
+                             "decode", "flash_micro"],
                     default=None)
     ap.add_argument("--quick", action="store_true")
     args = ap.parse_args()
@@ -1426,6 +1526,7 @@ def main() -> None:
               "topk8": measure_topk8,
               "pipelined": measure_pipelined,
               "coalesced": measure_coalesced,
+              "chaos_soak": measure_chaos_soak,
               "decode": measure_decode,
               "flash_micro": measure_flash_micro}[args.role]
         print(json.dumps(fn(args.quick)))
@@ -1604,6 +1705,12 @@ def main() -> None:
                                timeout=900)
         if coal is not None:
             detail["multi_client_coalesced"] = coal
+        # robustness soak: a seeded response-drop/dup/5xx schedule must
+        # lose zero batches and match the fault-free run's loss
+        soak = _run_subprocess("chaos_soak", args.quick, CPU_ENV,
+                               timeout=900)
+        if soak is not None:
+            detail["chaos_soak"] = soak
 
     detail["fused"] = fused
     if fused is None:

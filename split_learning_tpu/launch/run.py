@@ -644,6 +644,35 @@ def cmd_train(args) -> int:
             transport = LocalTransport(
                 server, compress=args.compress,
                 density=getattr(args, "compress_density", 0.1))
+        chaos_spec = getattr(args, "chaos", None)
+        if chaos_spec:
+            # seeded fault injection wraps whichever wire was built —
+            # same spec + same seed = the same faults at the same steps
+            # (transport/chaos.py); absent, the wire is untouched
+            from split_learning_tpu.transport.chaos import (
+                ChaosPolicy, ChaosTransport)
+            chaos_policy = ChaosPolicy(
+                chaos_spec, seed=getattr(args, "chaos_seed", 0) or 0)
+            transport = ChaosTransport(transport, chaos_policy)
+            if transport_factory is not None:
+                inner_factory = transport_factory
+                transport_factory = lambda: ChaosTransport(  # noqa: E731
+                    inner_factory(), chaos_policy)
+            print(f"[chaos] injecting {chaos_spec!r} "
+                  f"(seed {chaos_policy.seed}) on the client wire",
+                  file=sys.stderr)
+        fail_policy = getattr(args, "failure_policy", None) or "raise"
+        breaker = None
+        if fail_policy != "raise" and (cfg.mode != "split" or depth > 1):
+            print(f"[warn] --failure-policy {fail_policy} applies to the "
+                  "serialized split client only; ignored here",
+                  file=sys.stderr)
+            fail_policy = "raise"
+        if fail_policy == "retry":
+            # retry clients probe /health instead of hammering a dead
+            # server with full payloads (runtime/breaker.py)
+            from split_learning_tpu.runtime import CircuitBreaker
+            breaker = CircuitBreaker(transport.health)
         if cfg.mode == "split":
             if depth > 1:
                 if phase_prof is not None:
@@ -657,9 +686,11 @@ def cmd_train(args) -> int:
                     plan, cfg, rng, transport, depth=depth,
                     transport_factory=transport_factory, logger=logger)
             else:
-                client = SplitClientTrainer(plan, cfg, rng, transport,
-                                            logger=logger,
-                                            profiler=phase_prof)
+                client = SplitClientTrainer(
+                    plan, cfg, rng, transport,
+                    failure_policy=fail_policy,
+                    max_retries=getattr(args, "max_retries", 3),
+                    logger=logger, profiler=phase_prof, breaker=breaker)
             layout = "split_local" if server is not None else "client_only"
         elif cfg.mode == "u_split":
             client = USplitClientTrainer(plan, cfg, rng, transport,
@@ -981,9 +1012,18 @@ def cmd_serve(args) -> int:
         print(f"[serve] tracing on: /metrics histograms live; Chrome "
               f"trace -> {trace_path} on shutdown", file=sys.stderr)
 
+    chaos_policy = None
+    if getattr(args, "chaos", None):
+        from split_learning_tpu.transport.chaos import ChaosPolicy
+        chaos_policy = ChaosPolicy(
+            args.chaos, seed=getattr(args, "chaos_seed", 0) or 0)
+        print(f"[chaos] injecting {args.chaos!r} "
+              f"(seed {chaos_policy.seed}) server-side", file=sys.stderr)
+
     server = SplitHTTPServer(runtime, host=args.host, port=args.port,
                              compress=args.compress or "none",
-                             density=args.compress_density).start()
+                             density=args.compress_density,
+                             chaos=chaos_policy).start()
     print(f"[serve] mode={cfg.mode} listening on {server.url}")
     try:
         while True:
@@ -1290,6 +1330,27 @@ def main(argv: Optional[list] = None) -> int:
                          "cut-layer exchanges in flight (bounded-staleness "
                          "async SGD; an http server needs "
                          "--allow-out-of-order when N > 1)")
+    pt.add_argument("--chaos", default=None, metavar="SPEC",
+                    help="deterministic fault injection on the client "
+                         "wire: comma list of kind[=rate][:ms], kinds "
+                         "drop_req | drop_resp | dup | delay | corrupt | "
+                         "http500 (e.g. 'drop_resp=0.1,dup=0.05'); seeded "
+                         "by --chaos-seed, off by default (untouched "
+                         "wire) — see README 'Fault tolerance'")
+    pt.add_argument("--chaos-seed", dest="chaos_seed", type=int, default=0,
+                    help="seed for the --chaos schedule (same spec + "
+                         "seed = the same faults at the same steps)")
+    pt.add_argument("--failure-policy", dest="failure_policy",
+                    choices=["raise", "retry", "skip"], default=None,
+                    help="what a split client does when the wire fails: "
+                         "raise (default), retry (bounded, with a "
+                         "circuit breaker probing /health while the "
+                         "server is down), or skip (reference behavior: "
+                         "drop the batch, counted)")
+    pt.add_argument("--max-retries", dest="max_retries", type=int,
+                    default=3,
+                    help="retry budget per step with "
+                         "--failure-policy retry (default 3)")
     pt.add_argument("--resume", action="store_true",
                     help="restore the latest checkpoint before training")
     pt.add_argument("--checkpoint-every", type=int, default=0,
@@ -1332,6 +1393,14 @@ def main(argv: Optional[list] = None) -> int:
     ps.add_argument("--compress-density", dest="compress_density",
                     type=float, default=0.1,
                     help="topk8 only: default reply density (default 0.1)")
+    ps.add_argument("--chaos", default=None, metavar="SPEC",
+                    help="deterministic server-side fault injection on "
+                         "step requests: same grammar as train --chaos; "
+                         "http500/drop_req fire before the update is "
+                         "applied, drop_resp/corrupt after (the "
+                         "lost-response case the replay cache recovers)")
+    ps.add_argument("--chaos-seed", dest="chaos_seed", type=int, default=0,
+                    help="seed for the --chaos schedule")
     ps.add_argument("--trace", default=None, metavar="PATH",
                     help="per-step span tracing (obs/): serve live "
                          "queue-wait/dispatch histograms on GET /metrics "

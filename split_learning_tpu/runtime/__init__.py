@@ -5,12 +5,14 @@ from split_learning_tpu.runtime.client import (
     StepRecord,
     USplitClientTrainer,
 )
+from split_learning_tpu.runtime.breaker import CircuitBreaker
 from split_learning_tpu.runtime.checkpoint import Checkpointer, joint_state
 from split_learning_tpu.runtime.generate import (
     generate_remote, greedy_generate, sample_generate)
 from split_learning_tpu.runtime.evaluate import evaluate, evaluate_remote
 from split_learning_tpu.runtime.multi_client import MultiClientSplitRunner
 from split_learning_tpu.runtime.pipelined_client import PipelinedSplitClientTrainer
+from split_learning_tpu.runtime.replay import ReplayCache
 from split_learning_tpu.runtime.server import (
     FedAvgAggregator,
     ProtocolError,
@@ -27,4 +29,5 @@ __all__ = [
     "Checkpointer", "joint_state", "MultiClientSplitRunner",
     "PipelinedSplitClientTrainer", "greedy_generate", "sample_generate",
     "evaluate", "evaluate_remote", "generate_remote",
+    "CircuitBreaker", "ReplayCache",
 ]

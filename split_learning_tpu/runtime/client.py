@@ -67,17 +67,24 @@ class SplitClientTrainer:
                  retry_backoff: float = 0.5,
                  logger: Optional[Any] = None,
                  profiler: Optional[Any] = None,
-                 client_id: int = 0) -> None:
+                 client_id: int = 0,
+                 breaker: Optional[Any] = None) -> None:
         """retry_backoff: base seconds for exponential backoff between
         retries (0.5 -> 0.5, 1, 2, 4...). Without it, a restarting server
         (seconds of downtime) would exhaust every retry in microseconds —
-        elastic recovery needs the client to outwait the outage."""
+        elastic recovery needs the client to outwait the outage.
+
+        breaker: optional CircuitBreaker (runtime/breaker.py). When set,
+        it observes every transport outcome; once open, retry waits
+        become cheap /health probes with backoff+jitter instead of blind
+        sleeps followed by full-payload POSTs at a dead server."""
         self.plan = plan
         self.cfg = cfg
         self.transport = transport
         self.failure_policy = failure_policy
         self.max_retries = max_retries
         self.retry_backoff = retry_backoff
+        self.breaker = breaker
         self.logger = logger
         self.client_id = client_id
         self.profiler = profiler  # PhaseProfiler: compute-vs-transport split
@@ -152,6 +159,13 @@ class SplitClientTrainer:
         attempt = 0
         while True:
             try:
+                if self.breaker is not None:
+                    # while open this probes /health (backoff+jitter)
+                    # instead of letting the full-payload POST bounce
+                    # off a dead server; raises TransportError when the
+                    # open budget is spent, handled below like any wire
+                    # failure
+                    self.breaker.before_attempt()
                 if tid is not None:
                     obs_trace.CTX.trace_id = tid
                 t_tr0 = time.perf_counter() if tr is not None else 0.0
@@ -162,16 +176,25 @@ class SplitClientTrainer:
                 finally:
                     if tid is not None:
                         obs_trace.CTX.trace_id = None
+                if self.breaker is not None:
+                    self.breaker.record_success()
                 if tr is not None:
                     tr.record("transport", t_tr0,
                               time.perf_counter() - t_tr0, trace_id=tid,
                               tid=self.client_id, step=step)
                 break
             except TransportError:
+                if self.breaker is not None:
+                    self.breaker.record_failure()
                 attempt += 1
                 if (self.failure_policy == FailurePolicy.RETRY
                         and attempt <= self.max_retries):
-                    if self.retry_backoff > 0:
+                    # with an OPEN breaker the wait happens in
+                    # before_attempt (health probes); the blind sleep is
+                    # for transient blips below the breaker threshold
+                    if self.retry_backoff > 0 and not (
+                            self.breaker is not None
+                            and self.breaker.state == "open"):
                         time.sleep(self.retry_backoff * 2 ** (attempt - 1))
                     continue
                 if self.failure_policy == FailurePolicy.SKIP:

@@ -1,0 +1,121 @@
+"""Bounded replay cache — exactly-once step delivery within a window.
+
+The strict-step handshake (``ServerRuntime._check_step``) makes delivery
+*at-most-once*: a retried request whose original was applied gets a 409.
+That is the lost-response desync — the server absorbed the update, the
+client never got its cut-layer gradient, and the two halves drift apart.
+
+The fix is the classic RPC one: remember the reply. Each applied
+``(client_id, op, step)`` keeps its result in a bounded FIFO window; a
+duplicate delivery inside the window is served the *original* reply (not
+recomputed — the retry's payload may differ bit-wise under EF
+compression, and recomputing would double-apply the update). Below the
+window the 409 remains: a replay that stale is a protocol bug, not a
+retry.
+
+Entries can also carry the exact encoded HTTP body
+(:meth:`attach_body`), so a replayed wire reply is bit-identical to the
+original — byte-equal frames, same CRC, and the server's EF residual
+ledger is untouched by the replay.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, Optional, Tuple
+
+Key = Tuple[int, str, int]  # (client_id, op, step)
+
+
+class ReplayCache:
+    """FIFO reply cache, bounded per-(client, op) and globally.
+
+    ``window`` bounds each (client_id, op) stream: a client retrying its
+    last few steps always hits; anything older ages out. ``max_total``
+    bounds the whole cache so a burst of client ids cannot grow it
+    without limit (same discipline as the u_residual store).
+    """
+
+    def __init__(self, window: int = 8, max_total: int = 64) -> None:
+        self.window = int(window)
+        self.max_total = int(max_total)
+        self._entries: "OrderedDict[Key, list]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.body_hits = 0
+        self.evictions = 0
+
+    # ------------------------------------------------------------------ #
+    def get(self, client_id: int, op: str, step: int) -> Optional[Any]:
+        """The cached result for a duplicate delivery, or None on miss.
+        Counts the hit."""
+        key = (int(client_id), op, int(step))
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return None
+            self.hits += 1
+            return entry[0]
+
+    def contains(self, client_id: int, op: str, step: int) -> bool:
+        with self._lock:
+            return (int(client_id), op, int(step)) in self._entries
+
+    def put(self, client_id: int, op: str, step: int, result: Any) -> None:
+        key = (int(client_id), op, int(step))
+        with self._lock:
+            if key in self._entries:
+                return  # first apply wins; never overwrite a reply
+            self._entries[key] = [result, None]
+            self._evict_locked(int(client_id), op)
+
+    # ------------------------------------------------------------------ #
+    def attach_body(self, client_id: int, op: str, step: int,
+                    body: bytes) -> None:
+        """Attach the encoded wire reply to an existing entry so replays
+        are served byte-identical. No-op on a missing entry (evicted
+        between put and attach) or if a body is already attached."""
+        key = (int(client_id), op, int(step))
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None and entry[1] is None:
+                entry[1] = body
+
+    def get_body(self, client_id: int, op: str, step: int) -> Optional[bytes]:
+        """The original encoded reply bytes, or None. Counts a body hit
+        (the caller serves these raw — the bit-identical path)."""
+        key = (int(client_id), op, int(step))
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None or entry[1] is None:
+                return None
+            self.body_hits += 1
+            return entry[1]
+
+    # ------------------------------------------------------------------ #
+    def _evict_locked(self, client_id: int, op: str) -> None:
+        mine = [k for k in self._entries
+                if k[0] == client_id and k[1] == op]
+        while len(mine) > self.window:
+            victim = mine.pop(0)  # FIFO: entries insert in step order
+            del self._entries[victim]
+            self.evictions += 1
+        while len(self._entries) > self.max_total:
+            self._entries.popitem(last=False)
+            self.evictions += 1
+
+    def clear(self) -> None:
+        """Drop everything — resume_from() re-bases the step floor, and
+        replies from the pre-restore lineage must not be replayable."""
+        with self._lock:
+            self._entries.clear()
+
+    def counters(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "replay_hits": self.hits,
+                "replay_body_hits": self.body_hits,
+                "replay_evictions": self.evictions,
+                "replay_cache_size": len(self._entries),
+            }

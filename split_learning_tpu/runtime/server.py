@@ -34,6 +34,7 @@ from split_learning_tpu.obs import trace as obs_trace
 from split_learning_tpu.obs.metrics import Registry
 from split_learning_tpu.runtime.coalesce import (
     CoalesceRequest, RequestCoalescer, pow2_bucket)
+from split_learning_tpu.runtime.replay import ReplayCache
 from split_learning_tpu.runtime.state import (
     TrainState, apply_grads, make_state, make_tx)
 from split_learning_tpu.utils.config import Config
@@ -59,12 +60,19 @@ class ServerRuntime:
     def __init__(self, plan: SplitPlan, cfg: Config, rng: jax.Array,
                  sample_input: np.ndarray, strict_steps: bool = True,
                  coalesce_max: int = 1,
-                 coalesce_window_ms: float = 2.0) -> None:
+                 coalesce_window_ms: float = 2.0,
+                 replay_window: int = 8) -> None:
         """coalesce_max > 1 turns on request coalescing (classic split
         mode only): concurrent split_step calls that arrive within
         ``coalesce_window_ms`` of each other batch into one dispatch, up
         to ``coalesce_max`` per group (runtime/coalesce.py). 1 = the
-        serialized path, bit-for-bit — the coalescer is never built."""
+        serialized path, bit-for-bit — the coalescer is never built.
+
+        ``replay_window`` bounds the per-(client, op) reply cache that
+        makes step delivery exactly-once within the window: a duplicate
+        or retried request whose original was applied is served the
+        original reply instead of 409-ing (runtime/replay.py). 0
+        disables the cache and restores at-most-once semantics."""
         self.plan = plan
         self.cfg = cfg
         self.mode = cfg.mode
@@ -115,6 +123,13 @@ class ServerRuntime:
                 self._coalescer = RequestCoalescer(
                     self._dispatch_group, coalesce_max,
                     coalesce_window_ms / 1e3)
+        # exactly-once within a window: applied replies are cached and
+        # replayed verbatim to duplicate deliveries; below the window the
+        # strict-step 409 still holds (a replay that stale is a protocol
+        # bug, not a retry)
+        self.replay: Optional[ReplayCache] = (
+            ReplayCache(window=replay_window) if replay_window > 0
+            else None)
         # residuals for the U-shaped two-hop step, keyed by step
         self._u_residual: Dict[int, Any] = {}
         # reply-direction error feedback for the topk8 wire mode, keyed
@@ -204,6 +219,14 @@ class ServerRuntime:
             # mode guard ≡ HTTP 400 (ref src/server_part.py:31-36)
             raise ProtocolError(
                 f"split_step called in mode {self.mode!r}", status=400)
+        # duplicate delivery (lost response, retried request, dup'd
+        # frame): serve the reply the original apply produced — the
+        # update must not run twice, and the client must still get its
+        # cut-layer gradient instead of a 409
+        if self.replay is not None:
+            cached = self.replay.get(client_id, "split_step", step)
+            if cached is not None:
+                return cached
         # obs: tr stays None by default, and every timing site below is
         # gated on it — the untraced serialized path takes no extra
         # locks and allocates nothing (the zero-overhead-off contract)
@@ -222,10 +245,19 @@ class ServerRuntime:
         t_q0 = time.perf_counter() if tr is not None else 0.0
         with self._lock:
             t_d0 = time.perf_counter() if tr is not None else 0.0
+            if self.replay is not None:
+                # re-check under the lock: a concurrent duplicate may
+                # have applied and cached while we waited for it
+                cached = self.replay.get(client_id, "split_step", step)
+                if cached is not None:
+                    return cached
             self._check_step(step, client_id)
             self.state, g_acts, loss = self._split_step(
                 self.state, jnp.asarray(activations), jnp.asarray(labels))
             g_host, loss_f = np.asarray(g_acts), float(loss)
+            if self.replay is not None:
+                self.replay.put(client_id, "split_step", step,
+                                (g_host, loss_f))
             # max(): with strict_steps off (pipelined clients) steps can
             # arrive out of order, and the acknowledged step — what /health
             # reports and checkpoints are labeled with — must never regress
@@ -274,9 +306,27 @@ class ServerRuntime:
         t_pick = time.perf_counter() if tr is not None else 0.0
         with self._lock:
             admitted = []
+            # a retry can land in the same flush window as its original
+            # (or a cached reply may already exist): leaders compute,
+            # followers of the same (client, step) share the leader's
+            # reply, and cached steps resolve without touching the batch
+            leaders: Dict[Tuple[int, int], CoalesceRequest] = {}
+            followers: Dict[Tuple[int, int], list] = {}
             for r in group:
+                key = (r.client_id, r.step)
+                if self.replay is not None:
+                    cached = self.replay.get(r.client_id, "split_step",
+                                             r.step)
+                    if cached is not None:
+                        r.result = cached
+                        r.done.set()
+                        continue
+                if key in leaders:
+                    followers.setdefault(key, []).append(r)
+                    continue
                 try:
                     self._check_step(r.step, r.client_id)
+                    leaders[key] = r
                     admitted.append(r)
                 except ProtocolError as exc:
                     r.error = exc
@@ -314,6 +364,12 @@ class ServerRuntime:
                     g_acts.dtype, copy=False)
                 r.result = (seg, float(per_ex[off:off + b].mean()))
                 off += b
+                if self.replay is not None:
+                    self.replay.put(r.client_id, "split_step", r.step,
+                                    r.result)
+                for f in followers.get((r.client_id, r.step), ()):
+                    f.result = r.result
+                    f.done.set()
                 acked = max(self._last_step.get(r.client_id, -1), r.step)
                 self._last_step[r.client_id] = acked
                 if self.on_step is not None:
@@ -362,6 +418,12 @@ class ServerRuntime:
             raise ProtocolError(
                 f"u_forward called in mode {self.mode!r}", status=400)
         with self._lock:
+            if self.replay is not None:
+                # duplicate hop 1: return the original features and KEEP
+                # the stored residual — hop 2 may still be coming
+                cached = self.replay.get(client_id, "u_forward", step)
+                if cached is not None:
+                    return cached
             self._check_step(step, client_id)
             acts = jnp.asarray(activations)
             feats = self._u_fwd(self.state.params, acts)
@@ -376,7 +438,10 @@ class ServerRuntime:
             if overflow > 0:
                 for key in list(self._u_residual)[:overflow]:
                     del self._u_residual[key]
-            return np.asarray(feats)
+            feats_host = np.asarray(feats)
+            if self.replay is not None:
+                self.replay.put(client_id, "u_forward", step, feats_host)
+            return feats_host
 
     def u_backward(self, feat_grads: np.ndarray, step: int,
                    client_id: int = 0) -> np.ndarray:
@@ -384,12 +449,22 @@ class ServerRuntime:
             raise ProtocolError(
                 f"u_backward called in mode {self.mode!r}", status=400)
         with self._lock:
+            if self.replay is not None:
+                # duplicate hop 2: the residual was consumed by the
+                # original apply — without the cache this is the
+                # "unknown step" failure a lost response turns into
+                cached = self.replay.get(client_id, "u_backward", step)
+                if cached is not None:
+                    return cached
             acts = self._u_residual.pop((client_id, step), None)
             if acts is None:
                 raise ProtocolError(
                     f"u_backward for unknown step {step} (client {client_id})")
             self.state, g_acts = self._u_bwd(
                 self.state, acts, jnp.asarray(feat_grads))
+            g_host = np.asarray(g_acts)
+            if self.replay is not None:
+                self.replay.put(client_id, "u_backward", step, g_host)
             # max(): with strict_steps off (pipelined clients) steps can
             # arrive out of order, and the acknowledged step — what /health
             # reports and checkpoints are labeled with — must never regress
@@ -398,7 +473,7 @@ class ServerRuntime:
             self._last_step[client_id] = acked
             if self.on_step is not None:
                 self.on_step(acked)
-            return np.asarray(g_acts)
+            return g_host
 
     def aggregate(self, params: Any, epoch: int, loss: float,
                   step: int, num_examples: Optional[int] = None) -> Any:
@@ -433,6 +508,10 @@ class ServerRuntime:
             self._last_step = {}
             self._step_floor = step - 1  # applies to every client_id
             self._u_residual.clear()
+            # replies from the pre-restore lineage must not be replayable
+            # into the restored one
+            if self.replay is not None:
+                self.replay.clear()
             # error-feedback residuals describe the *pre-restore* stream;
             # feeding them into post-restore steps would inject stale mass
             self.wire_ef.reset()
@@ -490,7 +569,36 @@ class ServerRuntime:
         snap["gauges"]["acked_step"] = float(h["step"])
         for k, v in h.get("coalescing", {}).items():
             snap["counters"][f"coalesce_{k}"] = float(v)
+        if self.replay is not None:
+            rc = self.replay.counters()
+            snap["gauges"]["replay_cache_size"] = float(
+                rc.pop("replay_cache_size"))
+            for k, v in rc.items():
+                snap["counters"][f"{k}_total"] = float(v)
         return snap
+
+    # -- wire-server replay hooks (transport/http.py) -------------------- #
+    def replay_lookup(self, client_id: int, op: str,
+                      step: int) -> Tuple[Optional[bytes], Optional[Any]]:
+        """For wire servers, the cached reply to a duplicate delivery:
+        ``(body, result)`` — ``body`` is the exact encoded bytes of the
+        original reply (the bit-identical path, preferred), ``result``
+        the in-process result when the bytes were never attached. Both
+        None on a miss (or when replay is disabled)."""
+        if self.replay is None:
+            return None, None
+        body = self.replay.get_body(client_id, op, step)
+        if body is not None:
+            return body, None
+        return None, self.replay.get(client_id, op, step)
+
+    def attach_reply_body(self, client_id: int, op: str, step: int,
+                          body: bytes) -> None:
+        """Pin the encoded wire reply to the step's cache entry so a
+        replay ships the original frame byte-for-byte (same payload,
+        same CRC, EF ledger untouched)."""
+        if self.replay is not None:
+            self.replay.attach_body(client_id, op, step, body)
 
     def close(self) -> None:
         """Flush and join the coalescer (no-op on serialized servers)."""

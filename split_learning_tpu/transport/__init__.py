@@ -4,10 +4,13 @@ from split_learning_tpu.transport.base import (
     Transport,
     TransportError,
     TransportStats,
+    backoff_delays,
 )
+from split_learning_tpu.transport.chaos import ChaosPolicy, ChaosTransport
 from split_learning_tpu.transport.local import LocalTransport
 
 __all__ = [
     "Transport", "TransportError", "TransportStats",
     "FaultInjector", "FaultyTransport", "LocalTransport",
+    "ChaosPolicy", "ChaosTransport", "backoff_delays",
 ]

@@ -255,6 +255,26 @@ class FaultyTransport(Transport):
         self.inner.close()
 
 
+def backoff_delays(initial: float = 0.5, factor: float = 2.0,
+                   cap: float = 5.0, jitter: float = 0.0,
+                   rng: Optional[np.random.RandomState] = None):
+    """Exponential backoff schedule: ``initial * factor**i`` capped at
+    ``cap``, each delay stretched by up to ``jitter`` of itself (uniform,
+    from ``rng`` — seeded for testability, per-client-random in prod so
+    N clients probing a restarting server spread out instead of
+    thundering-herding the same instants). Infinite generator; callers
+    own the deadline."""
+    if rng is None:
+        rng = np.random.RandomState()
+    i = 0
+    while True:
+        d = min(initial * (factor ** i), cap)
+        if jitter > 0:
+            d *= 1.0 + jitter * float(rng.rand())
+        yield d
+        i += 1
+
+
 def timed(stats: TransportStats):
     """Context manager measuring one round trip."""
     class _Timer:

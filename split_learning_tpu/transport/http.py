@@ -31,12 +31,17 @@ import requests
 
 from split_learning_tpu.obs import trace as obs_trace
 from split_learning_tpu.transport import codec
-from split_learning_tpu.transport.base import Transport, TransportError, timed
+from split_learning_tpu.transport.base import (
+    Transport, TransportError, backoff_delays, timed)
+from split_learning_tpu.transport.chaos import _AttemptCounter, CHAOS_OPS
 
 CRC_HEADER = "X-SLT-CRC32"
 # ops that carry a per-step trace id when tracing is on (predict and
 # aggregate are outside the step span taxonomy)
 _TRACED_PATHS = ("/forward_pass", "/u_forward", "/u_backward")
+# wire path -> ServerRuntime replay-cache op (runtime/replay.py)
+_OP_BY_PATH = {"/forward_pass": "split_step", "/u_forward": "u_forward",
+               "/u_backward": "u_backward"}
 
 
 class SplitHTTPServer:
@@ -44,14 +49,22 @@ class SplitHTTPServer:
 
     def __init__(self, runtime: Any, host: str = "127.0.0.1",
                  port: int = 0, compress: str = "none",
-                 density: float = 0.1) -> None:
+                 density: float = 0.1, chaos: Optional[Any] = None) -> None:
         """compress/density: server-side *defaults* for reply packing —
         a request carrying its own ``compress``/``density`` keys always
         wins (the client picks the wire format; these let ``serve
-        --compress ...`` force one for clients that don't)."""
+        --compress ...`` force one for clients that don't).
+
+        chaos: optional ChaosPolicy (transport/chaos.py) injecting
+        server-side faults on the seeded schedule: http500 / drop_req
+        before the runtime applies anything, drop_resp (reply discarded
+        after apply — the lost-response case) / corrupt (bad reply CRC)
+        after, delay before. None = the untouched wire."""
         if compress not in ("none", "int8", "topk8"):
             raise ValueError(f"unknown compression {compress!r}")
         self.runtime = runtime
+        self.chaos = chaos
+        self._chaos_attempts = _AttemptCounter()
         self.default_compress = compress
         self.default_density = float(density)
         # reply-direction error feedback: prefer the runtime's buffer
@@ -67,14 +80,34 @@ class SplitHTTPServer:
                 pass
 
             def _reply(self, status: int, body: bytes,
-                       ctype: str = "application/octet-stream") -> None:
+                       ctype: str = "application/octet-stream",
+                       crc: Optional[int] = None) -> None:
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 # frame integrity the reference's raw pickle bodies lack
-                self.send_header(CRC_HEADER, str(codec.checksum(body)))
+                # (crc override: the chaos 'corrupt' fault ships a frame
+                # the client's checksum gate must refuse)
+                self.send_header(CRC_HEADER,
+                                 str(crc if crc is not None
+                                     else codec.checksum(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _send_200(self, body: bytes, fault) -> None:
+                """Final send, honoring a post-apply chaos fault: the
+                runtime already absorbed the update — only the reply is
+                sabotaged (dropped mid-flight or CRC-corrupted)."""
+                if fault is not None and fault[0] == "drop_resp":
+                    # no status line at all: the client sees the
+                    # connection die and maps it to TransportError
+                    self.close_connection = True
+                    return
+                if fault is not None and fault[0] == "corrupt":
+                    self._reply(200, body,
+                                crc=codec.checksum(body) ^ 0x5A5A5A5A)
+                    return
+                self._reply(200, body)
 
             def do_GET(self):
                 if self.path == "/health":
@@ -112,6 +145,35 @@ class SplitHTTPServer:
                     tree = codec.decode(raw)
                     req = codec.decompress_tree(tree)
                     cid = int(req.get("client_id", 0))
+                    # server-side chaos: one seeded draw per delivery
+                    # attempt of a step op. Pre-apply kinds act here;
+                    # drop_resp/corrupt ride to _send_200 so they fire
+                    # AFTER the runtime has applied the update.
+                    fault = None
+                    if (outer.chaos is not None and self.path in CHAOS_OPS
+                            and "step" in req):
+                        attempt = outer._chaos_attempts.next(
+                            (cid, self.path, int(req["step"])))
+                        fault = outer.chaos.draw(self.path,
+                                                 int(req["step"]), attempt)
+                    if fault is not None:
+                        outer.chaos.count(fault[0])
+                        kind, arg = fault
+                        if kind == "delay":
+                            time.sleep(arg / 1e3)
+                            fault = None
+                        elif kind == "http500":
+                            self._reply(500, codec.encode(
+                                {"error": "chaos: injected http500"}))
+                            return
+                        elif kind == "drop_req":
+                            # request "lost" before the server saw it
+                            self.close_connection = True
+                            return
+                        elif kind == "dup":
+                            # duplication is a client/network act; the
+                            # server can't re-deliver its own reply
+                            fault = None
                     tid = req.get("trace_id")
                     if tid is not None:
                         # adopt the client's trace id on this handler
@@ -147,6 +209,43 @@ class SplitHTTPServer:
                         pack = codec.q8_compress
                     else:
                         pack = (lambda a: a)
+                    # exactly-once: a redelivered step is served the
+                    # reply its original apply produced, never
+                    # re-dispatched into the runtime
+                    op = _OP_BY_PATH.get(self.path)
+                    if (op is not None and "step" in req
+                            and hasattr(outer.runtime, "replay_lookup")):
+                        step_i = int(req["step"])
+                        cached_body, cached = outer.runtime.replay_lookup(
+                            cid, op, step_i)
+                        if cached_body is not None:
+                            # the original frame, byte-for-byte: same
+                            # payload, same CRC, EF ledger untouched
+                            self._send_200(cached_body, fault)
+                            return
+                        if cached is not None:
+                            # result cached by an in-process first
+                            # delivery (no wire bytes to replay):
+                            # rebuild the reply, packing topk8
+                            # statelessly — running the EF compressor
+                            # again for a step it already packed would
+                            # corrupt the residual ledger
+                            if mode == "topk8":
+                                pack = (lambda a: codec.topk8_compress(
+                                    np.asarray(a), density)[0])
+                            if op == "split_step":
+                                resp = {"grads": pack(cached[0]),
+                                        "loss": cached[1],
+                                        "step": req["step"]}
+                            elif op == "u_forward":
+                                resp = {"features": pack(cached)}
+                            else:
+                                resp = {"grads": pack(cached)}
+                            body = codec.encode(resp)
+                            outer.runtime.attach_reply_body(
+                                cid, op, step_i, body)
+                            self._send_200(body, fault)
+                            return
                     if self.path == "/forward_pass":
                         grads, loss = outer.runtime.split_step(
                             req["activations"], req["labels"],
@@ -184,7 +283,15 @@ class SplitHTTPServer:
                             outer.runtime, "note_wire_compression"):
                         outer.runtime.note_wire_compression(
                             in_raw + out_raw, in_wire + out_wire)
-                    self._reply(200, codec.encode(resp))
+                    body = codec.encode(resp)
+                    if (op is not None and "step" in req and hasattr(
+                            outer.runtime, "attach_reply_body")):
+                        # pin the exact frame to the replay entry BEFORE
+                        # sending: even a reply lost in flight leaves
+                        # the retry a bit-identical copy to collect
+                        outer.runtime.attach_reply_body(
+                            cid, op, int(req["step"]), body)
+                    self._send_200(body, fault)
                 except ProtocolError as exc:
                     self._reply(exc.status, codec.encode({"error": str(exc)}))
                 except Exception as exc:  # noqa: BLE001 — server must not die
@@ -255,9 +362,18 @@ class HttpTransport(Transport):
         return np.asarray(arr)
 
     def _rollback(self, key: str) -> None:
-        """A failed POST means the packed tensor never reached the server:
-        undo the error-feedback update so the shipped mass isn't marked
-        delivered (the retry/skip policies re-pack from scratch)."""
+        """A failed POST means this client never got its reply: undo the
+        error-feedback update so the shipped mass isn't marked delivered
+        (the retry/skip policies re-pack from scratch).
+
+        Consistent with replayed delivery by determinism: TopK8EF
+        rollback restores the exact pre-compress residual, so re-packing
+        the SAME tensor reproduces the original payload and the original
+        post-compress residual bit-for-bit. Whether the server applied
+        the first delivery (lost response -> retry served from its
+        replay cache) or never saw it (lost request -> retry dispatched
+        fresh), the client's EF ledger ends in the same state it would
+        have reached on a clean wire."""
         if self.compress == "topk8":
             self._ef.rollback(key)
 
@@ -399,21 +515,31 @@ class HttpTransport(Transport):
                 f"GET /health -> {resp.status_code}: {resp.content[:200]!r}")
         return codec.decode(resp.content)
 
-    def wait_ready(self, timeout: float = 60.0,
-                   interval: float = 0.5) -> Dict[str, Any]:
+    def wait_ready(self, timeout: float = 60.0, interval: float = 0.5,
+                   max_interval: float = 5.0, jitter: float = 0.5,
+                   seed: Optional[int] = None) -> Dict[str, Any]:
         """Block until the server answers /health — the explicit readiness
         barrier the reference lacks (it silently drops every batch sent
         before the server is up, ``src/client_part.py:127-129``;
-        SURVEY.md §3.4 "the client does not wait for the server")."""
+        SURVEY.md §3.4 "the client does not wait for the server").
+
+        Polls on exponential backoff (``interval``, x2 per miss, capped
+        at ``max_interval``) with up to ``jitter`` of multiplicative
+        jitter, so N clients waiting out one restarting server desync
+        their probes instead of thundering-herding the same instants.
+        ``seed`` pins the jitter stream (tests)."""
         import time as _time
         deadline = _time.monotonic() + timeout
-        while True:
+        rng = np.random.RandomState(seed) if seed is not None else None
+        for delay in backoff_delays(interval, cap=max_interval,
+                                    jitter=jitter, rng=rng):
             try:
                 return self.health()
             except TransportError:
-                if _time.monotonic() >= deadline:
+                now = _time.monotonic()
+                if now >= deadline:
                     raise
-                _time.sleep(interval)
+                _time.sleep(min(delay, deadline - now))
 
     def close(self) -> None:
         self._session.close()

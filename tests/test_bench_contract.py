@@ -95,6 +95,31 @@ def test_bench_topk8_role_quick():
     assert "synthetic-wire" in tk["platform"]
 
 
+@pytest.mark.slow
+def test_bench_chaos_soak_role_quick():
+    """The chaos_soak leg's contract fields (robustness PR): trains the
+    same seeded stream clean and under a seeded drop_resp/dup/http500
+    schedule, and must report zero dropped batches, engaged replay
+    cache, injected faults, and (exactly-once being deterministic) a
+    loss parity that binds even in quick mode."""
+    sys.path.insert(0, REPO)
+    from bench import measure_chaos_soak
+
+    soak = measure_chaos_soak(quick=True)
+    assert soak["leg"] == "chaos_soak"
+    assert soak["platform"] == "cpu"
+    assert soak["chaos_spec"] and soak["chaos_seed"] is not None
+    assert soak["dropped_batches"] == 0
+    assert sum(soak["chaos_injected"].values()) > 0
+    assert soak["replay_hits"] > 0
+    for run in ("clean", "chaos"):
+        assert soak[f"final_loss_{run}"] > 0
+        assert soak[f"steps_per_sec_{run}"] > 0
+    assert soak["loss_parity"] <= 0.05
+    assert soak["max_step_loss_diff"] >= 0.0
+    assert soak["valid"] is True, soak["invalid_reason"]
+
+
 def test_degraded_headline_is_self_describing(monkeypatch, capsys):
     """VERDICT r3 weak #1: when the intended TPU backend is unavailable
     the parsed headline must never be a bare CPU number — it replays the

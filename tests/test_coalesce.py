@@ -243,7 +243,12 @@ def test_concurrent_clients_form_groups_and_health_reports_counters():
     server.close()
 
 
-def test_replay_409s_its_own_client_without_poisoning_the_group():
+def test_replayed_step_served_from_cache_without_poisoning_the_group():
+    """A duplicate delivery of an applied step is resolved from the
+    replay cache (exactly-once: the ORIGINAL step-0 loss comes back even
+    though the retry carries different batch data — the server's answer
+    to a step is whatever its first apply produced), never enters the
+    batch, and its groupmate's fresh step still goes through."""
     cfg, plan, server = make_server(coalesce_max=2, window_ms=500.0,
                                     n_clients=2, strict=True)
     clients = [
@@ -251,17 +256,14 @@ def test_replay_409s_its_own_client_without_poisoning_the_group():
                            LocalTransport(server), client_id=i)
         for i in range(2)
     ]
-    clients[0].train_step(*batch(0), step=0)  # window flush of one
+    orig = clients[0].train_step(*batch(0), step=0)  # window flush of one
 
     barrier = threading.Barrier(2)
     out = {}
 
     def replay():
         barrier.wait(timeout=30)
-        try:
-            clients[0].train_step(*batch(1), step=0)  # replayed step
-        except ProtocolError as exc:
-            out["replay"] = exc
+        out["replay"] = clients[0].train_step(*batch(1), step=0)
 
     def fresh():
         barrier.wait(timeout=30)
@@ -273,11 +275,24 @@ def test_replay_409s_its_own_client_without_poisoning_the_group():
         t.start()
     for t in threads:
         t.join(timeout=60)
-    # the replay was rejected at dispatch-admission; its groupmate's
-    # step still went through
-    assert isinstance(out.get("replay"), ProtocolError)
+    assert out.get("replay") == orig  # cached first-apply reply, verbatim
+    assert server.replay.hits >= 1
     assert np.isfinite(out.get("fresh"))
     assert server._last_step == {0: 0, 1: 0}
+    server.close()
+
+
+def test_stale_step_below_replay_window_still_409s_in_group():
+    """Genuinely stale replays — steps the cache has evicted (or never
+    saw) — keep the strict-step 409 at dispatch-admission."""
+    cfg, plan, server = make_server(coalesce_max=2, window_ms=2.0,
+                                    n_clients=1, strict=True)
+    client = SplitClientTrainer(plan, cfg, jax.random.PRNGKey(0),
+                                LocalTransport(server))
+    for s in range(1, server.replay.window + 3):
+        client.train_step(*batch(s), step=s)
+    with pytest.raises(ProtocolError):
+        client.train_step(*batch(0), step=0)  # never applied, below window
     server.close()
 
 

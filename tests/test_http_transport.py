@@ -72,13 +72,21 @@ def test_http_error_status_mapping(http_pair):
     cfg, plan, runtime, server, transport = http_pair
     acts = np.zeros((2, 26, 26, 32), np.float32)
     labels = np.zeros((2,), np.int64)
-    transport.split_step(acts, labels, step=10)
-    # 409 replay -> ProtocolError (permanent)
+    g0, l0 = transport.split_step(acts, labels, step=10)
+    # duplicate of an applied step -> the cached original reply,
+    # bit-identical (exactly-once within the replay window)
+    g1, l1 = transport.split_step(acts, labels, step=10)
+    np.testing.assert_array_equal(g0, g1)
+    assert l0 == l1
+    assert runtime.replay.body_hits >= 1  # served raw original bytes
+    # below the window the 409 remains: evict step 10, then replay it
+    for s in range(11, 11 + runtime.replay.window + 1):
+        transport.split_step(acts, labels, step=s)
     with pytest.raises(ProtocolError):
         transport.split_step(acts, labels, step=10)
     # 400 mode guard -> ProtocolError
     with pytest.raises(ProtocolError):
-        transport.aggregate({"w": np.zeros(2, np.float32)}, 0, 0.0, 11)
+        transport.aggregate({"w": np.zeros(2, np.float32)}, 0, 0.0, 99)
     # connection refused -> TransportError (transient)
     dead = HttpTransport("http://127.0.0.1:9")
     with pytest.raises(TransportError):
@@ -128,3 +136,28 @@ def test_wait_ready_times_out_cleanly():
     with pytest.raises(TransportError):
         dead.wait_ready(timeout=0.5, interval=0.1)
     dead.close()
+
+
+def test_wait_ready_polls_on_exponential_backoff(monkeypatch):
+    """Satellite: the readiness poll doubles from ``interval`` up to
+    ``max_interval`` (then clamps to the deadline) instead of the old
+    fixed 0.5 s — N restarting-server waiters back off instead of
+    thundering-herding. Virtual clock: sleep lengths ARE the schedule."""
+    import time
+
+    slept = []
+    clock = {"t": 0.0}
+    monkeypatch.setattr(time, "monotonic", lambda: clock["t"])
+
+    def fake_sleep(s):
+        slept.append(s)
+        clock["t"] += s
+
+    monkeypatch.setattr(time, "sleep", fake_sleep)
+    dead = HttpTransport("http://127.0.0.1:9")
+    with pytest.raises(TransportError):
+        dead.wait_ready(timeout=10.0, interval=0.1, max_interval=5.0,
+                        jitter=0.0)
+    dead.close()
+    # 0.1 doubling to the 5.0 cap, final wait clamped to the deadline
+    assert slept == pytest.approx([0.1, 0.2, 0.4, 0.8, 1.6, 3.2, 3.7])

@@ -49,13 +49,23 @@ def test_interleaved_clients_with_per_client_handshake():
     assert np.mean(all_losses[-1]) < np.mean(all_losses[0]) * 0.7
 
 
-def test_same_client_replay_still_rejected():
+def test_same_client_replay_served_from_cache_then_rejected():
+    """Exactly-once within the replay window: a duplicate of an applied
+    step is answered with the cached original (no re-apply, no 409);
+    once evicted past the window, the strict-step 409 still holds."""
     server, runner = make(1)
-    runner.train_round(batches(1, seed=0))
+    orig = runner.train_round(batches(1, seed=0))[0]
     client = runner.clients[0]
     x, y = batches(1, seed=1)[0]
+    # duplicate of step 0: cached reply, server step unmoved
+    assert client.train_step(x, y, step=0) == orig
+    assert server.health()["step"] == 0
+    assert server.replay.hits >= 1
+    # evict step 0 out of the window, then the replay is a protocol error
+    for r in range(1, server.replay.window + 2):
+        runner.train_round(batches(1, seed=r))
     with pytest.raises(ProtocolError):
-        client.train_step(x, y, step=0)  # replay of client 0's step 0
+        client.train_step(x, y, step=0)
 
 
 def test_bottom_sync_fedavg():

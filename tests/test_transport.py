@@ -39,19 +39,34 @@ def test_split_step_contract(rng):
     t.split_step(acts, labels, step=1)
 
 
-def test_step_handshake_rejects_replay():
-    """The reference silently desyncs after a client restart (SURVEY.md §5);
-    we refuse non-monotonic steps. ProtocolError is permanent — it must NOT
-    be masked as a transient TransportError (skip/retry would hide it)."""
+def test_step_handshake_replay_and_stale():
+    """A duplicate of an APPLIED step is served the cached original reply
+    (exactly-once within the replay window — the retried request must not
+    re-run the update or 409). A step the server never computed is still
+    refused: ProtocolError is permanent — it must NOT be masked as a
+    transient TransportError (skip/retry would hide it)."""
     server = make_server()
     t = LocalTransport(server)
     acts = np.zeros((4, 26, 26, 32), np.float32)
     labels = np.zeros((4,), np.int64)
-    t.split_step(acts, labels, step=5)
+    g0, loss0 = t.split_step(acts, labels, step=5)
+    params_after = np.asarray(
+        jax.tree_util.tree_leaves(server.state.params)[0]).copy()
+    g1, loss1 = t.split_step(acts, labels, step=5)  # duplicate delivery
+    np.testing.assert_array_equal(g0, g1)
+    assert loss0 == loss1
+    # the duplicate did NOT re-apply the update
+    np.testing.assert_array_equal(
+        params_after,
+        np.asarray(jax.tree_util.tree_leaves(server.state.params)[0]))
+    assert server.replay.hits == 1
     with pytest.raises(ProtocolError):
-        t.split_step(acts, labels, step=5)  # replay
+        t.split_step(acts, labels, step=3)  # never computed: stale rollback
+    # below the cache window the 409 still holds: push step 5 out, replay it
+    for s in range(6, 6 + server.replay.window + 1):
+        t.split_step(acts, labels, step=s)
     with pytest.raises(ProtocolError):
-        t.split_step(acts, labels, step=3)  # rollback
+        t.split_step(acts, labels, step=5)  # evicted — genuinely stale
 
 
 def test_mode_guards():
@@ -223,6 +238,61 @@ def test_multiclient_fedavg_through_server_runtime():
         assert not th.is_alive(), "FedAvg round deadlocked"
     np.testing.assert_allclose(np.asarray(results["a"]["w"]), [3.0] * 3)
     np.testing.assert_allclose(np.asarray(results["b"]["w"]), [3.0] * 3)
+
+
+def test_fedavg_timeout_then_retry_never_double_counts():
+    """Satellite: a client that times out waiting for its round withdraws
+    its submission (identity token, runtime/server.py), so its retry is
+    ONE submission — not two. If the withdrawal failed, the retry would
+    complete the round alone with the stale duplicate and skew the mean."""
+    import threading
+    from split_learning_tpu.runtime import FedAvgAggregator
+
+    agg = FedAvgAggregator(2)
+    with pytest.raises(TimeoutError):
+        agg.submit({"w": np.full((2,), 1.0, np.float32)}, timeout=0.05)
+    assert agg._pending == []  # the timed-out submission was withdrawn
+    results = {}
+
+    def client(name, value):
+        results[name] = agg.submit({"w": np.full((2,), value, np.float32)})
+
+    t1 = threading.Thread(target=client, args=("retry", 1.0))
+    t2 = threading.Thread(target=client, args=("other", 5.0))
+    t1.start(); t2.start(); t1.join(timeout=30); t2.join(timeout=30)
+    assert not t1.is_alive() and not t2.is_alive()
+    # mean of exactly {1, 5} — a leaked duplicate would have completed a
+    # round of {1, 1} or shifted this one
+    np.testing.assert_allclose(np.asarray(results["retry"]["w"]), [3.0, 3.0])
+    np.testing.assert_allclose(np.asarray(results["other"]["w"]), [3.0, 3.0])
+
+
+def test_fedavg_timeout_then_retry_weighted_round():
+    """Same withdrawal contract under example-count weighting: the
+    timed-out weighted submission must not linger, or the retry round's
+    weighted mean would count the stale weight twice."""
+    import threading
+    from split_learning_tpu.runtime import FedAvgAggregator
+
+    agg = FedAvgAggregator(2)
+    with pytest.raises(TimeoutError):
+        agg.submit({"w": np.full((2,), 100.0, np.float32)}, timeout=0.05,
+                   weight=1000.0)
+    assert agg._pending == []
+    results = {}
+
+    def client(name, value, weight):
+        results[name] = agg.submit(
+            {"w": np.full((2,), value, np.float32)}, weight=weight)
+
+    t1 = threading.Thread(target=client, args=("retry", 2.0, 1.0))
+    t2 = threading.Thread(target=client, args=("other", 6.0, 3.0))
+    t1.start(); t2.start(); t1.join(timeout=30); t2.join(timeout=30)
+    assert not t1.is_alive() and not t2.is_alive()
+    # weighted mean (2*1 + 6*3) / 4 = 5.0; any trace of the withdrawn
+    # (100.0, weight 1000) submission would dominate the round
+    np.testing.assert_allclose(np.asarray(results["retry"]["w"]), [5.0, 5.0])
+    np.testing.assert_allclose(np.asarray(results["other"]["w"]), [5.0, 5.0])
 
 
 def test_u_residual_eviction():
