@@ -927,6 +927,50 @@ def measure_pipelined(quick: bool) -> dict:
         f"steps_per_sec_depth{depth}": depth_w,
         "pipelining_speedup": depth_w / sync,
     }
+
+    # --- async-dispatch overlap scenario (PR 5) -----------------------
+    # The depth-W window keeps W steps in flight, so an off-lock D2H on
+    # the server genuinely overlaps the NEXT lane's dispatch — the
+    # pipelined client is the cleanest consumer of async dispatch.
+    # d2h_delay_s is the same honestly-synthetic sleep as the wire
+    # above; no wire delay here, the transfer is the thing measured.
+    d2h = 0.02
+
+    def run_depth_overlap(overlap: bool, n_steps: int) -> float:
+        runtime = ServerRuntime(plan, cfg, jax.random.PRNGKey(0), x[0],
+                                strict_steps=False, overlap=overlap,
+                                d2h_delay_s=d2h)
+        server = SplitHTTPServer(runtime).start()
+        lane0 = HttpTransport(server.url)
+        piped = PipelinedSplitClientTrainer(
+            plan, cfg, jax.random.PRNGKey(0), lane0, depth=depth,
+            transport_factory=lambda: HttpTransport(server.url))
+        try:
+            piped.train(lambda: iter(batches[:2]), epochs=1)  # warm lanes
+            t0 = time.perf_counter()
+            piped.train(lambda: iter(batches[2:n_steps + 2]), epochs=1,
+                        start_step=2)
+            return n_steps / (time.perf_counter() - t0)
+        finally:
+            piped.close()
+            lane0.close()
+            server.stop()
+
+    ov_steps = 6 if quick else 16
+    ov_on = run_depth_overlap(True, ov_steps)
+    ov_off = run_depth_overlap(False, ov_steps)
+    out["overlap"] = {
+        "d2h_delay_ms": d2h * 1e3, "steps": ov_steps,
+        "note": ("synthetic d2h: sleeps model the host transfer CPU JAX "
+                 "lacks; with overlap off it serializes the lanes behind "
+                 "the server lock, with overlap on (async dispatch, the "
+                 "default) it runs off-lock while the next lane "
+                 "dispatches. The hard gate lives in the "
+                 "multi_client_coalesced leg"),
+        "steps_per_sec_overlap_on": ov_on,
+        "steps_per_sec_overlap_off": ov_off,
+        "overlap_speedup": ov_on / ov_off,
+    }
     return out
 
 
@@ -989,10 +1033,12 @@ def measure_coalesced(quick: bool) -> dict:
         def close(self):
             self.inner.close()
 
-    def run(coalesce_max: int, concurrent: bool, wire_delay: float):
+    def run(coalesce_max: int, concurrent: bool, wire_delay: float,
+            overlap: bool = True, d2h_delay: float = 0.0):
         server = ServerRuntime(
             plan, cfg, jax.random.PRNGKey(0), x[0, 0],
             coalesce_max=coalesce_max,
+            overlap=overlap, d2h_delay_s=d2h_delay,
             # generous window: the group should close full when the
             # clients really are concurrent, not on the timer
             coalesce_window_ms=max(2 * wire_delay * 1e3, 5.0))
@@ -1023,6 +1069,21 @@ def measure_coalesced(quick: bool) -> dict:
     raw_serialized, _ = run(1, False, 0.0)
     raw_coalesced, _ = run(n_clients, True, 0.0)
 
+    # --- async-dispatch overlap pair (PR 5) ---------------------------
+    # N concurrent clients against a NON-coalescing server (every step
+    # its own lock acquisition — the regime where lock-hold time is the
+    # bottleneck). d2h_delay_s models the host transfer CPU JAX lacks
+    # (the same honestly-synthetic sleep idiom as the wire): with
+    # overlap off the transfer serializes every peer behind the lock,
+    # with overlap on it runs on the waiter's thread while the next
+    # client's step dispatches.
+    d2h_delay = 0.03
+    sps_overlap_on, _ = run(1, True, delay, overlap=True,
+                            d2h_delay=d2h_delay)
+    sps_overlap_off, _ = run(1, True, delay, overlap=False,
+                             d2h_delay=d2h_delay)
+    overlap_speedup = sps_overlap_on / sps_overlap_off
+
     # parity guard (exact math, no sleeps): a single client against a
     # coalescing server makes every group a window flush of one, which
     # must reproduce the serialized loss series within f32 tolerance
@@ -1046,6 +1107,61 @@ def measure_coalesced(quick: bool) -> dict:
     diff = float(np.max(np.abs(
         np.asarray(loss_series(1)) - np.asarray(loss_series(n_clients)))))
     parity_tol = 1e-4
+
+    # overlap parity: moving the D2H off the lock cannot change numerics
+    # (same jitted program, same application order), so the gate is
+    # BIT-identity, not a tolerance — measured on a deterministic
+    # single-client sequential run (under concurrency the application
+    # order is a thread race in both modes, so only the sequential pair
+    # can demand bit-identity)
+    def overlap_loss_series(overlap: bool):
+        server = ServerRuntime(plan, pcfg, jax.random.PRNGKey(0), px[0],
+                               overlap=overlap)
+        client = SplitClientTrainer(plan, pcfg, jax.random.PRNGKey(1),
+                                    LocalTransport(server))
+        try:
+            return [client.train_step(px[i], py[i], i)
+                    for i in range(parity_steps)]
+        finally:
+            server.close()
+
+    overlap_loss_diff = float(np.max(np.abs(
+        np.asarray(overlap_loss_series(True))
+        - np.asarray(overlap_loss_series(False)))))
+
+    # lock-hold accounting: with overlap on, the p50 of the lock-held
+    # window (slt_lock_hold_seconds) must sit BELOW the p50 of the
+    # overlap-off dispatch span (old taxonomy: dispatch reabsorbs the
+    # materialization) — the direct measurement that the D2H left the
+    # lock. Histograms populate only while tracing, so this runs as a
+    # short traced pair outside every timed window.
+    from split_learning_tpu import obs
+    from split_learning_tpu.obs.metrics import histogram_percentile
+
+    def traced_metrics(overlap: bool):
+        obs.enable()
+        try:
+            server = ServerRuntime(plan, cfg, jax.random.PRNGKey(0),
+                                   x[0, 0], overlap=overlap,
+                                   d2h_delay_s=d2h_delay)
+            runner = MultiClientSplitRunner(
+                plan, cfg, jax.random.PRNGKey(1),
+                lambda i: LocalTransport(server),
+                num_clients=n_clients, concurrent=True)
+            try:
+                for r in range(2):
+                    runner.train_round(list(zip(x[r], y[r])))
+                return server.metrics()
+            finally:
+                runner.close()
+                server.close()
+        finally:
+            obs.disable()
+
+    hists_on = traced_metrics(True)["histograms"]
+    hists_off = traced_metrics(False)["histograms"]
+    lock_hold_p50 = histogram_percentile(hists_on.get("lock_hold", {}), 50)
+    dispatch_off_p50 = histogram_percentile(hists_off.get("dispatch", {}), 50)
 
     def _traced_coalesced():
         server = ServerRuntime(plan, cfg, jax.random.PRNGKey(0), x[0, 0],
@@ -1081,6 +1197,24 @@ def measure_coalesced(quick: bool) -> dict:
             f"mean group occupancy {occupancy:.2f} < 2: the concurrent "
             "clients never actually coalesced, so the speedup column "
             "measures nothing")
+    elif overlap_speedup < 1.3:
+        invalid_reason = (
+            f"overlap speedup {overlap_speedup:.2f} < 1.3 at "
+            f"{n_clients} concurrent clients: taking the D2H off the "
+            "lock bought nothing, the async-dispatch leg is broken")
+    elif overlap_loss_diff != 0.0:
+        invalid_reason = (
+            f"overlap on-vs-off loss series differ by {overlap_loss_diff} "
+            "(must be bit-identical: the D2H's placement cannot change "
+            "numerics)")
+    elif int(hists_on.get("lock_hold", {}).get("count", 0)) == 0:
+        invalid_reason = ("traced overlap-on run recorded no lock_hold "
+                          "samples: slt_lock_hold_seconds never populated")
+    elif not lock_hold_p50 < dispatch_off_p50:
+        invalid_reason = (
+            f"lock_hold p50 {lock_hold_p50 * 1e3:.2f} ms is not below "
+            f"the no-overlap dispatch p50 {dispatch_off_p50 * 1e3:.2f} ms: "
+            "the lock is still covering the materialization")
     return {
         "leg": "multi_client_coalesced",
         "clients": n_clients,
@@ -1106,6 +1240,23 @@ def measure_coalesced(quick: bool) -> dict:
                      "the serving win the coalescer exists for"),
             "steps_per_sec_serialized": raw_serialized,
             "steps_per_sec_coalesced": raw_coalesced,
+        },
+        "overlap": {
+            "note": ("async dispatch (PR 5): N concurrent clients, "
+                     "non-coalescing server, synthetic d2h_delay_s "
+                     "modeling the host transfer CPU JAX lacks; overlap "
+                     "off serializes every client's transfer behind the "
+                     "lock, overlap on runs it off-lock on the waiter's "
+                     "thread. Loss parity is measured bit-identical on "
+                     "a deterministic sequential pair; p50s come from a "
+                     "short traced pair outside the timed windows"),
+            "d2h_delay_ms": d2h_delay * 1e3,
+            "steps_per_sec_overlap_on": sps_overlap_on,
+            "steps_per_sec_overlap_off": sps_overlap_off,
+            "overlap_speedup": overlap_speedup,
+            "loss_max_abs_diff_on_vs_off": overlap_loss_diff,
+            "lock_hold_p50_ms": lock_hold_p50 * 1e3,
+            "dispatch_p50_ms_no_overlap": dispatch_off_p50 * 1e3,
         },
         "loss_max_abs_diff_vs_serialized": diff,
         "parity_tol": parity_tol,

@@ -23,10 +23,14 @@ import dataclasses
 import gzip
 import hashlib
 import os
+import queue
 import struct
 import sys
 import tarfile
-from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+import threading
+import time
+from typing import (Any, Dict, Iterable, Iterator, List, Optional,
+                    Sequence, Tuple)
 
 import numpy as np
 
@@ -550,3 +554,104 @@ def batches(split: Split, batch_size: int, seed: int = 0, *,
 
 def epoch_steps(n: int, batch_size: int, drop_remainder: bool = False) -> int:
     return n // batch_size if drop_remainder else -(-n // batch_size)
+
+
+# --------------------------------------------------------------------- #
+# device prefetch — overlap batch k+1's H2D with step k's round trip
+
+_DONE = object()  # end-of-stream marker on the prefetch queue
+
+
+class DevicePrefetch:
+    """Stage batch k+1 onto the device while step k is in flight.
+
+    Wraps any ``(x, y)`` batch iterator: a background thread pulls ahead
+    (up to ``depth`` batches), issues ``jax.device_put(x)`` — an *async*
+    H2D transfer, so staging overlaps the consumer's round trip — and
+    hands ``(x_device, y)`` through a bounded queue. Labels pass through
+    untouched: they travel host-side over the wire (``np.asarray(y)``
+    in the trainers), and staging them would only buy a wasted D2H.
+
+    The wrapper yields the exact batch sequence of the plain iterator
+    (``device_put`` is value-preserving; order is the queue's FIFO), and
+    :meth:`close` — also the context-manager exit — drains it cleanly on
+    early loop exit: the staging thread parks only on bounded waits and
+    is joined, never leaked. jax is imported lazily, on the staging
+    thread: this module stays numpy-only for data-side users.
+    """
+
+    def __init__(self, source: Iterable[Tuple[np.ndarray, Any]],
+                 depth: int = 2) -> None:
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1 (got {depth})")
+        self.depth = depth
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._exc: Optional[BaseException] = None
+        self._finished = False
+        self._thread = threading.Thread(
+            target=self._run, args=(iter(source),),
+            name="slt-prefetch", daemon=True)
+        self._thread.start()
+
+    def _put(self, item: Any) -> bool:
+        # bounded waits only: a consumer that left early sets _stop and
+        # drains, and this producer must notice instead of parking
+        # forever on a full queue
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _run(self, it: Iterator[Tuple[np.ndarray, Any]]) -> None:
+        try:
+            import jax  # lazy: see class docstring
+            for x, y in it:
+                if self._stop.is_set():
+                    return
+                if not self._put((jax.device_put(x), y)):
+                    return
+        except BaseException as exc:  # re-raised on the consumer thread
+            self._exc = exc
+        finally:
+            self._put(_DONE)
+
+    # -- iterator protocol --------------------------------------------- #
+    def __iter__(self) -> "DevicePrefetch":
+        return self
+
+    def __next__(self) -> Tuple[Any, Any]:
+        if self._finished:
+            raise StopIteration
+        item = self._q.get()
+        if item is _DONE:
+            self._finished = True
+            self._thread.join(timeout=5.0)
+            if self._exc is not None:
+                raise self._exc
+            raise StopIteration
+        return item
+
+    # -- lifecycle ----------------------------------------------------- #
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop staging and join the thread. Safe to call at any point
+        (mid-epoch break included) and idempotent."""
+        self._stop.set()
+        self._finished = True
+        deadline = time.monotonic() + timeout
+        while self._thread.is_alive() and time.monotonic() < deadline:
+            try:
+                # drain: unblocks a producer parked on a full queue
+                self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.02)
+
+    def __enter__(self) -> "DevicePrefetch":
+        return self
+
+    def __exit__(self, *exc_info: Any) -> None:
+        self.close()

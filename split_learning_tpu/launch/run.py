@@ -610,13 +610,16 @@ def cmd_train(args) -> int:
         if args.transport == "http":
             from split_learning_tpu.transport.http import HttpTransport
             density = getattr(args, "compress_density", 0.1)
+            # pool >= depth: a shared session with W > 10 lanes would
+            # otherwise serialize on urllib3's default pool of 10
+            pool = max(32, depth)
             transport = HttpTransport(cfg.server_url,
                                       compress=args.compress or "none",
-                                      density=density)
+                                      density=density, pool_maxsize=pool)
             if depth > 1:  # one connection per in-flight lane
                 transport_factory = lambda: HttpTransport(  # noqa: E731
                     cfg.server_url, compress=args.compress or "none",
-                    density=density)
+                    density=density, pool_maxsize=pool)
             # readiness barrier: the reference's client starts blind and
             # silently drops every pre-server batch (SURVEY.md §3.4)
             info = transport.wait_ready(timeout=args.wait_server)
@@ -637,7 +640,9 @@ def cmd_train(args) -> int:
             # in-process server: out-of-order arrival is part of the deal
             # for a depth-W window, so strictness follows the depth
             server = ServerRuntime(plan, cfg, jax.random.PRNGKey(cfg.seed),
-                                   sample, strict_steps=depth <= 1)
+                                   sample, strict_steps=depth <= 1,
+                                   overlap=not getattr(
+                                       args, "no_overlap", False))
             # --compress plumbs here too (wire emulation through the real
             # codec) so compressed-path runs don't need sockets; None
             # keeps the legacy direct path bit-for-bit
@@ -753,11 +758,20 @@ def cmd_train(args) -> int:
             if ckptr is not None:
                 ckptr.save_once(next_step, party_tree())
 
+        prefetch = getattr(args, "prefetch", 0) or 0
+        if prefetch > 0 and cfg.mode != "split":
+            print(f"[warn] --prefetch ignored in mode {cfg.mode!r} "
+                  "(split only)", file=sys.stderr)
+            prefetch = 0
+        train_kwargs: Dict[str, Any] = {}
+        if prefetch > 0:
+            train_kwargs["prefetch"] = prefetch
         try:
             with trace_ctx:
                 records = client.train(data_iter, epochs=cfg.epochs,
                                        start_step=start_step,
-                                       on_epoch_end=on_epoch_end)
+                                       on_epoch_end=on_epoch_end,
+                                       **train_kwargs)
         finally:
             if hasattr(client, "close"):  # pipelined: join lanes + conns
                 client.close()
@@ -876,7 +890,8 @@ def cmd_serve(args) -> int:
                                 sample,
                                 strict_steps=not args.allow_out_of_order,
                                 coalesce_max=args.coalesce_max,
-                                coalesce_window_ms=args.coalesce_window_ms)
+                                coalesce_window_ms=args.coalesce_window_ms,
+                                overlap=not args.no_overlap)
     except ValueError as e:  # e.g. --coalesce-max outside split mode
         print(f"[error] {e}", file=sys.stderr)
         return 2
@@ -1330,6 +1345,15 @@ def main(argv: Optional[list] = None) -> int:
                          "cut-layer exchanges in flight (bounded-staleness "
                          "async SGD; an http server needs "
                          "--allow-out-of-order when N > 1)")
+    pt.add_argument("--prefetch", dest="prefetch", type=int, default=0,
+                    help="split mode: stage the next N batches on device "
+                         "while the current step is in flight (background "
+                         "H2D transfer; 0 = off, 2 is a good start)")
+    pt.add_argument("--no-overlap", dest="no_overlap", action="store_true",
+                    help="local transport only: make the in-process server "
+                         "materialize results while holding its device "
+                         "lock (pre-async-dispatch behavior; escape hatch "
+                         "— see README 'Async dispatch & prefetch')")
     pt.add_argument("--chaos", default=None, metavar="SPEC",
                     help="deterministic fault injection on the client "
                          "wire: comma list of kind[=rate][:ms], kinds "
@@ -1385,6 +1409,12 @@ def main(argv: Optional[list] = None) -> int:
                     help="how long a coalescing group waits for peers "
                          "after its first request before flushing partial "
                          "(only with --coalesce-max > 1)")
+    ps.add_argument("--no-overlap", dest="no_overlap", action="store_true",
+                    help="materialize step results while holding the "
+                         "device lock instead of off-lock (disables the "
+                         "async-dispatch overlap of step t's host copy "
+                         "with step t+1's compute; escape hatch — see "
+                         "README 'Async dispatch & prefetch')")
     ps.add_argument("--compress", choices=["none", "int8", "topk8"],
                     default=None,
                     help="default wire compression for replies to clients "

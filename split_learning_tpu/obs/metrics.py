@@ -114,6 +114,33 @@ class Registry:
                 "gauges": gauges, "phase_fractions": fractions}
 
 
+def histogram_percentile(hist_snapshot: Dict[str, Any], q: float) -> float:
+    """Estimate the q-th percentile (0..100) from a histogram snapshot
+    (:meth:`Histogram.snapshot` shape) by linear interpolation within
+    the covering bucket — the standard Prometheus ``histogram_quantile``
+    estimate. Returns 0.0 on an empty histogram; a percentile landing in
+    the +Inf slot clamps to the last finite bound (the estimate is a
+    floor there, like Prometheus's). Used by bench gates that compare
+    e.g. ``lock_hold`` p50 against the old-taxonomy ``dispatch`` p50."""
+    total = int(hist_snapshot.get("count", 0))
+    if total <= 0:
+        return 0.0
+    if not 0.0 <= q <= 100.0:
+        raise ValueError(f"percentile must be in [0, 100] (got {q})")
+    rank = q / 100.0 * total
+    buckets = hist_snapshot["buckets"]
+    cumulative = hist_snapshot["cumulative"]
+    prev_cum, prev_le = 0, 0.0
+    for le, cum in zip(buckets, cumulative):
+        if cum >= rank:
+            if cum == prev_cum:
+                return float(le)
+            frac = (rank - prev_cum) / (cum - prev_cum)
+            return prev_le + (float(le) - prev_le) * max(frac, 0.0)
+        prev_cum, prev_le = cum, float(le)
+    return float(buckets[-1])  # +Inf slot: clamp to last finite bound
+
+
 def _sanitize(name: str) -> str:
     out = "".join(c if (c.isalnum() or c in "_:") else "_" for c in name)
     return out if not out[:1].isdigit() else "_" + out

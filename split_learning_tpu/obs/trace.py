@@ -14,8 +14,16 @@ records every phase as a span:
   reproduces ``fraction('transport')``), ``client_bwd``, ``opt_apply``,
   ``step_total``.
 - server party: ``queue_wait`` (lock wait; enqueue -> group pickup
-  under coalescing, which includes the window wait), ``dispatch``
-  (jitted step + host materialization).
+  under coalescing, which includes the window wait), ``dispatch`` (the
+  lock-held window: admission + the jitted call), and — on
+  async-dispatch servers (``ServerRuntime(overlap=True)``, the default)
+  — ``d2h``, the off-lock host materialization that overlaps the next
+  step's device compute. With overlap off there is no ``d2h`` span and
+  ``dispatch`` reabsorbs the materialization (the pre-PR-5 taxonomy;
+  consumers must treat ``d2h`` as optional). The lock-hold time itself
+  goes to the ``lock_hold`` metrics histogram (``slt_lock_hold_seconds``)
+  only, not to a span — it would double-cover ``dispatch`` on a trace
+  timeline.
 
 Spans aggregate into the per-party :class:`~.metrics.Registry`
 histograms and export as Chrome-trace-format events (one JSON event
@@ -62,6 +70,10 @@ PARTY_PIDS = {"client": 1, "server": 2}
 # queue_wait/dispatch belong to the server party; counting either would
 # double-book)
 CLIENT_PHASES = ("client_fwd", "transport", "client_bwd", "opt_apply")
+
+# server-party span names, for reporting tools; "d2h" appears only when
+# the server runs with overlap on (async dispatch — see module docstring)
+SERVER_PHASES = ("queue_wait", "dispatch", "d2h")
 
 
 class Tracer:

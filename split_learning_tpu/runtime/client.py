@@ -229,23 +229,32 @@ class SplitClientTrainer:
 
     def train(self, data_iter: Callable[[], Iterable[Tuple[np.ndarray, np.ndarray]]],
               epochs: Optional[int] = None, start_step: int = 0,
-              on_epoch_end: Optional[Callable[[int, int], None]] = None
-              ) -> List[StepRecord]:
+              on_epoch_end: Optional[Callable[[int, int], None]] = None,
+              prefetch: int = 0) -> List[StepRecord]:
         """Full training run ≡ train_split_learning (3 epochs default).
 
         ``start_step`` seeds the client-authoritative step counter (resume);
         ``on_epoch_end(epoch, next_step)`` fires after each epoch
-        (checkpoint hook)."""
+        (checkpoint hook). ``prefetch`` > 0 wraps each epoch's iterator
+        in a :class:`~split_learning_tpu.data.datasets.DevicePrefetch`
+        of that depth, so batch k+1's H2D staging overlaps step k's
+        round trip (same batch sequence, pinned by tests)."""
         records: List[StepRecord] = []
         step = start_step
         for epoch in range(epochs if epochs is not None else self.cfg.epochs):
-            for x, y in data_iter():
-                loss = self.train_step(x, y, step)
-                if loss is not None:
-                    records.append(StepRecord(step=step, loss=loss, epoch=epoch))
-                    if self.logger is not None:
-                        self.logger.log_metric("loss", loss, step=step)
-                step += 1
+            with contextlib.ExitStack() as stack:
+                it: Iterable = data_iter()
+                if prefetch > 0:
+                    from split_learning_tpu.data.datasets import DevicePrefetch
+                    it = stack.enter_context(DevicePrefetch(it, depth=prefetch))
+                for x, y in it:
+                    loss = self.train_step(x, y, step)
+                    if loss is not None:
+                        records.append(StepRecord(step=step, loss=loss,
+                                                  epoch=epoch))
+                        if self.logger is not None:
+                            self.logger.log_metric("loss", loss, step=step)
+                    step += 1
             if on_epoch_end is not None:
                 on_epoch_end(epoch, step)
         return records

@@ -52,7 +52,9 @@ class CoalesceRequest:
     step: int
     client_id: int
     done: threading.Event = field(default_factory=threading.Event)
-    result: Optional[Tuple[np.ndarray, float]] = None
+    # a value, or (async-dispatch servers) a zero-arg thunk submit()
+    # redeems on the waiter thread — see ServerRuntime._GroupD2H
+    result: Optional[Any] = None
     error: Optional[BaseException] = None
     # obs (obs/trace.py), set by submit() only while tracing is enabled:
     # the caller's trace id, the enqueue timestamp (queue_wait =
@@ -134,6 +136,15 @@ class RequestCoalescer:
             raise TimeoutError(
                 f"coalesced split_step for client {client_id} step {step} "
                 f"not flushed within {timeout}s")
+        if req.error is None and callable(req.result):
+            # async-dispatch servers resolve with a thunk: the dispatch
+            # only queued device work, and THIS waiter thread redeems it
+            # — the group's (single, shared) host materialization runs
+            # here, off the dispatcher, overlapping the next group's
+            # device compute. Redeeming may back-fill server_spans (the
+            # d2h span is unknown until the transfer happens), so it
+            # runs before the republish below.
+            req.result = req.result()
         if req.server_spans is not None:
             # lazy import: keeps the untraced module surface jax- and
             # obs-free for the pure queue unit tests

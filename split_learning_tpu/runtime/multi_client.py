@@ -106,6 +106,44 @@ class MultiClientSplitRunner:
             self.sync_bottoms()
         return losses
 
+    def train_rounds(self, batch_iters: Sequence[Any],
+                     rounds: Optional[int] = None,
+                     prefetch: int = 0) -> List[List[float]]:
+        """Drive whole rounds from per-client batch iterators (one
+        iterable of ``(x, y)`` per client). Stops after ``rounds``
+        rounds, or when any client's iterator drains (every round needs
+        all clients). ``prefetch`` > 0 wraps each client's iterator in a
+        :class:`~split_learning_tpu.data.datasets.DevicePrefetch` of
+        that depth, so every client's next batch stages H2D while the
+        current round's traffic is in flight; the wrappers are drained
+        and joined on every exit path."""
+        if len(batch_iters) != len(self.clients):
+            raise ValueError(
+                f"expected {len(self.clients)} batch iterators, "
+                f"got {len(batch_iters)}")
+        its: List[Any] = [iter(b) for b in batch_iters]
+        wrapped: List[Any] = []
+        if prefetch > 0:
+            from split_learning_tpu.data.datasets import DevicePrefetch
+            its = [DevicePrefetch(it, depth=prefetch) for it in its]
+            wrapped = its
+        losses: List[List[float]] = []
+        try:
+            done = 0
+            while rounds is None or done < rounds:
+                batch = []
+                for it in its:
+                    try:
+                        batch.append(next(it))
+                    except StopIteration:
+                        return losses
+                losses.append(self.train_round(batch))
+                done += 1
+            return losses
+        finally:
+            for w in wrapped:
+                w.close()
+
     def close(self) -> None:
         if self._pool is not None:
             self._pool.shutdown(wait=True)

@@ -34,6 +34,7 @@ batch-drop semantics; wrap the transport in retries if the link flakes.
 
 from __future__ import annotations
 
+import contextlib
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable, Iterable, List, Optional, Tuple
@@ -145,39 +146,47 @@ class PipelinedSplitClientTrainer:
 
     def train(self, data_iter: Callable[[], Iterable[Tuple[np.ndarray, np.ndarray]]],
               epochs: Optional[int] = None, start_step: int = 0,
-              on_epoch_end: Optional[Callable[[int, int], None]] = None
-              ) -> List[StepRecord]:
+              on_epoch_end: Optional[Callable[[int, int], None]] = None,
+              prefetch: int = 0) -> List[StepRecord]:
         """Full run; the in-flight window drains at every epoch boundary so
-        ``on_epoch_end`` (checkpoint hook) sees a quiesced client."""
+        ``on_epoch_end`` (checkpoint hook) sees a quiesced client.
+        ``prefetch`` > 0 wraps each epoch's iterator in a DevicePrefetch
+        of that depth (batch k+1's H2D overlaps the in-flight window)."""
         records: List[StepRecord] = []
         step = start_step
         for epoch in range(epochs if epochs is not None else self.cfg.epochs):
-            window: List[Tuple[Any, np.ndarray, Future, int]] = []
-            for x, y in data_iter():
-                self.ensure_init(x)
-                if len(window) == self.depth:
-                    entry = window.pop(0)
+            with contextlib.ExitStack() as stack:
+                it: Iterable = data_iter()
+                if prefetch > 0:
+                    from split_learning_tpu.data.datasets import DevicePrefetch
+                    it = stack.enter_context(
+                        DevicePrefetch(it, depth=prefetch))
+                window: List[Tuple[Any, np.ndarray, Future, int]] = []
+                for x, y in it:
+                    self.ensure_init(x)
+                    if len(window) == self.depth:
+                        entry = window.pop(0)
+                        loss = self._apply(entry[:3])
+                        self._record(records, entry[3], epoch, loss)
+                    # stash the MATERIALIZED device array, not the caller's
+                    # buffer: the remat backward re-reads it up to depth-1
+                    # batches later, and a loader that recycles one numpy
+                    # buffer per batch would silently hand it different data
+                    tr = obs_trace.get_tracer()
+                    t_f0 = time.perf_counter() if tr is not None else 0.0
+                    xd = jnp.asarray(x)
+                    acts = np.asarray(self._fwd(self.state.params, xd))
+                    if tr is not None:
+                        tr.record("client_fwd", t_f0,
+                                  time.perf_counter() - t_f0,
+                                  tid=self.client_id, step=step)
+                    lane = step % self.depth
+                    window.append((self.state.params, xd,
+                                   self._submit(lane, acts, y, step), step))
+                    step += 1
+                for entry in window:  # drain
                     loss = self._apply(entry[:3])
                     self._record(records, entry[3], epoch, loss)
-                # stash the MATERIALIZED device array, not the caller's
-                # buffer: the remat backward re-reads it up to depth-1
-                # batches later, and a loader that recycles one numpy
-                # buffer per batch would silently hand it different data
-                tr = obs_trace.get_tracer()
-                t_f0 = time.perf_counter() if tr is not None else 0.0
-                xd = jnp.asarray(x)
-                acts = np.asarray(self._fwd(self.state.params, xd))
-                if tr is not None:
-                    tr.record("client_fwd", t_f0,
-                              time.perf_counter() - t_f0,
-                              tid=self.client_id, step=step)
-                lane = step % self.depth
-                window.append((self.state.params, xd,
-                               self._submit(lane, acts, y, step), step))
-                step += 1
-            for entry in window:  # drain
-                loss = self._apply(entry[:3])
-                self._record(records, entry[3], epoch, loss)
             if on_epoch_end is not None:
                 on_epoch_end(epoch, step)
         return records

@@ -17,6 +17,15 @@ Entries can also carry the exact encoded HTTP body
 (:meth:`attach_body`), so a replayed wire reply is bit-identical to the
 original — byte-equal frames, same CRC, and the server's EF residual
 ledger is untouched by the replay.
+
+Async dispatch (PR 5) widens the race window this cache must close: the
+server now materializes the device result *outside* its lock, so a
+duplicate can arrive while the original is still mid-D2H. Entries are
+therefore futures, not just values: :meth:`begin` claims ownership of a
+(client, op, step) exactly once and leaves a *pending* entry behind;
+duplicates that lose the claim block on the entry's event
+(:meth:`wait`) and are served the one materialized result — never a
+409, never a second apply, never a second D2H.
 """
 
 from __future__ import annotations
@@ -28,35 +37,112 @@ from typing import Any, Dict, Optional, Tuple
 Key = Tuple[int, str, int]  # (client_id, op, step)
 
 
+class _Entry:
+    """One (client, op, step) reply slot — pending until resolved.
+
+    ``event`` fires once the owner either resolved (``done``, result and
+    maybe the encoded body are readable) or failed (``error`` set, the
+    entry already removed from the cache so a later retry can re-own the
+    step). Waiters hold a direct reference, so eviction can never strand
+    them."""
+
+    __slots__ = ("key", "event", "done", "result", "body", "error")
+
+    def __init__(self, key: Key) -> None:
+        self.key = key
+        self.event = threading.Event()
+        self.done = False
+        self.result: Any = None
+        self.body: Optional[bytes] = None
+        self.error: Optional[BaseException] = None
+
+
 class ReplayCache:
     """FIFO reply cache, bounded per-(client, op) and globally.
 
     ``window`` bounds each (client_id, op) stream: a client retrying its
     last few steps always hits; anything older ages out. ``max_total``
     bounds the whole cache so a burst of client ids cannot grow it
-    without limit (same discipline as the u_residual store).
+    without limit (same discipline as the u_residual store). Only
+    resolved entries are evictable — a pending entry has an owner thread
+    mid-materialization and waiters parked on it.
     """
 
     def __init__(self, window: int = 8, max_total: int = 64) -> None:
         self.window = int(window)
         self.max_total = int(max_total)
-        self._entries: "OrderedDict[Key, list]" = OrderedDict()
+        self._entries: "OrderedDict[Key, _Entry]" = OrderedDict()
         self._lock = threading.Lock()
         self.hits = 0
         self.body_hits = 0
         self.evictions = 0
 
-    # ------------------------------------------------------------------ #
-    def get(self, client_id: int, op: str, step: int) -> Optional[Any]:
-        """The cached result for a duplicate delivery, or None on miss.
-        Counts the hit."""
+    # -- ownership: the in-flight-future protocol ---------------------- #
+    def begin(self, client_id: int, op: str,
+              step: int) -> Tuple[_Entry, bool]:
+        """Claim (client_id, op, step). Returns ``(entry, owner)``:
+        exactly one caller per key gets ``owner=True`` and must later
+        :meth:`resolve` or :meth:`fail` the entry; everyone else gets
+        the existing entry (pending or resolved) to :meth:`wait` on."""
         key = (int(client_id), op, int(step))
         with self._lock:
             entry = self._entries.get(key)
-            if entry is None:
+            if entry is not None:
+                return entry, False
+            entry = _Entry(key)
+            self._entries[key] = entry
+            self._evict_locked(int(client_id), op)
+            return entry, True
+
+    def resolve(self, entry: _Entry, result: Any) -> None:
+        """Publish the owner's materialized result and wake waiters.
+        Idempotent; never overwrites (first apply wins)."""
+        with self._lock:
+            if entry.done:
+                return
+            entry.result = result
+            entry.done = True
+        entry.event.set()
+
+    def fail(self, entry: _Entry, error: BaseException) -> None:
+        """Owner's apply never produced a result (admission 409, dispatch
+        error): remove the claim so a later retry can re-own the step,
+        store the error for anyone already waiting, wake them."""
+        with self._lock:
+            if entry.done:
+                return
+            entry.error = error
+            if self._entries.get(entry.key) is entry:
+                del self._entries[entry.key]
+        entry.event.set()
+
+    def wait(self, entry: _Entry, timeout: float = 120.0) -> Any:
+        """Block a duplicate on the in-flight future; counts the hit.
+        Re-raises the owner's error if the original apply failed (the
+        duplicate of a 409'd step is itself that same 409)."""
+        if not entry.event.wait(timeout=timeout):
+            raise TimeoutError(
+                f"replayed step {entry.key} still in flight after "
+                f"{timeout}s")
+        if entry.error is not None:
+            raise entry.error
+        with self._lock:
+            self.hits += 1
+            return entry.result
+
+    # -- value-level back-compat surface ------------------------------- #
+    def get(self, client_id: int, op: str, step: int) -> Optional[Any]:
+        """The cached result for a duplicate delivery, or None on a miss.
+        Counts the hit. Non-blocking: a still-pending entry reads as a
+        miss (callers that can block use :meth:`begin`/:meth:`wait` or
+        :meth:`lookup`)."""
+        key = (int(client_id), op, int(step))
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None or not entry.done:
                 return None
             self.hits += 1
-            return entry[0]
+            return entry.result
 
     def contains(self, client_id: int, op: str, step: int) -> bool:
         with self._lock:
@@ -65,10 +151,17 @@ class ReplayCache:
     def put(self, client_id: int, op: str, step: int, result: Any) -> None:
         key = (int(client_id), op, int(step))
         with self._lock:
-            if key in self._entries:
-                return  # first apply wins; never overwrite a reply
-            self._entries[key] = [result, None]
+            entry = self._entries.get(key)
+            if entry is not None:
+                if entry.done:
+                    return  # first apply wins; never overwrite a reply
+            else:
+                entry = _Entry(key)
+                self._entries[key] = entry
+            entry.result = result
+            entry.done = True
             self._evict_locked(int(client_id), op)
+        entry.event.set()
 
     # ------------------------------------------------------------------ #
     def attach_body(self, client_id: int, op: str, step: int,
@@ -79,8 +172,8 @@ class ReplayCache:
         key = (int(client_id), op, int(step))
         with self._lock:
             entry = self._entries.get(key)
-            if entry is not None and entry[1] is None:
-                entry[1] = body
+            if entry is not None and entry.body is None:
+                entry.body = body
 
     def get_body(self, client_id: int, op: str, step: int) -> Optional[bytes]:
         """The original encoded reply bytes, or None. Counts a body hit
@@ -88,22 +181,54 @@ class ReplayCache:
         key = (int(client_id), op, int(step))
         with self._lock:
             entry = self._entries.get(key)
-            if entry is None or entry[1] is None:
+            if entry is None or entry.body is None:
                 return None
             self.body_hits += 1
-            return entry[1]
+            return entry.body
+
+    def lookup(self, client_id: int, op: str, step: int,
+               timeout: float = 120.0
+               ) -> Tuple[Optional[bytes], Optional[Any]]:
+        """Wire-server duplicate check: ``(body, result)``. Blocks on a
+        pending entry — a duplicate that arrives while the original is
+        still materializing waits for the one D2H instead of 409-ing.
+        Prefers the attached body (bit-identical replay); falls back to
+        the in-process result; ``(None, None)`` on a miss or when the
+        original's apply failed (the retry then re-runs the op and gets
+        the failure first-hand)."""
+        key = (int(client_id), op, int(step))
+        with self._lock:
+            entry = self._entries.get(key)
+        if entry is None:
+            return None, None
+        if not entry.event.wait(timeout=timeout) or entry.error is not None:
+            return None, None
+        with self._lock:
+            if entry.body is not None:
+                self.body_hits += 1
+                return entry.body, None
+            self.hits += 1
+            return None, entry.result
 
     # ------------------------------------------------------------------ #
     def _evict_locked(self, client_id: int, op: str) -> None:
-        mine = [k for k in self._entries
-                if k[0] == client_id and k[1] == op]
-        while len(mine) > self.window:
+        mine = [k for k, e in self._entries.items()
+                if k[0] == client_id and k[1] == op and e.done]
+        pending = sum(1 for k, e in self._entries.items()
+                      if k[0] == client_id and k[1] == op and not e.done)
+        while len(mine) + pending > self.window and mine:
             victim = mine.pop(0)  # FIFO: entries insert in step order
             del self._entries[victim]
             self.evictions += 1
         while len(self._entries) > self.max_total:
-            self._entries.popitem(last=False)
-            self.evictions += 1
+            for key, entry in self._entries.items():
+                if entry.done:
+                    del self._entries[key]
+                    self.evictions += 1
+                    break
+            else:
+                break  # everything left is pending; let owners finish
+        return
 
     def clear(self) -> None:
         """Drop everything — resume_from() re-bases the step floor, and

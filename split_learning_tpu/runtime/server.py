@@ -61,7 +61,9 @@ class ServerRuntime:
                  sample_input: np.ndarray, strict_steps: bool = True,
                  coalesce_max: int = 1,
                  coalesce_window_ms: float = 2.0,
-                 replay_window: int = 8) -> None:
+                 replay_window: int = 8,
+                 overlap: bool = True,
+                 d2h_delay_s: float = 0.0) -> None:
         """coalesce_max > 1 turns on request coalescing (classic split
         mode only): concurrent split_step calls that arrive within
         ``coalesce_window_ms`` of each other batch into one dispatch, up
@@ -72,11 +74,27 @@ class ServerRuntime:
         makes step delivery exactly-once within the window: a duplicate
         or retried request whose original was applied is served the
         original reply instead of 409-ing (runtime/replay.py). 0
-        disables the cache and restores at-most-once semantics."""
+        disables the cache and restores at-most-once semantics.
+
+        ``overlap`` (default on) takes host materialization off the
+        lock: the lock covers only step admission + the jitted dispatch
+        (which returns device futures immediately, chaining on the
+        donated state), and the D2H transfer (``np.asarray``/``float``)
+        runs after release — step t's transfer overlaps step t+1's
+        device compute. Placement of the transfer cannot change
+        numerics, and the application order under the lock is unchanged,
+        so the loss sequence is bit-identical either way; ``False``
+        (`serve --no-overlap`) restores the fully serial hot path.
+
+        ``d2h_delay_s`` adds a synthetic pause to every host
+        materialization — bench-only (CPU JAX has no real transfer cost
+        to overlap), honestly labeled wherever it is used."""
         self.plan = plan
         self.cfg = cfg
         self.mode = cfg.mode
         self.strict_steps = strict_steps
+        self.overlap = bool(overlap)
+        self._d2h_delay_s = float(d2h_delay_s)
         # optional hook fired (under the lock) after every completed op
         # with the acknowledged client step — the serve CLI hangs periodic
         # checkpointing off it
@@ -213,6 +231,11 @@ class ServerRuntime:
                 f"(last seen {last}); client restarted or replayed — "
                 "refusing to desync")
 
+    def _sleep_d2h(self) -> None:
+        # synthetic transfer cost (bench-only; see __init__)
+        if self._d2h_delay_s > 0.0:
+            time.sleep(self._d2h_delay_s)
+
     def split_step(self, activations: np.ndarray, labels: np.ndarray,
                    step: int, client_id: int = 0) -> Tuple[np.ndarray, float]:
         if self.mode != "split":
@@ -220,76 +243,112 @@ class ServerRuntime:
             raise ProtocolError(
                 f"split_step called in mode {self.mode!r}", status=400)
         # duplicate delivery (lost response, retried request, dup'd
-        # frame): serve the reply the original apply produced — the
-        # update must not run twice, and the client must still get its
-        # cut-layer gradient instead of a 409
+        # frame): claim the step exactly once. Losers of the claim block
+        # on the winner's in-flight future — materialization now runs
+        # off the lock, so "still materializing" is a real window a
+        # retry can land in — and are served the one materialized reply:
+        # the update must not run twice, and the client must still get
+        # its cut-layer gradient instead of a 409.
+        entry = None
         if self.replay is not None:
-            cached = self.replay.get(client_id, "split_step", step)
-            if cached is not None:
-                return cached
+            entry, owner = self.replay.begin(client_id, "split_step", step)
+            if not owner:
+                return self.replay.wait(entry)
         # obs: tr stays None by default, and every timing site below is
         # gated on it — the untraced serialized path takes no extra
         # locks and allocates nothing (the zero-overhead-off contract)
         tr = obs_trace.get_tracer()
-        if self._coalescer is not None:
-            # block on the group's future; the handshake runs at
-            # dispatch-admission time so a replayed step 409s its own
-            # client without poisoning the group
-            if tr is None:
-                return self._coalescer.submit(activations, labels, step,
-                                              client_id)
-            return self._coalescer.submit(
-                activations, labels, step, client_id,
-                trace_id=obs_trace.CTX.trace_id,
-                t_enqueue=time.perf_counter())
-        t_q0 = time.perf_counter() if tr is not None else 0.0
-        with self._lock:
-            t_d0 = time.perf_counter() if tr is not None else 0.0
-            if self.replay is not None:
-                # re-check under the lock: a concurrent duplicate may
-                # have applied and cached while we waited for it
-                cached = self.replay.get(client_id, "split_step", step)
-                if cached is not None:
-                    return cached
-            self._check_step(step, client_id)
-            self.state, g_acts, loss = self._split_step(
-                self.state, jnp.asarray(activations), jnp.asarray(labels))
-            g_host, loss_f = np.asarray(g_acts), float(loss)
-            if self.replay is not None:
-                self.replay.put(client_id, "split_step", step,
-                                (g_host, loss_f))
-            # max(): with strict_steps off (pipelined clients) steps can
-            # arrive out of order, and the acknowledged step — what /health
-            # reports and checkpoints are labeled with — must never regress
-            # below state the server has already absorbed
-            acked = max(self._last_step.get(client_id, -1), step)
-            self._last_step[client_id] = acked
-            if self.on_step is not None:
-                self.on_step(acked)
+        try:
+            if self._coalescer is not None:
+                # block on the group's future; the handshake runs at
+                # dispatch-admission time so a replayed step 409s its own
+                # client without poisoning the group
+                if tr is None:
+                    res = self._coalescer.submit(activations, labels,
+                                                 step, client_id)
+                else:
+                    res = self._coalescer.submit(
+                        activations, labels, step, client_id,
+                        trace_id=obs_trace.CTX.trace_id,
+                        t_enqueue=time.perf_counter())
+                if entry is not None:
+                    self.replay.resolve(entry, res)
+                return res
+            t_q0 = time.perf_counter() if tr is not None else 0.0
+            with self._lock:
+                t_d0 = time.perf_counter() if tr is not None else 0.0
+                self._check_step(step, client_id)
+                self.state, g_acts, loss = self._split_step(
+                    self.state, jnp.asarray(activations),
+                    jnp.asarray(labels))
+                if not self.overlap:
+                    # legacy placement: the transfer rides inside the
+                    # lock (and inside the dispatch span — the old span
+                    # taxonomy, where dispatch = jit + materialization)
+                    self._sleep_d2h()
+                    g_host, loss_f = np.asarray(g_acts), float(loss)
+                # max(): with strict_steps off (pipelined clients) steps
+                # can arrive out of order, and the acknowledged step —
+                # what /health reports and checkpoints are labeled with —
+                # must never regress below state the server has absorbed
+                acked = max(self._last_step.get(client_id, -1), step)
+                self._last_step[client_id] = acked
+                if self.on_step is not None:
+                    self.on_step(acked)
+                t_d1 = time.perf_counter() if tr is not None else 0.0
+            if self.overlap:
+                # off the lock: the jitted call above returned device
+                # futures (async dispatch), so forcing the transfer here
+                # lets step t's D2H overlap step t+1's device compute
+                self._sleep_d2h()
+                g_host, loss_f = np.asarray(g_acts), float(loss)
+            res = (g_host, loss_f)
+            if entry is not None:
+                self.replay.resolve(entry, res)
             if tr is not None:
-                # queue_wait = lock wait; dispatch = jitted step + host
-                # materialization (g_host/loss_f force the transfer)
                 self._record_server_spans(
-                    tr, t_q0, t_d0 - t_q0, t_d0,
-                    time.perf_counter() - t_d0,
+                    tr, t_q0, t_d0 - t_q0, t_d0, t_d1 - t_d0, t_d1,
+                    (time.perf_counter() - t_d1) if self.overlap else 0.0,
                     obs_trace.CTX.trace_id, step, client_id)
-            return g_host, loss_f
+            return res
+        except BaseException as exc:
+            # the apply never produced a reply (admission 409, dispatch
+            # error): release the claim so a retry can re-own the step,
+            # and hand the error to anyone already blocked on it
+            if entry is not None:
+                self.replay.fail(entry, exc)
+            raise
 
     def _record_server_spans(self, tr, t_q0: float, qw: float,
                              t_d0: float, dw: float,
+                             t_h0: float, hw: float,
                              trace_id: Optional[str], step: int,
                              client_id: int) -> None:
         """Record one step's server-party spans into the tracer and the
         /metrics histograms, and publish them to CTX.server_spans so the
-        transport can hand them back to the client (wire accounting)."""
+        transport can hand them back to the client (wire accounting).
+
+        ``dispatch`` is the lock-held window (admission + jitted call;
+        with overlap off it also contains the materialization — the old
+        taxonomy); ``d2h`` (hw > 0, overlap on) is the off-lock
+        materialization. ``lock_hold`` goes to the metrics histogram
+        only (``slt_lock_hold_seconds``) — as a trace span it would
+        double-cover the dispatch window."""
         tr.record("queue_wait", t_q0, qw, trace_id=trace_id,
                   party="server", tid=client_id, step=step)
         tr.record("dispatch", t_d0, dw, trace_id=trace_id,
                   party="server", tid=client_id, step=step)
         self._metrics.observe("queue_wait", qw)
         self._metrics.observe("dispatch", dw)
+        self._metrics.observe("lock_hold", dw)
+        spans = {"queue_wait": qw, "dispatch": dw}
+        if hw > 0.0:
+            tr.record("d2h", t_h0, hw, trace_id=trace_id,
+                      party="server", tid=client_id, step=step)
+            self._metrics.observe("d2h", hw)
+            spans["d2h"] = hw
         self._metrics.incr("split_steps_total")
-        obs_trace.CTX.server_spans = {"queue_wait": qw, "dispatch": dw}
+        obs_trace.CTX.server_spans = spans
 
     def _dispatch_group(self, group: "list[CoalesceRequest]",
                         reason: str) -> None:
@@ -305,22 +364,17 @@ class ServerRuntime:
         # includes the coalescer window wait by construction
         t_pick = time.perf_counter() if tr is not None else 0.0
         with self._lock:
+            t_lk0 = time.perf_counter() if tr is not None else 0.0
             admitted = []
-            # a retry can land in the same flush window as its original
-            # (or a cached reply may already exist): leaders compute,
-            # followers of the same (client, step) share the leader's
-            # reply, and cached steps resolve without touching the batch
+            # a retry can land in the same flush window as its original:
+            # leaders compute, followers of the same (client, step) share
+            # the leader's reply. (With replay enabled, duplicates are
+            # already deduplicated upstream — split_step's begin() claim —
+            # so followers only arise on replay-disabled servers.)
             leaders: Dict[Tuple[int, int], CoalesceRequest] = {}
             followers: Dict[Tuple[int, int], list] = {}
             for r in group:
                 key = (r.client_id, r.step)
-                if self.replay is not None:
-                    cached = self.replay.get(r.client_id, "split_step",
-                                             r.step)
-                    if cached is not None:
-                        r.result = cached
-                        r.done.set()
-                        continue
                 if key in leaders:
                     followers.setdefault(key, []).append(r)
                     continue
@@ -355,18 +409,28 @@ class ServerRuntime:
             self.state, g_acts, per_ex = self._coalesced_step(
                 self.state, jnp.asarray(acts), jnp.asarray(labels),
                 jnp.asarray(weights))
-            g_acts = np.asarray(g_acts)
-            per_ex = np.asarray(per_ex)
+            if not self.overlap:
+                # legacy placement: the whole group's transfer inside
+                # the lock (dispatch span = jit + materialization)
+                self._sleep_d2h()
+                g_acts = np.asarray(g_acts)
+                per_ex = np.asarray(per_ex)
             dw = time.perf_counter() - t_d0 if tr is not None else 0.0
+            pg = (_GroupD2H(self, g_acts, per_ex, tr)
+                  if self.overlap else None)
             off = 0
             for r, b in zip(admitted, sizes):
-                seg = (g_acts[off:off + b] * (total / b)).astype(
-                    g_acts.dtype, copy=False)
-                r.result = (seg, float(per_ex[off:off + b].mean()))
+                if pg is not None:
+                    # deferred: the flusher thread hands each waiter a
+                    # thunk instead of a value, so it is free to collect
+                    # group t+1 while group t's waiters share one D2H
+                    # (the first to arrive materializes; see _GroupD2H)
+                    r.result = pg.segment(r, off, b, total)
+                else:
+                    seg = (g_acts[off:off + b] * (total / b)).astype(
+                        g_acts.dtype, copy=False)
+                    r.result = (seg, float(per_ex[off:off + b].mean()))
                 off += b
-                if self.replay is not None:
-                    self.replay.put(r.client_id, "split_step", r.step,
-                                    r.result)
                 for f in followers.get((r.client_id, r.step), ()):
                     f.result = r.result
                     f.done.set()
@@ -388,6 +452,9 @@ class ServerRuntime:
                     self._metrics.observe("dispatch", dw)
                     self._metrics.incr("split_steps_total")
                 r.done.set()
+            if tr is not None:
+                self._metrics.observe(
+                    "lock_hold", time.perf_counter() - t_lk0)
 
     def predict(self, activations: np.ndarray,
                 client_id: int = 0) -> np.ndarray:
@@ -417,63 +484,89 @@ class ServerRuntime:
         if self.mode != "u_split":
             raise ProtocolError(
                 f"u_forward called in mode {self.mode!r}", status=400)
-        with self._lock:
-            if self.replay is not None:
-                # duplicate hop 1: return the original features and KEEP
-                # the stored residual — hop 2 may still be coming
-                cached = self.replay.get(client_id, "u_forward", step)
-                if cached is not None:
-                    return cached
-            self._check_step(step, client_id)
-            acts = jnp.asarray(activations)
-            feats = self._u_fwd(self.state.params, acts)
-            self._u_residual[(client_id, step)] = acts
-            mine = [k for k in self._u_residual if k[0] == client_id]
-            # FIFO eviction (dict preserves insertion order): this
-            # client's longest-waiting residual is the most likely orphan
-            for key in mine[:max(len(mine) - self.MAX_PENDING_RESIDUALS, 0)]:
-                del self._u_residual[key]
-            # global FIFO backstop: reclaims orphans of dead client_ids
-            overflow = len(self._u_residual) - self.MAX_TOTAL_RESIDUALS
-            if overflow > 0:
-                for key in list(self._u_residual)[:overflow]:
+        # duplicate hop 1: block on / serve the original features and
+        # KEEP the stored residual — hop 2 may still be coming
+        entry = None
+        if self.replay is not None:
+            entry, owner = self.replay.begin(client_id, "u_forward", step)
+            if not owner:
+                return self.replay.wait(entry)
+        try:
+            with self._lock:
+                self._check_step(step, client_id)
+                acts = jnp.asarray(activations)
+                feats = self._u_fwd(self.state.params, acts)
+                self._u_residual[(client_id, step)] = acts
+                mine = [k for k in self._u_residual if k[0] == client_id]
+                # FIFO eviction (dict preserves insertion order): this
+                # client's longest-waiting residual is the likeliest orphan
+                for key in mine[:max(len(mine) - self.MAX_PENDING_RESIDUALS,
+                                     0)]:
                     del self._u_residual[key]
-            feats_host = np.asarray(feats)
-            if self.replay is not None:
-                self.replay.put(client_id, "u_forward", step, feats_host)
+                # global FIFO backstop: reclaims orphans of dead client_ids
+                overflow = len(self._u_residual) - self.MAX_TOTAL_RESIDUALS
+                if overflow > 0:
+                    for key in list(self._u_residual)[:overflow]:
+                        del self._u_residual[key]
+                if not self.overlap:
+                    self._sleep_d2h()
+                    feats_host = np.asarray(feats)
+            if self.overlap:
+                # off the lock: async dispatch returned device futures
+                self._sleep_d2h()
+                feats_host = np.asarray(feats)
+            if entry is not None:
+                self.replay.resolve(entry, feats_host)
             return feats_host
+        except BaseException as exc:
+            if entry is not None:
+                self.replay.fail(entry, exc)
+            raise
 
     def u_backward(self, feat_grads: np.ndarray, step: int,
                    client_id: int = 0) -> np.ndarray:
         if self.mode != "u_split":
             raise ProtocolError(
                 f"u_backward called in mode {self.mode!r}", status=400)
-        with self._lock:
-            if self.replay is not None:
-                # duplicate hop 2: the residual was consumed by the
-                # original apply — without the cache this is the
-                # "unknown step" failure a lost response turns into
-                cached = self.replay.get(client_id, "u_backward", step)
-                if cached is not None:
-                    return cached
-            acts = self._u_residual.pop((client_id, step), None)
-            if acts is None:
-                raise ProtocolError(
-                    f"u_backward for unknown step {step} (client {client_id})")
-            self.state, g_acts = self._u_bwd(
-                self.state, acts, jnp.asarray(feat_grads))
-            g_host = np.asarray(g_acts)
-            if self.replay is not None:
-                self.replay.put(client_id, "u_backward", step, g_host)
-            # max(): with strict_steps off (pipelined clients) steps can
-            # arrive out of order, and the acknowledged step — what /health
-            # reports and checkpoints are labeled with — must never regress
-            # below state the server has already absorbed
-            acked = max(self._last_step.get(client_id, -1), step)
-            self._last_step[client_id] = acked
-            if self.on_step is not None:
-                self.on_step(acked)
+        # duplicate hop 2: the residual was consumed by the original
+        # apply — without the cache this is the "unknown step" failure a
+        # lost response turns into
+        entry = None
+        if self.replay is not None:
+            entry, owner = self.replay.begin(client_id, "u_backward", step)
+            if not owner:
+                return self.replay.wait(entry)
+        try:
+            with self._lock:
+                acts = self._u_residual.pop((client_id, step), None)
+                if acts is None:
+                    raise ProtocolError(
+                        f"u_backward for unknown step {step} "
+                        f"(client {client_id})")
+                self.state, g_acts = self._u_bwd(
+                    self.state, acts, jnp.asarray(feat_grads))
+                if not self.overlap:
+                    self._sleep_d2h()
+                    g_host = np.asarray(g_acts)
+                # max(): with strict_steps off (pipelined clients) steps
+                # can arrive out of order, and the acknowledged step —
+                # what /health reports and checkpoints are labeled with —
+                # must never regress below state the server has absorbed
+                acked = max(self._last_step.get(client_id, -1), step)
+                self._last_step[client_id] = acked
+                if self.on_step is not None:
+                    self.on_step(acked)
+            if self.overlap:
+                # off the lock: async dispatch returned device futures
+                self._sleep_d2h()
+                g_host = np.asarray(g_acts)
+            if entry is not None:
+                self.replay.resolve(entry, g_host)
             return g_host
+        except BaseException as exc:
+            if entry is not None:
+                self.replay.fail(entry, exc)
+            raise
 
     def aggregate(self, params: Any, epoch: int, loss: float,
                   step: int, num_examples: Optional[int] = None) -> Any:
@@ -584,13 +677,13 @@ class ServerRuntime:
         ``(body, result)`` — ``body`` is the exact encoded bytes of the
         original reply (the bit-identical path, preferred), ``result``
         the in-process result when the bytes were never attached. Both
-        None on a miss (or when replay is disabled)."""
+        None on a miss (or when replay is disabled). Blocks on an
+        in-flight entry: a duplicate that lands while the original is
+        still materializing off the lock waits for that one D2H instead
+        of re-dispatching or 409-ing."""
         if self.replay is None:
             return None, None
-        body = self.replay.get_body(client_id, op, step)
-        if body is not None:
-            return body, None
-        return None, self.replay.get(client_id, op, step)
+        return self.replay.lookup(client_id, op, step)
 
     def attach_reply_body(self, client_id: int, op: str, step: int,
                           body: bytes) -> None:
@@ -604,6 +697,67 @@ class ServerRuntime:
         """Flush and join the coalescer (no-op on serialized servers)."""
         if self._coalescer is not None:
             self._coalescer.close()
+
+
+class _GroupD2H:
+    """Deferred host materialization for one coalesced group.
+
+    With overlap on, ``_dispatch_group`` resolves each request with a
+    thunk instead of a value: the flusher thread never blocks on the
+    transfer (it is already collecting group t+1), and the first waiter
+    thread to redeem its thunk pays the group's single D2H — everyone
+    else reads the cached host arrays. The device references are dropped
+    after the transfer so the group's buffers are not pinned past it."""
+
+    __slots__ = ("_runtime", "_g_dev", "_per_ex_dev", "_tr", "_lock",
+                 "g", "per_ex", "t_h0", "hw")
+
+    def __init__(self, runtime: "ServerRuntime", g_dev, per_ex_dev,
+                 tr) -> None:
+        self._runtime = runtime
+        self._g_dev = g_dev
+        self._per_ex_dev = per_ex_dev
+        self._tr = tr
+        self._lock = threading.Lock()
+        self.g: Optional[np.ndarray] = None
+        self.per_ex: Optional[np.ndarray] = None
+        self.t_h0 = 0.0
+        self.hw = 0.0
+
+    def _materialize(self) -> None:
+        with self._lock:
+            if self.g is None:
+                t_h0 = time.perf_counter() if self._tr is not None else 0.0
+                self._runtime._sleep_d2h()
+                g = np.asarray(self._g_dev)
+                per_ex = np.asarray(self._per_ex_dev)
+                if self._tr is not None:
+                    self.t_h0 = t_h0
+                    self.hw = time.perf_counter() - t_h0
+                self.g, self.per_ex = g, per_ex
+                self._g_dev = self._per_ex_dev = None
+
+    def segment(self, req: CoalesceRequest, off: int, b: int, total: int):
+        """The thunk ``RequestCoalescer.submit`` redeems on the waiter
+        thread: materialize (once), slice + rescale this request's
+        segment, and back-fill the ``d2h`` span into the request's
+        server spans (unknown at dispatch time — the transfer had not
+        happened yet)."""
+        def _seg() -> Tuple[np.ndarray, float]:
+            self._materialize()
+            g, per_ex = self.g, self.per_ex
+            seg = (g[off:off + b] * (total / b)).astype(g.dtype,
+                                                        copy=False)
+            res = (seg, float(per_ex[off:off + b].mean()))
+            if self._tr is not None:
+                if req.server_spans is not None:
+                    req.server_spans = dict(req.server_spans, d2h=self.hw)
+                self._tr.record("d2h", self.t_h0, self.hw,
+                                trace_id=req.trace_id, party="server",
+                                tid=req.client_id, step=req.step)
+                self._runtime._metrics.observe("d2h", self.hw)
+            return res
+        return _seg
 
 
 class FedAvgAggregator:

@@ -330,24 +330,40 @@ class HttpTransport(Transport):
     classification instead of silent batch drops."""
 
     def __init__(self, base_url: str, timeout: float = 60.0,
-                 compress: str = "none", density: float = 0.1) -> None:
+                 compress: str = "none", density: float = 0.1,
+                 pool_maxsize: int = 32) -> None:
         """``compress="int8"`` quantizes the cut-layer tensors on the wire
         (4x fewer bytes; lossy — see ops/quantize.py). ``"topk8"`` ships
         only the top ``density`` fraction of magnitudes as int8 with
         sender-side error feedback (~17x at density 0.1 — see
         transport/codec.py). Weights (/aggregate_weights) always travel
-        lossless."""
+        lossless.
+
+        ``pool_maxsize`` sizes the urllib3 connection pool mounted on
+        the session. requests' default is 10; a pipelined client sharing
+        one transport across W > 10 lanes would silently serialize the
+        overflow on pool checkout (urllib3 blocks or discards), so
+        callers with deep windows must pass ``pool_maxsize >= depth``
+        (launch/run.py does)."""
         super().__init__()
         if compress not in ("none", "int8", "topk8"):
             raise ValueError(f"unknown compression {compress!r}")
+        if pool_maxsize < 1:
+            raise ValueError(f"pool_maxsize must be >= 1 (got {pool_maxsize})")
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self.compress = compress
         self.density = float(density)
+        self.pool_maxsize = int(pool_maxsize)
         # up-direction error feedback, keyed per op (one transport = one
         # client, so the op name is the whole key)
         self._ef = codec.TopK8EF()
         self._session = requests.Session()
+        adapter = requests.adapters.HTTPAdapter(
+            pool_connections=self.pool_maxsize,
+            pool_maxsize=self.pool_maxsize)
+        self._session.mount("http://", adapter)
+        self._session.mount("https://", adapter)
 
     def _pack(self, arr: np.ndarray, key: str = "x") -> Any:
         if self.compress == "int8":

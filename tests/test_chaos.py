@@ -4,6 +4,9 @@ after server apply (the desync the reference cannot survive), breaker
 state machine, and backoff schedules. All fast — no real sleeps, tiny
 models — so CI can run this file as the fault-tolerance smoke."""
 
+import threading
+import time
+
 import jax
 import numpy as np
 import pytest
@@ -292,3 +295,42 @@ def test_ef_rollback_then_repack_is_bit_identical():
     d2, r2 = topk8_compress(arr, 0.05)
     np.testing.assert_array_equal(d1["q"], d2["q"])
     np.testing.assert_array_equal(r1, r2)
+
+
+# ---------------------------------------------------------------------- #
+# async dispatch (PR 5): exactly-once across the off-lock window
+# ---------------------------------------------------------------------- #
+
+def test_duplicate_during_materialization_blocks_on_inflight_future():
+    """Async dispatch opens a window the old cache could not cover: the
+    step is applied but its reply is still materializing off the lock.
+    A duplicate landing there must block on the in-flight future and be
+    served the ONE materialized reply — not 409 (the step is not a
+    stale replay) and not a second apply."""
+    cfg = Config(mode="split", batch_size=BATCH)
+    plan = get_plan(mode="split")
+    sample = np.zeros((BATCH, 28, 28, 1), np.float32)
+    runtime = ServerRuntime(plan, cfg, jax.random.PRNGKey(2), sample,
+                            overlap=True, d2h_delay_s=0.4)
+    rs = np.random.RandomState(0)
+    x = rs.randn(BATCH, 26, 26, 32).astype(np.float32)  # cut-layer acts
+    y = rs.randint(0, 10, BATCH).astype(np.int64)
+    runtime.split_step(x, y, 0)  # compile + one padded materialization
+
+    results = {}
+    ta = threading.Thread(
+        target=lambda: results.update(a=runtime.split_step(x, y, 1)))
+    ta.start()
+    time.sleep(0.15)  # the original is now materializing, off the lock
+    t0 = time.perf_counter()
+    res_b = runtime.split_step(x, y, 1)  # duplicate delivery
+    waited = time.perf_counter() - t0
+    ta.join()
+    res_a = results["a"]
+
+    assert waited > 0.05  # it really blocked on the in-flight future
+    np.testing.assert_array_equal(res_b[0], res_a[0])  # identical reply
+    assert res_b[1] == res_a[1]
+    assert runtime.replay.hits == 1       # served from the future, once
+    assert runtime.health()["step"] == 1
+    assert int(runtime.state.step) == 2   # warmup + ONE apply, not two
