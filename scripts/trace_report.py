@@ -30,13 +30,18 @@ import json
 import sys
 from typing import Any, Dict, List
 
-# must match obs/trace.py CLIENT_PHASES / SERVER_PHASES (kept literal:
-# this script runs standalone, without the package importable). "d2h"
-# exists only on async-dispatch servers (PR 5) — totals.get(..., 0.0)
-# below keeps traces from older runs parsing (and reporting 0) without
-# it, the tolerant-parser contract.
-CLIENT_PHASES = ("client_fwd", "transport", "client_bwd", "opt_apply")
-TRANSPORT_SUB = ("encode", "wire", "queue_wait", "dispatch", "d2h")
+# the span taxonomy's single home is obs/spans.py (slt-lint SLT003);
+# this script also runs standalone, without the package importable, so
+# it falls back to a literal copy that tests/test_analysis.py pins
+# byte-equal to the registry. "d2h" exists only on async-dispatch
+# servers (PR 5) — totals.get(..., 0.0) below keeps traces from older
+# runs parsing (and reporting 0) without it, the tolerant-parser
+# contract.
+try:
+    from split_learning_tpu.obs.spans import CLIENT_PHASES, TRANSPORT_SUB
+except ImportError:
+    CLIENT_PHASES = ("client_fwd", "transport", "client_bwd", "opt_apply")
+    TRANSPORT_SUB = ("encode", "wire", "queue_wait", "dispatch", "d2h")
 
 
 def load_events(path: str) -> List[Dict[str, Any]]:

@@ -1,0 +1,12 @@
+"""slt-lint: project-specific concurrency-invariant static analysis.
+
+Run as ``python -m split_learning_tpu.analysis <paths...>``. The rule
+catalog lives in :mod:`split_learning_tpu.analysis.rules`; the dynamic
+counterpart (lock-order / hold-budget watchdog) is
+:mod:`split_learning_tpu.obs.locks`. Stdlib-only by design — the CI
+lint step must not require jax/numpy to import.
+"""
+
+from split_learning_tpu.analysis.engine import Finding, lint_paths, main
+
+__all__ = ["Finding", "lint_paths", "main"]

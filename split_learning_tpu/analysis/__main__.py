@@ -1,0 +1,8 @@
+"""``python -m split_learning_tpu.analysis <paths...>``"""
+
+import sys
+
+from split_learning_tpu.analysis.engine import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,177 @@
+"""slt-lint driver: walk files, run rules, apply waivers, report.
+
+Waiver syntax (both forms require a non-empty reason — an unreasoned
+waiver is itself a finding):
+
+* inline, on the offending line or the line directly above::
+
+      x = np.asarray(dev)  # slt-lint: disable=SLT001 (legacy overlap-off path)
+
+* file-scoped, one per line in the checked-in waiver file
+  (``.slt-lint.waivers`` at the repo root, empty by policy —
+  real violations get fixed, not parked)::
+
+      SLT003 split_learning_tpu/foo/bar.py reason text
+
+Exit status: 0 when every finding is waived (or none), 1 otherwise —
+the CI contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import re
+import sys
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from split_learning_tpu.analysis.rules import (Finding, RULES, Src,
+                                               run_rules)
+
+_WAIVER_RE = re.compile(
+    r"#\s*slt-lint:\s*disable=([A-Z0-9,\s]+?)\s*\(([^)]*)\)")
+_DEFAULT_WAIVER_FILE = ".slt-lint.waivers"
+
+
+def _posix(path: str) -> str:
+    return path.replace(os.sep, "/")
+
+
+def _parse_inline_waivers(text: str, path: str
+                          ) -> Tuple[Dict[int, Tuple[Set[str], str]],
+                                     List[Finding]]:
+    """line -> (rule ids, reason); a waiver on its own line covers the
+    next line, otherwise the line it sits on."""
+    waivers: Dict[int, Tuple[Set[str], str]] = {}
+    problems: List[Finding] = []
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        m = _WAIVER_RE.search(line)
+        if m is None:
+            if re.search(r"#\s*slt-lint:\s*disable", line):
+                problems.append(Finding(
+                    "SLT000", path, lineno,
+                    "malformed waiver — expected "
+                    "'# slt-lint: disable=SLT00N (reason)'"))
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        reason = m.group(2).strip()
+        if not reason:
+            problems.append(Finding(
+                "SLT000", path, lineno,
+                "waiver without a reason — say why, in the parens"))
+            continue
+        target = lineno + 1 if line.strip().startswith("#") else lineno
+        waivers[target] = (rules, reason)
+    return waivers, problems
+
+
+def _load_waiver_file(path: str) -> Tuple[List[Tuple[str, str, str]],
+                                          List[Finding]]:
+    """Lines of 'RULE path reason...' -> (rule, path-suffix, reason)."""
+    entries: List[Tuple[str, str, str]] = []
+    problems: List[Finding] = []
+    try:
+        with open(path, encoding="utf-8") as fh:
+            lines = fh.read().splitlines()
+    except OSError:
+        return entries, problems
+    for lineno, line in enumerate(lines, start=1):
+        stripped = line.strip()
+        if not stripped or stripped.startswith("#"):
+            continue
+        parts = stripped.split(None, 2)
+        if len(parts) < 3 or parts[0] not in RULES:
+            problems.append(Finding(
+                "SLT000", path, lineno,
+                "malformed waiver-file entry — expected "
+                "'SLT00N path/suffix.py reason text'"))
+            continue
+        entries.append((parts[0], _posix(parts[1]), parts[2]))
+    return entries, problems
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs
+                             if d != "__pycache__" and not d.startswith("."))
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(root, f)
+
+
+def lint_file(path: str,
+              file_waivers: Optional[List[Tuple[str, str, str]]] = None
+              ) -> List[Finding]:
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    try:
+        tree = ast.parse(text, filename=path)
+    except SyntaxError as exc:
+        return [Finding("SLT000", path, exc.lineno or 1,
+                        f"cannot parse: {exc.msg}")]
+    src = Src(path=path, posix=_posix(path), tree=tree, text=text)
+    findings = run_rules(src)
+    inline, problems = _parse_inline_waivers(text, path)
+    out: List[Finding] = list(problems)
+    for f in findings:
+        waived, reason = False, ""
+        hit = inline.get(f.line)
+        if hit is not None and f.rule in hit[0]:
+            waived, reason = True, hit[1]
+        if not waived and file_waivers:
+            for rule, suffix, wf_reason in file_waivers:
+                if rule == f.rule and src.posix.endswith(suffix):
+                    waived, reason = True, wf_reason
+                    break
+        out.append(Finding(f.rule, f.path, f.line, f.message,
+                           waived=waived, reason=reason))
+    return out
+
+
+def lint_paths(paths: Iterable[str],
+               waiver_file: Optional[str] = None) -> List[Finding]:
+    file_waivers: List[Tuple[str, str, str]] = []
+    problems: List[Finding] = []
+    if waiver_file is None and os.path.exists(_DEFAULT_WAIVER_FILE):
+        waiver_file = _DEFAULT_WAIVER_FILE
+    if waiver_file:
+        file_waivers, problems = _load_waiver_file(waiver_file)
+    findings = list(problems)
+    for path in iter_py_files(paths):
+        findings.extend(lint_file(path, file_waivers))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m split_learning_tpu.analysis",
+        description="slt-lint: project concurrency-invariant checks")
+    parser.add_argument("paths", nargs="*", default=["split_learning_tpu"],
+                        help="files or directories to lint")
+    parser.add_argument("--waiver-file", default=None,
+                        help=f"file-scoped waivers (default: "
+                             f"{_DEFAULT_WAIVER_FILE} if present)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule_id, (_fn, doc) in sorted(RULES.items()):
+            print(f"{rule_id}: {doc}")
+        return 0
+
+    findings = lint_paths(args.paths or ["split_learning_tpu"],
+                          args.waiver_file)
+    unwaived = [f for f in findings if not f.waived]
+    for f in findings:
+        print(f.format())
+    n_waived = sum(1 for f in findings if f.waived)
+    print(f"slt-lint: {len(unwaived)} unwaived finding(s), "
+          f"{n_waived} waived")
+    return 1 if unwaived else 0

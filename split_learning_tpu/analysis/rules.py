@@ -1,0 +1,608 @@
+"""slt-lint rule catalog.
+
+Each rule encodes one invariant the runtime's correctness currently
+rests on by convention (see ISSUE 6 / the PR 4-5 postmortems):
+
+========  ==============================================================
+SLT001    no D2H or blocking transport/IO under the runtime/coalescer
+          locks — the serialization PR 5 removed must not creep back
+SLT002    every ``replay.begin()`` claim reaches ``resolve()`` /
+          ``fail()`` (or the non-owner ``wait()``) on all exit paths —
+          a leaked claim wedges every duplicate of that step forever
+SLT003    span-name literals live in obs/spans.py only — the
+          client/server/trace_report taxonomies must not drift
+SLT004    wire-path determinism — no module-global RNG, no unseeded
+          RNG construction, no wall clock in chaos/codec/ops/breaker
+SLT005    lock-order — the statically visible nested-acquisition graph
+          must be acyclic
+========  ==============================================================
+
+Rules are deliberately project-shaped: scopes are path suffixes inside
+this repo, receivers are matched by the names the runtime actually
+uses, and the known-good exceptions (the ``overlap=False`` legacy
+branch; the ``_GroupD2H`` materialization latch, whose whole purpose is
+to hold its private lock across the D2H) are encoded here rather than
+waived at every site. Everything else goes through the
+``# slt-lint: disable=SLT00N (reason)`` waiver syntax in engine.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Any, Dict, Iterator, List, Optional, Set, Tuple
+
+from split_learning_tpu.analysis import cfg as cfg_mod
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+    waived: bool = False
+    reason: str = ""
+
+    def format(self) -> str:
+        tail = f"  [waived: {self.reason}]" if self.waived else ""
+        return f"{self.path}:{self.line}: {self.rule} {self.message}{tail}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Src:
+    """One parsed file as the rules see it."""
+    path: str       # as passed on the command line
+    posix: str      # forward-slash form, for scope suffix matching
+    tree: ast.AST
+    text: str
+
+
+def _in_dir(src: Src, *parts: str) -> bool:
+    return any(f"/{p}/" in src.posix or src.posix.startswith(f"{p}/")
+               for p in parts)
+
+
+def _ends(src: Src, *suffixes: str) -> bool:
+    return any(src.posix.endswith(s) for s in suffixes)
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is total on 3.9+
+        return "<expr>"
+
+
+# ---------------------------------------------------------------------- #
+# SLT001: no D2H / blocking calls under the runtime locks
+# ---------------------------------------------------------------------- #
+
+_LOCKISH = ("lock", "cond", "mutex")
+
+# the one class whose lock exists to serialize the D2H itself: the
+# group-materialization latch holds its private lock across np.asarray
+# so exactly one waiter pays the transfer — that is its contract, not a
+# violation of the runtime lock discipline
+_D2H_LATCH_CLASSES = frozenset({"_GroupD2H"})
+
+
+def _is_lockish_name(name: str) -> bool:
+    return any(tok in name for tok in _LOCKISH)
+
+
+def _lock_expr_name(expr: ast.expr) -> Optional[str]:
+    """'self._lock'-shaped context expr -> its source text, else None."""
+    if isinstance(expr, ast.Attribute) and _is_lockish_name(expr.attr):
+        return _unparse(expr)
+    if isinstance(expr, ast.Name) and _is_lockish_name(expr.id):
+        return expr.id
+    return None
+
+
+def _call_root(func: ast.expr) -> Optional[str]:
+    """Leftmost Name of an attribute chain ('np' for np.random.rand)."""
+    while isinstance(func, ast.Attribute):
+        func = func.value
+    return func.id if isinstance(func, ast.Name) else None
+
+
+def _is_overlap_gate(test: ast.expr) -> Optional[bool]:
+    """``if not self.overlap:`` -> True (body is the legacy branch);
+    ``if self.overlap:`` -> False (the *else* is legacy)."""
+    if (isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not)
+            and isinstance(test.operand, ast.Attribute)
+            and test.operand.attr == "overlap"):
+        return True
+    if isinstance(test, ast.Attribute) and test.attr == "overlap":
+        return False
+    return None
+
+
+def _slt001_blocking(node: ast.Call, held_lock: str) -> Optional[str]:
+    """Why this call must not run under the lock, or None."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        if f.id == "float" and node.args and not isinstance(
+                node.args[0], ast.Constant):
+            return ("float() on a non-constant forces device->host "
+                    "materialization")
+        return None
+    if not isinstance(f, ast.Attribute):
+        return None
+    recv = _unparse(f.value)
+    root = _call_root(f)
+    if f.attr == "asarray" and root in ("np", "numpy"):
+        return "np.asarray is a blocking device->host transfer"
+    if f.attr == "device_get" and root == "jax":
+        return "jax.device_get is a blocking device->host transfer"
+    if f.attr == "block_until_ready":
+        return ".block_until_ready() blocks on device completion"
+    if f.attr == "sleep" and root == "time":
+        return "time.sleep under the lock serializes every other caller"
+    if f.attr == "_sleep_d2h":
+        return "synthetic D2H delay under the lock"
+    if f.attr in ("result", "join"):
+        return f".{f.attr}() blocks under the lock"
+    if f.attr in ("wait", "wait_for") and recv != held_lock:
+        return (f".{f.attr}() on {recv!r} blocks while holding "
+                f"{held_lock!r}")
+    if root == "requests":
+        return "network IO under the lock"
+    return None
+
+
+class _Slt001Visitor(ast.NodeVisitor):
+    def __init__(self, src: Src) -> None:
+        self.src = src
+        self.findings: List[Finding] = []
+        self._class: List[str] = []
+        self._held: List[str] = []
+        self._legacy = 0  # depth of explicitly-gated overlap-off branches
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._class.append(node.name)
+        self.generic_visit(node)
+        self._class.pop()
+
+    def _visit_with(self, node: Any) -> None:
+        locks = [n for n in (_lock_expr_name(i.context_expr)
+                             for i in node.items) if n is not None]
+        exempt = bool(self._class) and self._class[-1] in _D2H_LATCH_CLASSES
+        if locks and not exempt:
+            self._held.extend(locks)
+            self.generic_visit(node)
+            del self._held[len(self._held) - len(locks):]
+        else:
+            self.generic_visit(node)
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+    def visit_If(self, node: ast.If) -> None:
+        gate = _is_overlap_gate(node.test)
+        for field, stmts in (("body", node.body), ("orelse", node.orelse)):
+            legacy = (gate is True and field == "body") or (
+                gate is False and field == "orelse")
+            if legacy:
+                self._legacy += 1
+            for s in stmts:
+                self.visit(s)
+            if legacy:
+                self._legacy -= 1
+        self.visit(node.test)
+
+    def _skip_nested_def(self, node: Any) -> None:
+        # a def under a with-lock doesn't run there; analyze it lock-free
+        held, self._held = self._held, []
+        legacy, self._legacy = self._legacy, 0
+        self.generic_visit(node)
+        self._held, self._legacy = held, legacy
+
+    visit_FunctionDef = _skip_nested_def
+    visit_AsyncFunctionDef = _skip_nested_def
+    visit_Lambda = _skip_nested_def
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._held and not self._legacy:
+            why = _slt001_blocking(node, self._held[-1])
+            if why is not None:
+                self.findings.append(Finding(
+                    "SLT001", self.src.path, node.lineno,
+                    f"{why} (inside `with {self._held[-1]}:`)"))
+        self.generic_visit(node)
+
+
+def check_slt001(src: Src) -> Iterator[Finding]:
+    if not _in_dir(src, "runtime", "transport"):
+        return
+    v = _Slt001Visitor(src)
+    v.visit(src.tree)
+    yield from v.findings
+
+
+# ---------------------------------------------------------------------- #
+# SLT002: replay claims paired on every path
+# ---------------------------------------------------------------------- #
+
+def _is_replay_recv(expr: ast.expr) -> bool:
+    return "replay" in _unparse(expr)
+
+
+def _begin_claim(stmt: ast.stmt) -> Optional[str]:
+    """'entry, owner = <replay>.begin(...)' -> 'entry'."""
+    if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+        return None
+    value = stmt.value
+    if not (isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Attribute)
+            and value.func.attr == "begin"
+            and _is_replay_recv(value.func.value)):
+        return None
+    targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+    if not targets:
+        return None
+    t = targets[0]
+    if isinstance(t, ast.Tuple) and t.elts and isinstance(t.elts[0], ast.Name):
+        return t.elts[0].id
+    if isinstance(t, ast.Name):
+        return t.id
+    return None
+
+
+def _barrier_scan_roots(stmt: ast.stmt) -> List[ast.AST]:
+    """What actually executes *at* a CFG node: compound statements only
+    evaluate their header there (bodies are separate nodes), and a
+    def/class statement executes nothing from its body at all."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [i.context_expr for i in stmt.items]
+    if isinstance(stmt, ast.ExceptHandler):
+        return [stmt.type] if stmt.type is not None else []
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return []
+    return [stmt]
+
+
+def _is_barrier(stmt: Optional[ast.stmt]) -> bool:
+    if stmt is None:
+        return False
+    for root in _barrier_scan_roots(stmt):
+        if _scan_barrier_calls(root):
+            return True
+    return False
+
+
+def _scan_barrier_calls(root: ast.AST) -> bool:
+    for node in ast.walk(root):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("resolve", "fail", "wait")
+                and _is_replay_recv(node.func.value)):
+            return True
+    return False
+
+
+def _claim_branch_infeasible(cond: Any, claim: str) -> bool:
+    """Prune '<claim> is None' edges: on the analyzed paths the claim
+    exists (a None claim is, by construction, not a claim)."""
+    if not (isinstance(cond, tuple) and cond and cond[0] == "branch"):
+        return False
+    _tag, test, taken = cond
+    if (isinstance(test, ast.Compare) and len(test.ops) == 1
+            and isinstance(test.left, ast.Name) and test.left.id == claim
+            and len(test.comparators) == 1
+            and isinstance(test.comparators[0], ast.Constant)
+            and test.comparators[0].value is None):
+        if isinstance(test.ops[0], ast.Is):
+            return taken is True       # 'claim is None' branch: impossible
+        if isinstance(test.ops[0], ast.IsNot):
+            return taken is False      # skipping 'claim is not None': imp.
+    return False
+
+
+def _leak_path_exists(graph: cfg_mod.CFG, begin_node: cfg_mod.Node,
+                      claim: str) -> bool:
+    seen: Set[int] = set()
+    # follow only normal flow out of begin itself: if begin() raises,
+    # no claim was made
+    frontier = [t for t, c in begin_node.succs
+                if not (isinstance(c, tuple) and c and c[0] == "exc")]
+    while frontier:
+        node = frontier.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        if node is graph.exit:
+            return True
+        barrier = _is_barrier(node.stmt)
+        for target, cond in node.succs:
+            if barrier and not (isinstance(cond, tuple) and cond
+                                and cond[0] == "exc"):
+                continue  # barrier absorbs normal flow; exc may escape it
+            if _claim_branch_infeasible(cond, claim):
+                continue
+            frontier.append(target)
+    return False
+
+
+def check_slt002(src: Src) -> Iterator[Finding]:
+    if not _in_dir(src, "runtime", "transport"):
+        return
+    for fn in ast.walk(src.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        begins = [(s, c) for s in ast.walk(fn)
+                  if isinstance(s, ast.stmt)
+                  and (c := _begin_claim(s)) is not None]
+        if not begins:
+            continue
+        graph = cfg_mod.build(fn)
+        for stmt, claim in begins:
+            for node in graph.nodes_for(stmt):
+                if _leak_path_exists(graph, node, claim):
+                    yield Finding(
+                        "SLT002", src.path, stmt.lineno,
+                        f"claim {claim!r} from replay begin() can reach "
+                        f"exit of {fn.name}() without resolve()/fail()/"
+                        f"wait() on some path")
+                    break
+
+
+# ---------------------------------------------------------------------- #
+# SLT003: span names come from obs/spans.py
+# ---------------------------------------------------------------------- #
+
+_SPAN_SINKS = ("record", "record_span", "observe")
+
+
+def check_slt003(src: Src) -> Iterator[Finding]:
+    if not _in_dir(src, "runtime", "transport", "obs"):
+        return
+    if _ends(src, "obs/spans.py"):
+        return  # the registry itself is the one legal home of literals
+    for node in ast.walk(src.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SPAN_SINKS and node.args):
+            continue
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            yield Finding(
+                "SLT003", src.path, node.lineno,
+                f"span/metric name {first.value!r} passed to "
+                f".{node.func.attr}() as a string literal — use the "
+                f"obs/spans.py constant so taxonomies cannot drift")
+
+
+# ---------------------------------------------------------------------- #
+# SLT004: wire-path determinism
+# ---------------------------------------------------------------------- #
+
+_NONDET_IMPORTS = {
+    "random", "randint", "randrange", "choice", "choices", "shuffle",
+    "sample", "uniform", "gauss", "rand", "randn", "default_rng",
+}
+
+
+def check_slt004(src: Src) -> Iterator[Finding]:
+    if not (_ends(src, "transport/chaos.py", "transport/codec.py",
+                  "native/codec.py", "runtime/breaker.py")
+            or _in_dir(src, "ops")):
+        return
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ImportFrom) and node.module in (
+                "random", "numpy.random"):
+            bad = [a.name for a in node.names if a.name in _NONDET_IMPORTS]
+            if bad:
+                yield Finding(
+                    "SLT004", src.path, node.lineno,
+                    f"import of module-global RNG symbol(s) {bad} from "
+                    f"{node.module} — draw from an injectable seeded "
+                    f"generator instead")
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            continue
+        root = _call_root(f)
+        recv = _unparse(f.value)
+        if recv == "random":
+            if f.attr in ("Random", "SystemRandom"):
+                if f.attr == "SystemRandom" or not node.args:
+                    yield Finding(
+                        "SLT004", src.path, node.lineno,
+                        f"random.{f.attr}({'' if not node.args else '...'})"
+                        f" is not reproducible — seed it explicitly")
+            else:
+                yield Finding(
+                    "SLT004", src.path, node.lineno,
+                    f"random.{f.attr}() draws from the module-global RNG "
+                    f"— chaos/codec schedules must be pure functions of "
+                    f"(seed, path, step, attempt)")
+        elif recv in ("np.random", "numpy.random"):
+            if f.attr in ("RandomState", "default_rng"):
+                if not node.args:
+                    yield Finding(
+                        "SLT004", src.path, node.lineno,
+                        f"{recv}.{f.attr}() without a seed is "
+                        f"nondeterministic — pass one")
+            else:
+                yield Finding(
+                    "SLT004", src.path, node.lineno,
+                    f"{recv}.{f.attr}() draws from numpy's module-global "
+                    f"RNG — use a seeded RandomState/Generator")
+        elif root == "time" and f.attr in ("time", "time_ns"):
+            yield Finding(
+                "SLT004", src.path, node.lineno,
+                f"time.{f.attr}() makes the wire path depend on the wall "
+                f"clock — use step/attempt counters (time.sleep and "
+                f"perf_counter/monotonic for measurement are fine)")
+
+
+# ---------------------------------------------------------------------- #
+# SLT005: the static lock-acquisition graph is acyclic
+# ---------------------------------------------------------------------- #
+
+class _MethodLocks(ast.NodeVisitor):
+    """Per-method: directly acquired self-locks + called self-methods,
+    each recorded with the lock names held at that point."""
+
+    def __init__(self) -> None:
+        self.acquires: List[Tuple[str, List[str], int]] = []
+        self.calls: List[Tuple[str, List[str], int]] = []
+        self._held: List[str] = []
+
+    def _visit_with(self, node: Any) -> None:
+        names = [n for n in (_lock_expr_name(i.context_expr)
+                             for i in node.items) if n is not None]
+        for n in names:
+            self.acquires.append((n, list(self._held), node.lineno))
+            self._held.append(n)
+        self.generic_visit(node)
+        if names:
+            del self._held[len(self._held) - len(names):]
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+                and f.value.id == "self"):
+            self.calls.append((f.attr, list(self._held), node.lineno))
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass  # nested defs execute elsewhere
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+
+def _canon(cls: Optional[str], lock: str, modstem: str) -> str:
+    owner = cls if cls is not None else modstem
+    return f"{owner}.{lock.replace('self.', '')}"
+
+
+def check_slt005(src: Src) -> Iterator[Finding]:
+    modstem = src.posix.rsplit("/", 1)[-1].rsplit(".", 1)[0]
+    # edges: (outer, inner) -> line of the witnessing acquisition
+    edges: Dict[Tuple[str, str], int] = {}
+
+    def scan_class(cls: ast.ClassDef) -> None:
+        methods: Dict[str, _MethodLocks] = {}
+        for item in cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                ml = _MethodLocks()
+                for s in item.body:
+                    ml.visit(s)
+                methods[item.name] = ml
+        # fixpoint: every lock a method can (transitively) acquire
+        reach: Dict[str, Set[str]] = {
+            name: {a for a, _h, _l in ml.acquires}
+            for name, ml in methods.items()}
+        changed = True
+        while changed:
+            changed = False
+            for name, ml in methods.items():
+                for callee, _held, _line in ml.calls:
+                    if callee in reach and not reach[callee] <= reach[name]:
+                        reach[name] |= reach[callee]
+                        changed = True
+        for name, ml in methods.items():
+            for lock, held, line in ml.acquires:
+                for outer in held:
+                    if outer != lock:
+                        edges.setdefault(
+                            (_canon(cls.name, outer, modstem),
+                             _canon(cls.name, lock, modstem)), line)
+            for callee, held, line in ml.calls:
+                if callee not in reach or not held:
+                    continue
+                for inner in reach[callee]:
+                    for outer in held:
+                        if outer != inner:
+                            edges.setdefault(
+                                (_canon(cls.name, outer, modstem),
+                                 _canon(cls.name, inner, modstem)), line)
+
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ClassDef):
+            scan_class(node)
+
+    # module-level functions: nested withs only
+    for node in src.tree.body if isinstance(src.tree, ast.Module) else []:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            ml = _MethodLocks()
+            for s in node.body:
+                ml.visit(s)
+            for lock, held, line in ml.acquires:
+                for outer in held:
+                    if outer != lock:
+                        edges.setdefault((_canon(None, outer, modstem),
+                                          _canon(None, lock, modstem)), line)
+
+    # cycle detection (within-file graph; the cross-object runtime graph
+    # is the watchdog's job — obs/locks.py)
+    adj: Dict[str, List[str]] = {}
+    for (a, b) in edges:
+        adj.setdefault(a, []).append(b)
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color: Dict[str, int] = {}
+
+    def dfs(n: str, stack: List[str]) -> Optional[List[str]]:
+        color[n] = GRAY
+        stack.append(n)
+        for m in adj.get(n, []):
+            if color.get(m, WHITE) == GRAY:
+                return stack[stack.index(m):] + [m]
+            if color.get(m, WHITE) == WHITE:
+                cyc = dfs(m, stack)
+                if cyc is not None:
+                    return cyc
+        stack.pop()
+        color[n] = BLACK
+        return None
+
+    for n in list(adj):
+        if color.get(n, WHITE) == WHITE:
+            cyc = dfs(n, [])
+            if cyc is not None:
+                line = min(edges.get((a, b), 1)
+                           for a, b in zip(cyc, cyc[1:]))
+                yield Finding(
+                    "SLT005", src.path, line,
+                    f"lock-order cycle: {' -> '.join(cyc)} — two threads "
+                    f"taking these in opposite orders deadlock")
+                return
+
+
+# ---------------------------------------------------------------------- #
+
+RULES = {
+    "SLT001": (check_slt001,
+               "no D2H / blocking IO under the runtime or coalescer lock"),
+    "SLT002": (check_slt002,
+               "replay begin() claims reach resolve()/fail()/wait() on "
+               "every exit path"),
+    "SLT003": (check_slt003,
+               "span/metric names come from obs/spans.py, never literals"),
+    "SLT004": (check_slt004,
+               "chaos/codec/ops/breaker stay deterministic: no global "
+               "RNG, no unseeded RNG, no wall clock"),
+    "SLT005": (check_slt005,
+               "the static nested-lock-acquisition graph is acyclic"),
+}
+
+
+def run_rules(src: Src) -> List[Finding]:
+    out: List[Finding] = []
+    for _rule_id, (fn, _doc) in sorted(RULES.items()):
+        out.extend(fn(src))
+    return out

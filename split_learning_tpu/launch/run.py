@@ -677,7 +677,9 @@ def cmd_train(args) -> int:
             # retry clients probe /health instead of hammering a dead
             # server with full payloads (runtime/breaker.py)
             from split_learning_tpu.runtime import CircuitBreaker
-            breaker = CircuitBreaker(transport.health)
+            # probe jitter is seeded from the run config (SLT004: the
+            # chaos-soak probe schedule must reproduce run to run)
+            breaker = CircuitBreaker(transport.health, seed=cfg.seed)
         if cfg.mode == "split":
             if depth > 1:
                 if phase_prof is not None:

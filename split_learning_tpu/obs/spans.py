@@ -1,0 +1,52 @@
+"""Central span-name registry — the single home of the trace taxonomy.
+
+Every span name the tracer, the metrics histograms, and the reporting
+tools agree on lives here and ONLY here (slt-lint rule SLT003): a call
+site that spells a span name as a string literal is a lint finding, so
+the client taxonomy, the server taxonomy, and ``scripts/trace_report.py``
+cannot drift apart silently. ``trace_report.py`` runs standalone
+(stdlib-only boxes) and therefore carries a literal fallback copy of the
+phase tuples — tests/test_analysis.py pins that copy equal to this
+module, which is the drift guard for the one consumer that cannot
+import us.
+
+Stdlib-only on purpose: importable by the linter, the report script,
+and the watchdog without pulling in numpy or jax.
+"""
+
+from __future__ import annotations
+
+# -- client-party spans (obs/trace.py module docstring for semantics) -- #
+CLIENT_FWD = "client_fwd"
+ENCODE = "encode"
+WIRE = "wire"
+TRANSPORT = "transport"
+CLIENT_BWD = "client_bwd"
+OPT_APPLY = "opt_apply"
+STEP_TOTAL = "step_total"
+
+# -- server-party spans ------------------------------------------------ #
+QUEUE_WAIT = "queue_wait"
+DISPATCH = "dispatch"
+D2H = "d2h"
+
+# metrics-histogram-only name (never a trace span — it would
+# double-cover ``dispatch`` on a timeline); fed by the traced runtime
+# and, under SLT_LOCK_DEBUG=1, by obs/locks.py InstrumentedLock
+LOCK_HOLD = "lock_hold"
+
+# the client-level phases that tile a step — the denominator of the
+# compute-vs-wire fraction (encode/wire are sub-phases of transport and
+# queue_wait/dispatch belong to the server party; counting either would
+# double-book)
+CLIENT_PHASES = (CLIENT_FWD, TRANSPORT, CLIENT_BWD, OPT_APPLY)
+
+# server-party span names, for reporting tools; D2H appears only when
+# the server runs with overlap on (async dispatch)
+SERVER_PHASES = (QUEUE_WAIT, DISPATCH, D2H)
+
+# the transport decomposition trace_report.py tabulates
+TRANSPORT_SUB = (ENCODE, WIRE, QUEUE_WAIT, DISPATCH, D2H)
+
+ALL_SPANS = (CLIENT_FWD, ENCODE, WIRE, TRANSPORT, CLIENT_BWD, OPT_APPLY,
+             STEP_TOTAL, QUEUE_WAIT, DISPATCH, D2H)

@@ -51,6 +51,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from split_learning_tpu.obs import spans
 from split_learning_tpu.obs.metrics import Registry
 
 
@@ -65,15 +66,10 @@ CTX = _Ctx()
 # Chrome-trace process ids: one synthetic "process" per party
 PARTY_PIDS = {"client": 1, "server": 2}
 
-# the client-level phases that tile a step — the denominator of the
-# compute-vs-wire fraction (encode/wire are sub-phases of transport and
-# queue_wait/dispatch belong to the server party; counting either would
-# double-book)
-CLIENT_PHASES = ("client_fwd", "transport", "client_bwd", "opt_apply")
-
-# server-party span names, for reporting tools; "d2h" appears only when
-# the server runs with overlap on (async dispatch — see module docstring)
-SERVER_PHASES = ("queue_wait", "dispatch", "d2h")
+# the phase tuples moved to obs/spans.py (the single home of the span
+# taxonomy — slt-lint SLT003); re-exported here for compatibility
+CLIENT_PHASES = spans.CLIENT_PHASES
+SERVER_PHASES = spans.SERVER_PHASES
 
 
 class Tracer:

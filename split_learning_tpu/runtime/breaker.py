@@ -21,6 +21,7 @@ whether a step is retried, skipped, or fatal.
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from typing import Callable, Dict, Optional
@@ -46,6 +47,7 @@ class CircuitBreaker:
                  probe_jitter: float = 0.5,
                  max_open_s: float = 60.0,
                  seed: Optional[int] = None,
+                 rng: Optional[random.Random] = None,
                  sleep: Callable[[float], None] = time.sleep) -> None:
         if failure_threshold < 1:
             raise ValueError("failure_threshold must be >= 1")
@@ -55,7 +57,12 @@ class CircuitBreaker:
         self.probe_cap_s = float(probe_cap_s)
         self.probe_jitter = float(probe_jitter)
         self.max_open_s = float(max_open_s)
-        self._seed = seed
+        # jitter source: injectable and always seeded (SLT004 — a
+        # chaos-soak run must reproduce its probe schedule exactly).
+        # Fleet spread comes from distinct per-client seeds, not from
+        # entropy: launch/run.py derives seed from (cfg.seed, client_id)
+        self._rng = rng if rng is not None else random.Random(
+            0 if seed is None else seed)
         self._sleep = sleep  # injectable for tests: no real waiting
         self._lock = threading.RLock()
         self.state = CLOSED
@@ -97,13 +104,9 @@ class CircuitBreaker:
         with self._lock:
             if self.state != OPEN:
                 return
-        rng = None
-        if self._seed is not None:
-            import numpy as np
-            rng = np.random.RandomState(self._seed)
         deadline = time.monotonic() + self.max_open_s
         for delay in backoff_delays(self.probe_initial_s, cap=self.probe_cap_s,
-                                    jitter=self.probe_jitter, rng=rng):
+                                    jitter=self.probe_jitter, rng=self._rng):
             self._sleep(min(delay, max(deadline - time.monotonic(), 0.0)))
             with self._lock:
                 if self.state != OPEN:

@@ -37,6 +37,7 @@ import numpy as np
 
 from split_learning_tpu.core.losses import cross_entropy
 from split_learning_tpu.core.stage import SplitPlan, stage_backward
+from split_learning_tpu.obs import spans
 from split_learning_tpu.obs import trace as obs_trace
 from split_learning_tpu.runtime.state import (
     TrainState, apply_grads, make_state, make_tx)
@@ -152,7 +153,7 @@ class SplitClientTrainer:
             acts = self._fwd(self.state.params, jnp.asarray(x))
             acts_host = np.asarray(acts)
         if tr is not None:
-            tr.record("client_fwd", t_step0,
+            tr.record(spans.CLIENT_FWD, t_step0,
                       time.perf_counter() - t_step0, trace_id=tid,
                       tid=self.client_id, step=step)
 
@@ -179,7 +180,7 @@ class SplitClientTrainer:
                 if self.breaker is not None:
                     self.breaker.record_success()
                 if tr is not None:
-                    tr.record("transport", t_tr0,
+                    tr.record(spans.TRANSPORT, t_tr0,
                               time.perf_counter() - t_tr0, trace_id=tid,
                               tid=self.client_id, step=step)
                 break
@@ -211,7 +212,7 @@ class SplitClientTrainer:
             if tr is not None:
                 jax.block_until_ready(g_params)
                 t_b1 = time.perf_counter()
-                tr.record("client_bwd", t_b0, t_b1 - t_b0, trace_id=tid,
+                tr.record(spans.CLIENT_BWD, t_b0, t_b1 - t_b0, trace_id=tid,
                           tid=self.client_id, step=step)
             t_o0 = time.perf_counter() if tr is not None else 0.0
             self.state = apply_grads(self._tx, self.state, g_params)
@@ -219,10 +220,10 @@ class SplitClientTrainer:
                 # sync only when timing accuracy matters
                 jax.block_until_ready(self.state.params)
             if tr is not None:
-                tr.record("opt_apply", t_o0, time.perf_counter() - t_o0,
+                tr.record(spans.OPT_APPLY, t_o0, time.perf_counter() - t_o0,
                           trace_id=tid, tid=self.client_id, step=step)
         if tr is not None:
-            tr.record("step_total", t_step0,
+            tr.record(spans.STEP_TOTAL, t_step0,
                       time.perf_counter() - t_step0, trace_id=tid,
                       tid=self.client_id, step=step)
         return loss

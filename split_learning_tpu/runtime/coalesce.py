@@ -31,6 +31,7 @@ from typing import Any, Callable, List, Optional, Tuple
 
 import numpy as np
 
+from split_learning_tpu.obs import locks as obs_locks
 from split_learning_tpu.transport.base import TransportStats
 
 
@@ -103,7 +104,8 @@ class RequestCoalescer:
         self.window_s = window_s
         self.stats = TransportStats()
         self._queue: List[CoalesceRequest] = []
-        self._cond = threading.Condition()
+        self._cond = threading.Condition(
+            obs_locks.make_lock("RequestCoalescer._cond"))
         self._closed = False
         self._thread = threading.Thread(
             target=self._run, name="slt-coalescer", daemon=True)

@@ -44,6 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from split_learning_tpu.core.stage import stage_backward
+from split_learning_tpu.obs import spans
 from split_learning_tpu.obs import trace as obs_trace
 from split_learning_tpu.runtime.client import StepRecord
 from split_learning_tpu.runtime.state import (
@@ -123,7 +124,7 @@ class PipelinedSplitClientTrainer:
                                            self.client_id)
             finally:
                 obs_trace.CTX.trace_id = None
-            tr.record("transport", t0, time.perf_counter() - t0,
+            tr.record(spans.TRANSPORT, t0, time.perf_counter() - t0,
                       trace_id=tid, tid=lane, step=step)
             return out
 
@@ -140,7 +141,7 @@ class PipelinedSplitClientTrainer:
         self.state = apply_grads(self._tx, self.state, g_params)
         if tr is not None:
             jax.block_until_ready(self.state.params)
-            tr.record("client_bwd", t0, time.perf_counter() - t0,
+            tr.record(spans.CLIENT_BWD, t0, time.perf_counter() - t0,
                       tid=self.client_id)
         return loss
 
@@ -177,7 +178,7 @@ class PipelinedSplitClientTrainer:
                     xd = jnp.asarray(x)
                     acts = np.asarray(self._fwd(self.state.params, xd))
                     if tr is not None:
-                        tr.record("client_fwd", t_f0,
+                        tr.record(spans.CLIENT_FWD, t_f0,
                                   time.perf_counter() - t_f0,
                                   tid=self.client_id, step=step)
                     lane = step % self.depth

@@ -34,6 +34,8 @@ import threading
 from collections import OrderedDict
 from typing import Any, Dict, Optional, Tuple
 
+from split_learning_tpu.obs import locks as obs_locks
+
 Key = Tuple[int, str, int]  # (client_id, op, step)
 
 
@@ -72,7 +74,8 @@ class ReplayCache:
         self.window = int(window)
         self.max_total = int(max_total)
         self._entries: "OrderedDict[Key, _Entry]" = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = obs_locks.make_lock("ReplayCache._lock",
+                                         reentrant=False)
         self.hits = 0
         self.body_hits = 0
         self.evictions = 0

@@ -30,6 +30,8 @@ import numpy as np
 from split_learning_tpu.core.losses import (
     cross_entropy, per_example_cross_entropy)
 from split_learning_tpu.core.stage import SplitPlan
+from split_learning_tpu.obs import locks as obs_locks
+from split_learning_tpu.obs import spans
 from split_learning_tpu.obs import trace as obs_trace
 from split_learning_tpu.obs.metrics import Registry
 from split_learning_tpu.runtime.coalesce import (
@@ -99,11 +101,14 @@ class ServerRuntime:
         # with the acknowledged client step — the serve CLI hangs periodic
         # checkpointing off it
         self.on_step: Optional[Any] = None
-        self._lock = threading.RLock()
         # obs (PR 2): queue-wait / dispatch histograms behind GET
         # /metrics and self.metrics(). Allocated at init (never on the
-        # step path); populated only while tracing is enabled.
+        # step path); populated only while tracing is enabled. Created
+        # before the lock so the SLT_LOCK_DEBUG watchdog can feed
+        # slt_lock_hold_seconds through it.
         self._metrics = Registry()
+        self._lock = obs_locks.make_lock("ServerRuntime._lock",
+                                         registry=self._metrics)
         # per-client step handshake (multi-client split: SURVEY.md config 3);
         # _step_floor is a global minimum installed by resume_from so that
         # EVERY client — known or not — must resume at or after the
@@ -334,21 +339,21 @@ class ServerRuntime:
         materialization. ``lock_hold`` goes to the metrics histogram
         only (``slt_lock_hold_seconds``) — as a trace span it would
         double-cover the dispatch window."""
-        tr.record("queue_wait", t_q0, qw, trace_id=trace_id,
+        tr.record(spans.QUEUE_WAIT, t_q0, qw, trace_id=trace_id,
                   party="server", tid=client_id, step=step)
-        tr.record("dispatch", t_d0, dw, trace_id=trace_id,
+        tr.record(spans.DISPATCH, t_d0, dw, trace_id=trace_id,
                   party="server", tid=client_id, step=step)
-        self._metrics.observe("queue_wait", qw)
-        self._metrics.observe("dispatch", dw)
-        self._metrics.observe("lock_hold", dw)
-        spans = {"queue_wait": qw, "dispatch": dw}
+        self._metrics.observe(spans.QUEUE_WAIT, qw)
+        self._metrics.observe(spans.DISPATCH, dw)
+        self._metrics.observe(spans.LOCK_HOLD, dw)
+        srv_spans = {spans.QUEUE_WAIT: qw, spans.DISPATCH: dw}
         if hw > 0.0:
-            tr.record("d2h", t_h0, hw, trace_id=trace_id,
+            tr.record(spans.D2H, t_h0, hw, trace_id=trace_id,
                       party="server", tid=client_id, step=step)
-            self._metrics.observe("d2h", hw)
-            spans["d2h"] = hw
+            self._metrics.observe(spans.D2H, hw)
+            srv_spans[spans.D2H] = hw
         self._metrics.incr("split_steps_total")
-        obs_trace.CTX.server_spans = spans
+        obs_trace.CTX.server_spans = srv_spans
 
     def _dispatch_group(self, group: "list[CoalesceRequest]",
                         reason: str) -> None:
@@ -420,7 +425,7 @@ class ServerRuntime:
                   if self.overlap else None)
             off = 0
             for r, b in zip(admitted, sizes):
-                if pg is not None:
+                if self.overlap:
                     # deferred: the flusher thread hands each waiter a
                     # thunk instead of a value, so it is free to collect
                     # group t+1 while group t's waiters share one D2H
@@ -442,19 +447,21 @@ class ServerRuntime:
                     # per-request queue wait (incl. window); the batched
                     # dispatch is one event shared by the whole group
                     qw = max(t_pick - r.t_enqueue, 0.0)
-                    r.server_spans = {"queue_wait": qw, "dispatch": dw}
-                    tr.record("queue_wait", r.t_enqueue, qw,
+                    r.server_spans = {spans.QUEUE_WAIT: qw,
+                                      spans.DISPATCH: dw}
+                    tr.record(spans.QUEUE_WAIT, r.t_enqueue, qw,
                               trace_id=r.trace_id, party="server",
                               tid=r.client_id, step=r.step)
-                    tr.record("dispatch", t_d0, dw, trace_id=r.trace_id,
-                              party="server", tid=r.client_id, step=r.step)
-                    self._metrics.observe("queue_wait", qw)
-                    self._metrics.observe("dispatch", dw)
+                    tr.record(spans.DISPATCH, t_d0, dw,
+                              trace_id=r.trace_id, party="server",
+                              tid=r.client_id, step=r.step)
+                    self._metrics.observe(spans.QUEUE_WAIT, qw)
+                    self._metrics.observe(spans.DISPATCH, dw)
                     self._metrics.incr("split_steps_total")
                 r.done.set()
             if tr is not None:
                 self._metrics.observe(
-                    "lock_hold", time.perf_counter() - t_lk0)
+                    spans.LOCK_HOLD, time.perf_counter() - t_lk0)
 
     def predict(self, activations: np.ndarray,
                 client_id: int = 0) -> np.ndarray:
@@ -618,11 +625,13 @@ class ServerRuntime:
         the wire, both directions — transports call this per request)
         into the metrics Registry: cumulative byte counters plus the
         ``wire_compression_ratio`` gauge /metrics exposes."""
+        raw_i, wire_i = int(raw_bytes), int(wire_bytes)
+        raw_f, wire_f = float(raw_i), float(wire_i)
         with self._lock:
-            self._wire_totals[0] += int(raw_bytes)
-            self._wire_totals[1] += int(wire_bytes)
-            self._metrics.incr("wire_raw_bytes", float(raw_bytes))
-            self._metrics.incr("wire_bytes", float(wire_bytes))
+            self._wire_totals[0] += raw_i
+            self._wire_totals[1] += wire_i
+            self._metrics.incr("wire_raw_bytes", raw_f)
+            self._metrics.incr("wire_bytes", wire_f)
             if self._wire_totals[1] > 0:
                 self._metrics.set_gauge(
                     "wire_compression_ratio",
@@ -751,11 +760,12 @@ class _GroupD2H:
             res = (seg, float(per_ex[off:off + b].mean()))
             if self._tr is not None:
                 if req.server_spans is not None:
-                    req.server_spans = dict(req.server_spans, d2h=self.hw)
-                self._tr.record("d2h", self.t_h0, self.hw,
+                    req.server_spans = dict(req.server_spans,
+                                            **{spans.D2H: self.hw})
+                self._tr.record(spans.D2H, self.t_h0, self.hw,
                                 trace_id=req.trace_id, party="server",
                                 tid=req.client_id, step=req.step)
-                self._runtime._metrics.observe("d2h", self.hw)
+                self._runtime._metrics.observe(spans.D2H, self.hw)
             return res
         return _seg
 

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import abc
 import dataclasses
+import random
 import threading
 import time
 from typing import Any, Dict, Optional, Tuple
@@ -97,10 +98,13 @@ class TransportStats:
                 self.counters.get(f"span_{name}_n", 0) + 1)
 
     def percentile(self, q: float) -> float:
+        # snapshot under the lock, rank outside it: record() on the hot
+        # path must never wait behind an O(n log n) percentile
         with self._lock:
             if not self._latencies:
                 return float("nan")
-            return float(np.percentile(np.asarray(self._latencies), q))
+            samples = list(self._latencies)
+        return float(np.percentile(np.asarray(samples), q))
 
     @classmethod
     def merged(cls, stats_list: "list[TransportStats]") -> "TransportStats":
@@ -257,20 +261,23 @@ class FaultyTransport(Transport):
 
 def backoff_delays(initial: float = 0.5, factor: float = 2.0,
                    cap: float = 5.0, jitter: float = 0.0,
-                   rng: Optional[np.random.RandomState] = None):
+                   rng: Optional[Any] = None):
     """Exponential backoff schedule: ``initial * factor**i`` capped at
     ``cap``, each delay stretched by up to ``jitter`` of itself (uniform,
-    from ``rng`` — seeded for testability, per-client-random in prod so
-    N clients probing a restarting server spread out instead of
-    thundering-herding the same instants). Infinite generator; callers
-    own the deadline."""
+    from ``rng``). ``rng`` is any object with a zero-arg uniform draw —
+    ``random.Random`` (``.random()``, what CircuitBreaker injects) or a
+    ``np.random.RandomState`` (``.rand()``). Callers wanting N clients
+    to spread out instead of thundering-herding a restarting server pass
+    per-client seeds; determinism stays end to end (SLT004). Infinite
+    generator; callers own the deadline."""
     if rng is None:
-        rng = np.random.RandomState()
+        rng = random.Random(0)
+    draw = getattr(rng, "rand", None) or rng.random
     i = 0
     while True:
         d = min(initial * (factor ** i), cap)
         if jitter > 0:
-            d *= 1.0 + jitter * float(rng.rand())
+            d *= 1.0 + jitter * float(draw())
         yield d
         i += 1
 

@@ -29,6 +29,7 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 import requests
 
+from split_learning_tpu.obs import spans
 from split_learning_tpu.obs import trace as obs_trace
 from split_learning_tpu.transport import codec
 from split_learning_tpu.transport.base import (
@@ -453,12 +454,12 @@ class HttpTransport(Transport):
             step = int(payload.get("step", -1))
             cid = int(payload.get("client_id", 0))
             wire = max((t_wire1 - t_wire0) - sum(srv.values()), 0.0)
-            tr.record("encode", t_enc0, enc_s,
+            tr.record(spans.ENCODE, t_enc0, enc_s,
                       trace_id=tid, party="client", tid=cid, step=step)
-            tr.record("wire", t_wire0, wire,
+            tr.record(spans.WIRE, t_wire0, wire,
                       trace_id=tid, party="client", tid=cid, step=step)
-            self.stats.record_span("encode", enc_s)
-            self.stats.record_span("wire", wire)
+            self.stats.record_span(spans.ENCODE, enc_s)
+            self.stats.record_span(spans.WIRE, wire)
             # server-reported spans fold into this transport's stats so
             # merged() carries the full cross-party phase breakdown
             for name, secs in srv.items():

@@ -13,6 +13,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from split_learning_tpu.obs import spans
 from split_learning_tpu.obs import trace as obs_trace
 from split_learning_tpu.transport import codec
 from split_learning_tpu.transport.base import Transport, TransportError, timed
@@ -148,12 +149,12 @@ class LocalTransport(Transport):
                 enc_s = (t1 - t0) + (t3 - t2)  # codec both ways
                 srv = obs_trace.CTX.server_spans or {}
                 wire = max((t2 - t1) - sum(srv.values()), 0.0)
-                tr.record("encode", t0, enc_s, trace_id=tid,
+                tr.record(spans.ENCODE, t0, enc_s, trace_id=tid,
                           party="client", tid=client_id, step=step)
-                tr.record("wire", t1, wire, trace_id=tid,
+                tr.record(spans.WIRE, t1, wire, trace_id=tid,
                           party="client", tid=client_id, step=step)
-                self.stats.record_span("encode", enc_s)
-                self.stats.record_span("wire", wire)
+                self.stats.record_span(spans.ENCODE, enc_s)
+                self.stats.record_span(spans.WIRE, wire)
                 for name, secs in srv.items():
                     self.stats.record_span(str(name), float(secs))
                 return out

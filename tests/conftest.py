@@ -30,6 +30,22 @@ except Exception:
     pass
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _lock_watchdog_gate():
+    """Under SLT_LOCK_DEBUG=1 the runtime locks report inversions and
+    hold-budget violations into obs/locks.py's default graph; any such
+    report from the suite's own runtimes is a real bug — fail the
+    session at teardown. (The intentional-inversion regression test
+    uses a private LockGraph, so it never trips this gate.)"""
+    from split_learning_tpu.obs import locks
+    yield
+    if locks.enabled():
+        violations = locks.default_graph().violations
+        assert not violations, (
+            "lock watchdog reports from the test session:\n" +
+            "\n".join(v["message"] for v in violations))
+
+
 @pytest.fixture(scope="session")
 def devices():
     # NOTE: ask for the cpu backend explicitly — bare jax.devices() resolves

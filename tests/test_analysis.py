@@ -1,0 +1,604 @@
+"""slt-lint (PR 6): the static rules on per-rule fixtures (positive,
+negative, waiver), the SLT002 CFG on try/finally and early-return
+shapes, the engine's exit-code contract, the spans-registry drift
+guards, and the obs/locks.py watchdog (intentional inversion detected;
+watchdog-off locks are plain threading primitives and the training
+numerics are bit-identical either way)."""
+
+import ast
+import textwrap
+import threading
+import time
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from split_learning_tpu.analysis import cfg as cfg_mod
+from split_learning_tpu.analysis import engine
+from split_learning_tpu.obs import locks, spans
+from split_learning_tpu.obs import trace as obs_trace
+from split_learning_tpu.obs.metrics import Registry
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _lint(tmp_path, relpath, source):
+    p = tmp_path / relpath
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(source))
+    return engine.lint_file(str(p))
+
+
+def _rules(findings, *, waived=None):
+    return sorted(f.rule for f in findings
+                  if waived is None or f.waived is waived)
+
+
+# ---------------------------------------------------------------------- #
+# SLT001: D2H / blocking under the lock
+# ---------------------------------------------------------------------- #
+
+def test_slt001_flags_d2h_under_lock(tmp_path):
+    findings = _lint(tmp_path, "runtime/server.py", """
+        import numpy as np
+        class ServerRuntime:
+            def step(self):
+                with self._lock:
+                    g = np.asarray(self.dev)
+                    loss = float(self.loss_dev)
+                    self.fut.result()
+                return g, loss
+    """)
+    assert _rules(findings) == ["SLT001", "SLT001", "SLT001"]
+
+
+def test_slt001_negative_shapes(tmp_path):
+    findings = _lint(tmp_path, "runtime/server.py", """
+        import numpy as np
+        import jax.numpy as jnp
+        class ServerRuntime:
+            def step(self):
+                with self._lock:
+                    acts = jnp.asarray(self.host)   # H2D: allowed
+                    if not self.overlap:
+                        g = np.asarray(self.dev)    # gated legacy branch
+                g = np.asarray(self.dev)            # off-lock
+                return g
+            def wait_ok(self):
+                with self._cond:
+                    self._cond.wait(timeout=1.0)    # the held cond itself
+        class _GroupD2H:
+            def _materialize(self):
+                with self._lock:                    # the D2H latch
+                    self.g = np.asarray(self._g_dev)
+    """)
+    assert findings == []
+
+
+def test_slt001_out_of_scope_dir(tmp_path):
+    findings = _lint(tmp_path, "models/thing.py", """
+        import numpy as np
+        class M:
+            def f(self):
+                with self._lock:
+                    return np.asarray(self.dev)
+    """)
+    assert findings == []
+
+
+def test_slt001_inline_waiver_same_line_and_line_above(tmp_path):
+    findings = _lint(tmp_path, "runtime/server.py", """
+        import numpy as np
+        class ServerRuntime:
+            def a(self):
+                with self._lock:
+                    g = np.asarray(self.dev)  # slt-lint: disable=SLT001 (demo)
+                return g
+            def b(self):
+                with self._lock:
+                    # slt-lint: disable=SLT001 (next-line demo)
+                    g = np.asarray(self.dev)
+                return g
+    """)
+    assert _rules(findings, waived=True) == ["SLT001", "SLT001"]
+    assert _rules(findings, waived=False) == []
+
+
+def test_waiver_without_reason_is_itself_a_finding(tmp_path):
+    findings = _lint(tmp_path, "runtime/server.py", """
+        import numpy as np
+        class ServerRuntime:
+            def a(self):
+                with self._lock:
+                    g = np.asarray(self.dev)  # slt-lint: disable=SLT001 ()
+                return g
+    """)
+    rules = _rules(findings, waived=False)
+    assert "SLT000" in rules and "SLT001" in rules  # waiver void, both red
+
+
+# ---------------------------------------------------------------------- #
+# SLT002: claim pairing through the CFG
+# ---------------------------------------------------------------------- #
+
+def test_slt002_early_return_leaks_claim(tmp_path):
+    findings = _lint(tmp_path, "runtime/server.py", """
+        class S:
+            def step(self, step):
+                entry, owner = self.replay.begin(0, "op", step)
+                if not owner:
+                    return self.replay.wait(entry)
+                res = self.compute()
+                if res is None:
+                    return None
+                self.replay.resolve(entry, res)
+                return res
+    """)
+    assert _rules(findings) == ["SLT002"]
+
+
+def test_slt002_try_except_pairing_is_clean(tmp_path):
+    findings = _lint(tmp_path, "runtime/server.py", """
+        class S:
+            def step(self, step):
+                entry, owner = self.replay.begin(0, "op", step)
+                if not owner:
+                    return self.replay.wait(entry)
+                try:
+                    res = self.compute()
+                    if entry is not None:
+                        self.replay.resolve(entry, res)
+                    return res
+                except BaseException as exc:
+                    if entry is not None:
+                        self.replay.fail(entry, exc)
+                    raise
+    """)
+    assert findings == []
+
+
+def test_slt002_resolve_in_finally_is_clean(tmp_path):
+    findings = _lint(tmp_path, "runtime/server.py", """
+        class S:
+            def step(self, step):
+                entry, owner = self.replay.begin(0, "op", step)
+                try:
+                    return self.compute()
+                finally:
+                    self.replay.resolve(entry, None)
+    """)
+    assert findings == []
+
+
+def test_slt002_finally_without_resolve_leaks(tmp_path):
+    findings = _lint(tmp_path, "runtime/server.py", """
+        class S:
+            def step(self, step):
+                entry, owner = self.replay.begin(0, "op", step)
+                try:
+                    return self.compute()
+                finally:
+                    self.log("done")
+    """)
+    assert _rules(findings) == ["SLT002"]
+
+
+def test_slt002_typed_handler_can_leak_past_handlers(tmp_path):
+    # a KeyError handler does not catch a RuntimeError: the exceptional
+    # edge escapes the try and the claim leaks
+    findings = _lint(tmp_path, "runtime/server.py", """
+        class S:
+            def step(self, step):
+                entry, owner = self.replay.begin(0, "op", step)
+                try:
+                    res = self.compute()
+                except KeyError as exc:
+                    self.replay.fail(entry, exc)
+                    raise
+                self.replay.resolve(entry, res)
+                return res
+    """)
+    assert _rules(findings) == ["SLT002"]
+
+
+def test_cfg_routes_return_through_finally():
+    fn = ast.parse(textwrap.dedent("""
+        def f(self):
+            try:
+                return self.work()
+            finally:
+                self.cleanup()
+    """)).body[0]
+    graph = cfg_mod.build(fn)
+    ret = next(n for n in graph.nodes if isinstance(n.stmt, ast.Return))
+    # the return's successor is a duplicated finally statement, not EXIT
+    succs = [t for t, _c in ret.succs]
+    assert graph.exit not in succs
+    assert any(isinstance(t.stmt, ast.Expr) for t in succs)
+
+
+def test_cfg_early_return_reaches_exit_directly():
+    fn = ast.parse(textwrap.dedent("""
+        def f(self, x):
+            if x is None:
+                return 0
+            return 1
+    """)).body[0]
+    graph = cfg_mod.build(fn)
+    returns = [n for n in graph.nodes if isinstance(n.stmt, ast.Return)]
+    assert len(returns) == 2
+    for r in returns:
+        assert graph.exit in [t for t, _c in r.succs]
+
+
+# ---------------------------------------------------------------------- #
+# SLT003: span literals
+# ---------------------------------------------------------------------- #
+
+def test_slt003_flags_literal_and_accepts_constant(tmp_path):
+    findings = _lint(tmp_path, "runtime/worker.py", """
+        from split_learning_tpu.obs import spans
+        def go(tr, stats, reg, dt):
+            tr.record("client_fwd", 0.0, dt)
+            stats.record_span("wire", dt)
+            reg.observe("lock_hold", dt)
+            tr.record(spans.CLIENT_FWD, 0.0, dt)
+            stats.record(dt)
+    """)
+    assert _rules(findings) == ["SLT003", "SLT003", "SLT003"]
+
+
+def test_slt003_waiver(tmp_path):
+    findings = _lint(tmp_path, "runtime/worker.py", """
+        def go(tr, dt):
+            tr.record("legacy", 0.0, dt)  # slt-lint: disable=SLT003 (old export)
+    """)
+    assert _rules(findings, waived=True) == ["SLT003"]
+    assert _rules(findings, waived=False) == []
+
+
+# ---------------------------------------------------------------------- #
+# SLT004: wire-path determinism
+# ---------------------------------------------------------------------- #
+
+def test_slt004_flags_global_rng_unseeded_ctor_and_wall_clock(tmp_path):
+    findings = _lint(tmp_path, "ops/noise.py", """
+        import random
+        import time
+        import numpy as np
+        def draw():
+            a = random.random()
+            rs = np.random.RandomState()
+            b = np.random.rand(3)
+            t = time.time()
+            return a, rs, b, t
+    """)
+    assert _rules(findings) == ["SLT004"] * 4
+
+
+def test_slt004_seeded_and_measurement_clocks_are_clean(tmp_path):
+    findings = _lint(tmp_path, "transport/chaos.py", """
+        import random
+        import time
+        import numpy as np
+        def draw(seed):
+            rng = random.Random(seed)
+            rs = np.random.RandomState(seed & 0x7FFFFFFF)
+            t0 = time.perf_counter()
+            time.sleep(0.0)
+            return rng.random(), rs.rand(), time.monotonic() - t0
+    """)
+    assert findings == []
+
+
+def test_slt004_flags_nondet_import(tmp_path):
+    findings = _lint(tmp_path, "transport/codec.py", """
+        from random import shuffle
+    """)
+    assert _rules(findings) == ["SLT004"]
+
+
+def test_slt004_out_of_scope(tmp_path):
+    findings = _lint(tmp_path, "launch/cli.py", """
+        import time
+        def now():
+            return time.time()
+    """)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------- #
+# SLT005: lock-order cycles
+# ---------------------------------------------------------------------- #
+
+def test_slt005_direct_cycle(tmp_path):
+    findings = _lint(tmp_path, "runtime/sharded.py", """
+        class S:
+            def a(self):
+                with self._alpha_lock:
+                    with self._beta_lock:
+                        pass
+            def b(self):
+                with self._beta_lock:
+                    with self._alpha_lock:
+                        pass
+    """)
+    assert _rules(findings) == ["SLT005"]
+
+
+def test_slt005_transitive_cycle_through_method_call(tmp_path):
+    findings = _lint(tmp_path, "runtime/sharded.py", """
+        class S:
+            def outer(self):
+                with self._alpha_lock:
+                    self.inner()
+            def inner(self):
+                with self._beta_lock:
+                    pass
+            def rev(self):
+                with self._beta_lock:
+                    with self._alpha_lock:
+                        pass
+    """)
+    assert _rules(findings) == ["SLT005"]
+
+
+def test_slt005_consistent_order_is_clean(tmp_path):
+    findings = _lint(tmp_path, "runtime/sharded.py", """
+        class S:
+            def a(self):
+                with self._alpha_lock:
+                    with self._beta_lock:
+                        pass
+            def b(self):
+                with self._alpha_lock:
+                    with self._beta_lock:
+                        pass
+    """)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------- #
+# engine: exit codes, waiver file, real tree
+# ---------------------------------------------------------------------- #
+
+def test_main_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "runtime" / "server.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(textwrap.dedent("""
+        import numpy as np
+        class ServerRuntime:
+            def f(self):
+                with self._lock:
+                    return np.asarray(self.dev)
+    """))
+    assert engine.main([str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "SLT001" in out and "server.py:6" in out  # file:line carried
+    bad.write_text("x = 1\n")
+    assert engine.main([str(tmp_path)]) == 0
+
+
+def test_waiver_file_scoped_waiver(tmp_path):
+    bad = tmp_path / "runtime" / "server.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(textwrap.dedent("""
+        import numpy as np
+        class ServerRuntime:
+            def f(self):
+                with self._lock:
+                    return np.asarray(self.dev)
+    """))
+    wf = tmp_path / "waivers"
+    wf.write_text("SLT001 runtime/server.py quarantined pending refactor\n")
+    assert engine.main([str(tmp_path), "--waiver-file", str(wf)]) == 0
+    wf.write_text("SLT001\n")  # malformed: no path/reason
+    assert engine.main([str(tmp_path), "--waiver-file", str(wf)]) == 1
+
+
+def test_syntax_error_is_a_finding(tmp_path):
+    p = tmp_path / "runtime" / "broken.py"
+    p.parent.mkdir(parents=True)
+    p.write_text("def f(:\n")
+    findings = engine.lint_file(str(p))
+    assert _rules(findings) == ["SLT000"]
+
+
+def test_list_rules(capsys):
+    assert engine.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("SLT001", "SLT002", "SLT003", "SLT004", "SLT005"):
+        assert rule in out
+
+
+def test_real_tree_has_zero_unwaived_findings():
+    """The acceptance gate: the shipped tree lints clean."""
+    findings = engine.lint_paths([str(REPO / "split_learning_tpu"),
+                                  str(REPO / "scripts")],
+                                 waiver_file=str(REPO / ".slt-lint.waivers"))
+    unwaived = [f for f in findings if not f.waived]
+    assert unwaived == [], "\n".join(f.format() for f in unwaived)
+
+
+# ---------------------------------------------------------------------- #
+# spans registry: drift guards
+# ---------------------------------------------------------------------- #
+
+def test_trace_reexports_spans_tuples():
+    assert obs_trace.CLIENT_PHASES == spans.CLIENT_PHASES
+    assert obs_trace.SERVER_PHASES == spans.SERVER_PHASES
+
+
+def test_trace_report_fallback_matches_registry():
+    """scripts/trace_report.py runs standalone, so it keeps a literal
+    fallback copy of the phase tuples — pinned here to the registry."""
+    tree = ast.parse((REPO / "scripts" / "trace_report.py").read_text())
+    fallback = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Try):
+            continue
+        for h in node.handlers:
+            if getattr(h.type, "id", None) != "ImportError":
+                continue
+            for s in h.body:
+                if (isinstance(s, ast.Assign)
+                        and isinstance(s.targets[0], ast.Name)):
+                    fallback[s.targets[0].id] = ast.literal_eval(s.value)
+    assert fallback["CLIENT_PHASES"] == spans.CLIENT_PHASES
+    assert fallback["TRANSPORT_SUB"] == spans.TRANSPORT_SUB
+
+
+def test_analysis_package_is_stdlib_only():
+    """The CI lint step must not need jax/numpy: the analysis package
+    imports nothing outside the stdlib and itself."""
+    import importlib
+    for mod in ("engine", "rules", "cfg"):
+        m = importlib.import_module(f"split_learning_tpu.analysis.{mod}")
+        src = Path(m.__file__).read_text()
+        tree = ast.parse(src)
+        for node in ast.walk(tree):
+            names = []
+            if isinstance(node, ast.Import):
+                names = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                names = [node.module]
+            for name in names:
+                root = name.split(".")[0]
+                assert root not in ("jax", "numpy", "requests"), (
+                    f"{mod}.py imports {name}")
+
+
+# ---------------------------------------------------------------------- #
+# obs/locks.py: the dynamic watchdog
+# ---------------------------------------------------------------------- #
+
+def test_intentional_inversion_is_detected():
+    g = locks.LockGraph()
+    a = locks.InstrumentedLock("A", graph=g, budget_s=None)
+    b = locks.InstrumentedLock("B", graph=g, budget_s=None)
+    with a:
+        with b:
+            pass
+    assert g.violations == []  # one order alone is fine
+    with b:
+        with a:
+            pass
+    kinds = [v["kind"] for v in g.violations]
+    assert kinds == ["lock-order-inversion"]
+    msg = g.violations[0]["message"]
+    assert "A" in msg and "B" in msg
+    # repeated inversions of the same pair are reported once
+    with b:
+        with a:
+            pass
+    assert len(g.violations) == 1
+
+
+def test_hold_budget_violation():
+    g = locks.LockGraph()
+    h = locks.InstrumentedLock("H", graph=g, budget_s=0.001)
+    with h:
+        time.sleep(0.01)
+    assert [v["kind"] for v in g.violations] == ["hold-budget"]
+    ok = locks.InstrumentedLock("OK", graph=g, budget_s=10.0)
+    with ok:
+        pass
+    assert len(g.violations) == 1
+
+
+def test_reentrant_acquire_is_not_an_edge_and_hold_spans_outermost():
+    g = locks.LockGraph()
+    reg = Registry()
+    l = locks.InstrumentedLock("R", graph=g, registry=reg, budget_s=None)
+    with l:
+        with l:  # reentrant
+            pass
+    assert g.violations == [] and g.edges == {}
+    snap = reg.snapshot()["histograms"]
+    assert snap[spans.LOCK_HOLD]["count"] == 1  # one outermost hold
+
+
+def test_condition_interop():
+    g = locks.LockGraph()
+    cv = threading.Condition(locks.InstrumentedLock("CV", graph=g,
+                                                    budget_s=None))
+    hits = []
+
+    def waiter():
+        with cv:
+            while not hits:
+                cv.wait(timeout=5.0)
+            hits.append("woke")
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with cv:
+        hits.append("notified")
+        cv.notify()
+    t.join(timeout=5.0)
+    assert not t.is_alive() and hits == ["notified", "woke"]
+    assert g.violations == []
+
+
+def test_make_lock_off_returns_plain_threading_primitives(monkeypatch):
+    monkeypatch.delenv("SLT_LOCK_DEBUG", raising=False)
+    assert isinstance(locks.make_lock("x"), type(threading.RLock()))
+    assert isinstance(locks.make_lock("x", reentrant=False),
+                      type(threading.Lock()))
+
+
+def test_make_lock_on_instruments_runtime_components(monkeypatch):
+    monkeypatch.setenv("SLT_LOCK_DEBUG", "1")
+    from split_learning_tpu.runtime.coalesce import RequestCoalescer
+    from split_learning_tpu.runtime.replay import ReplayCache
+    assert isinstance(locks.make_lock("x"), locks.InstrumentedLock)
+    cache = ReplayCache()
+    assert isinstance(cache._lock, locks.InstrumentedLock)
+    co = RequestCoalescer(lambda group, reason: None, max_group=2,
+                          window_s=0.0)
+    try:
+        assert isinstance(co._cond._lock, locks.InstrumentedLock)
+    finally:
+        co.close()
+
+
+def test_watchdog_loss_series_bit_identical(monkeypatch):
+    """SLT_LOCK_DEBUG instruments the locks and nothing else: the same
+    three steps produce a bit-identical loss series on and off — and
+    the off path (the shipped default) uses plain threading locks, so
+    the wire cannot change."""
+    from split_learning_tpu.models import get_plan
+    from split_learning_tpu.runtime import ServerRuntime, SplitClientTrainer
+    from split_learning_tpu.transport.local import LocalTransport
+    from split_learning_tpu.utils import Config
+
+    def series(debug):
+        if debug:
+            monkeypatch.setenv("SLT_LOCK_DEBUG", "1")
+        else:
+            monkeypatch.delenv("SLT_LOCK_DEBUG", raising=False)
+        cfg = Config(mode="split", batch_size=4, num_clients=1)
+        plan = get_plan(mode="split")
+        sample = np.zeros((4, 28, 28, 1), np.float32)
+        server = ServerRuntime(plan, cfg, jax.random.PRNGKey(2), sample)
+        if debug:
+            assert isinstance(server._lock, locks.InstrumentedLock)
+        else:
+            assert isinstance(server._lock, type(threading.RLock()))
+        client = SplitClientTrainer(plan, cfg, jax.random.PRNGKey(0),
+                                    LocalTransport(server))
+        rs = np.random.RandomState(7)
+        try:
+            return [client.train_step(
+                rs.randn(4, 28, 28, 1).astype(np.float32),
+                rs.randint(0, 10, 4).astype(np.int64), i)
+                for i in range(3)]
+        finally:
+            server.close()
+
+    on = series(True)
+    assert locks.default_graph().violations == []
+    assert on == series(False)

@@ -14,6 +14,7 @@ import pytest
 
 from split_learning_tpu import obs
 from split_learning_tpu.data.datasets import DevicePrefetch
+from split_learning_tpu.obs import locks
 from split_learning_tpu.models import get_plan
 from split_learning_tpu.obs.metrics import (Histogram, histogram_percentile,
                                             render_prometheus)
@@ -127,15 +128,21 @@ def test_concurrent_smoke_records_d2h_off_lock():
 
     hists = snap["histograms"]
     assert hists["d2h"]["count"] == 4
-    assert hists["lock_hold"]["count"] == 4
-    # lock-held window excludes the materialization: its p50 sits far
-    # below the padded transfer the old taxonomy would have absorbed
-    assert histogram_percentile(hists["lock_hold"], 50) < d2h / 2
-    assert histogram_percentile(hists["dispatch"], 50) < d2h / 2
-
     text = render_prometheus(snap)
-    assert "slt_lock_hold_seconds_count 4" in text
     assert "slt_d2h_seconds_count 4" in text
+    # under SLT_LOCK_DEBUG=1 the obs/locks.py watchdog also feeds
+    # lock_hold (one observation per outermost acquisition, warmup
+    # included), so the exact traced-step tally only holds watchdog-off
+    if locks.enabled():
+        assert hists["lock_hold"]["count"] >= 4
+    else:
+        assert hists["lock_hold"]["count"] == 4
+        assert "slt_lock_hold_seconds_count 4" in text
+        # lock-held window excludes the materialization: its p50 sits
+        # far below the padded transfer the old taxonomy would have
+        # absorbed
+        assert histogram_percentile(hists["lock_hold"], 50) < d2h / 2
+    assert histogram_percentile(hists["dispatch"], 50) < d2h / 2
 
 
 def test_histogram_percentile():
