@@ -656,26 +656,42 @@ def measure_topk8(quick: bool) -> dict:
                     "steps/sec barely moves with payload size"),
            "valid": True, "invalid_reason": None}
     finals = {}
-    for mode in ("none", "int8", "topk8"):
-        runtime = ServerRuntime(plan, cfg, jax.random.PRNGKey(0), data[0][0])
-        transport = _DelayedLocal(
-            LocalTransport(runtime, compress=mode, density=density), delay)
-        client = SplitClientTrainer(plan, cfg, jax.random.PRNGKey(0),
-                                    transport)
-        losses = []
-        t0 = time.perf_counter()
-        for i, (xb, yb) in enumerate(data):
-            losses.append(client.train_step(xb, yb, i))
-        dt = time.perf_counter() - t0
-        s = transport.stats.summary()
-        out[f"bytes_per_step_{mode}"] = (
-            (s["bytes_sent"] + s["bytes_received"]) / steps)
-        out[f"final_loss_{mode}"] = float(np.mean(losses[-tail:]))
-        out[f"steps_per_sec_{mode}"] = steps / dt
-        if mode == "topk8" and s.get("compression_ratio"):
-            out["codec_compression_ratio"] = s["compression_ratio"]
-        finals[mode] = out[f"final_loss_{mode}"]
-        transport.close()
+    # dispatch watchdog on for the whole leg (in-process force, not the
+    # env gate): counts XLA compiles and flags any steady-state recompile
+    from split_learning_tpu.obs import dispatch_debug
+    dd = dispatch_debug.tracker()
+    g0 = dd.gauges()
+    dispatch_debug.force(True)
+    try:
+        for mode in ("none", "int8", "topk8"):
+            runtime = ServerRuntime(plan, cfg, jax.random.PRNGKey(0),
+                                    data[0][0])
+            transport = _DelayedLocal(
+                LocalTransport(runtime, compress=mode, density=density),
+                delay)
+            client = SplitClientTrainer(plan, cfg, jax.random.PRNGKey(0),
+                                        transport)
+            losses = []
+            t0 = time.perf_counter()
+            for i, (xb, yb) in enumerate(data):
+                losses.append(client.train_step(xb, yb, i))
+            dt = time.perf_counter() - t0
+            s = transport.stats.summary()
+            out[f"bytes_per_step_{mode}"] = (
+                (s["bytes_sent"] + s["bytes_received"]) / steps)
+            out[f"final_loss_{mode}"] = float(np.mean(losses[-tail:]))
+            out[f"steps_per_sec_{mode}"] = steps / dt
+            if mode == "topk8" and s.get("compression_ratio"):
+                out["codec_compression_ratio"] = s["compression_ratio"]
+            finals[mode] = out[f"final_loss_{mode}"]
+            transport.close()
+    finally:
+        dispatch_debug.force(False)
+    g1 = dd.gauges()
+    out["compile_count"] = {
+        "total": g1["compile_count"] - g0["compile_count"],
+        "steady_state": (g1["steady_state_recompiles"]
+                         - g0["steady_state_recompiles"])}
 
     out["bytes_per_step"] = out["bytes_per_step_topk8"]
     out["byte_reduction_vs_fp32"] = (out["bytes_per_step_none"]
@@ -694,6 +710,11 @@ def measure_topk8(quick: bool) -> dict:
     if not quick and out["loss_parity"] > 0.05:
         problems.append(f"loss_parity={out['loss_parity']:.4f} > 0.05: "
                         "topk8 tail loss diverges from dense")
+    if out["compile_count"]["steady_state"]:
+        problems.append(
+            f"steady_state_recompiles="
+            f"{out['compile_count']['steady_state']:.0f} != 0: the hot "
+            "loop retraces after step 2")
     if problems:
         out["valid"] = False
         out["invalid_reason"] = "; ".join(problems)
@@ -1033,35 +1054,45 @@ def measure_coalesced(quick: bool) -> dict:
         def close(self):
             self.inner.close()
 
+    # dispatch watchdog on for every timed run (in-process force, not
+    # the env gate): counts XLA compiles, flags steady-state recompiles
+    from split_learning_tpu.obs import dispatch_debug
+    dd = dispatch_debug.tracker()
+
     def run(coalesce_max: int, concurrent: bool, wire_delay: float,
             overlap: bool = True, d2h_delay: float = 0.0):
-        server = ServerRuntime(
-            plan, cfg, jax.random.PRNGKey(0), x[0, 0],
-            coalesce_max=coalesce_max,
-            overlap=overlap, d2h_delay_s=d2h_delay,
-            # generous window: the group should close full when the
-            # clients really are concurrent, not on the timer
-            coalesce_window_ms=max(2 * wire_delay * 1e3, 5.0))
-        runner = MultiClientSplitRunner(
-            plan, cfg, jax.random.PRNGKey(1),
-            lambda i: _DelayedLocal(LocalTransport(server), wire_delay)
-            if wire_delay else LocalTransport(server),
-            num_clients=n_clients, concurrent=concurrent)
+        dispatch_debug.force(True)
         try:
-            for r in range(warm):
-                runner.train_round(list(zip(x[r], y[r])))
-            t0 = time.perf_counter()
-            for r in range(warm, rounds):
-                runner.train_round(list(zip(x[r], y[r])))
-            dt = time.perf_counter() - t0
-            health = server.health()
+            server = ServerRuntime(
+                plan, cfg, jax.random.PRNGKey(0), x[0, 0],
+                coalesce_max=coalesce_max,
+                overlap=overlap, d2h_delay_s=d2h_delay,
+                # generous window: the group should close full when the
+                # clients really are concurrent, not on the timer
+                coalesce_window_ms=max(2 * wire_delay * 1e3, 5.0))
+            runner = MultiClientSplitRunner(
+                plan, cfg, jax.random.PRNGKey(1),
+                lambda i: _DelayedLocal(LocalTransport(server), wire_delay)
+                if wire_delay else LocalTransport(server),
+                num_clients=n_clients, concurrent=concurrent)
+            try:
+                for r in range(warm):
+                    runner.train_round(list(zip(x[r], y[r])))
+                t0 = time.perf_counter()
+                for r in range(warm, rounds):
+                    runner.train_round(list(zip(x[r], y[r])))
+                dt = time.perf_counter() - t0
+                health = server.health()
+            finally:
+                runner.close()
+                server.close()
         finally:
-            runner.close()
-            server.close()
+            dispatch_debug.force(False)
         return (rounds - warm) * n_clients / dt, health.get("coalescing")
 
     # headline pair: synthetic wire, serialized relay vs concurrent +
     # coalescing server
+    g0 = dd.gauges()
     sps_serialized, _ = run(1, False, delay)
     sps_coalesced, co = run(n_clients, True, delay)
     # raw loopback pair: no wire to hide, shared cores convoy — reported
@@ -1083,6 +1114,11 @@ def measure_coalesced(quick: bool) -> dict:
     sps_overlap_off, _ = run(1, True, delay, overlap=False,
                              d2h_delay=d2h_delay)
     overlap_speedup = sps_overlap_on / sps_overlap_off
+    g1 = dd.gauges()
+    compile_count = {
+        "total": g1["compile_count"] - g0["compile_count"],
+        "steady_state": (g1["steady_state_recompiles"]
+                         - g0["steady_state_recompiles"])}
 
     # parity guard (exact math, no sleeps): a single client against a
     # coalescing server makes every group a window flush of one, which
@@ -1215,6 +1251,11 @@ def measure_coalesced(quick: bool) -> dict:
             f"lock_hold p50 {lock_hold_p50 * 1e3:.2f} ms is not below "
             f"the no-overlap dispatch p50 {dispatch_off_p50 * 1e3:.2f} ms: "
             "the lock is still covering the materialization")
+    elif compile_count["steady_state"]:
+        invalid_reason = (
+            f"steady_state_recompiles={compile_count['steady_state']:.0f}"
+            " != 0: the coalesced/serialized hot loops retrace after "
+            "step 2 (the pow2-pad signature set is not holding)")
     return {
         "leg": "multi_client_coalesced",
         "clients": n_clients,
@@ -1232,6 +1273,7 @@ def measure_coalesced(quick: bool) -> dict:
         "steps_per_sec_serialized": sps_serialized,
         "steps_per_sec_coalesced": sps_coalesced,
         "speedup_vs_serialized": speedup,
+        "compile_count": compile_count,
         "phases": phases,
         "coalescing": co,
         "mean_occupancy": occupancy,

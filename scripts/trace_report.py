@@ -38,10 +38,12 @@ from typing import Any, Dict, List
 # runs parsing (and reporting 0) without it, the tolerant-parser
 # contract.
 try:
-    from split_learning_tpu.obs.spans import CLIENT_PHASES, TRANSPORT_SUB
+    from split_learning_tpu.obs.spans import (CLIENT_PHASES, COMPILE,
+                                              TRANSPORT_SUB)
 except ImportError:
     CLIENT_PHASES = ("client_fwd", "transport", "client_bwd", "opt_apply")
     TRANSPORT_SUB = ("encode", "wire", "queue_wait", "dispatch", "d2h")
+    COMPILE = "xla_compile"
 
 
 def load_events(path: str) -> List[Dict[str, Any]]:
@@ -121,6 +123,30 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         ratios.append(sum(slot.get(p, 0.0) for p in CLIENT_PHASES) / wall)
     coverage = sum(ratios) / len(ratios) if ratios else None
 
+    # compile events (obs/dispatch_debug.py under SLT_DISPATCH_DEBUG=1):
+    # args.step carries the step scope's local ordinal, so "steady"
+    # (ordinal >= 2) compiles are the recompile storm this table makes
+    # visible. Tolerant: absent/non-numeric step fields count as
+    # non-steady instead of raising.
+    compile_durs: List[float] = []
+    steady_compiles = 0
+    for e in spans:
+        if e.get("name") != COMPILE:
+            continue
+        compile_durs.append(float(e.get("dur", 0.0)) / 1e6)
+        try:
+            step = int((e.get("args") or {}).get("step", -1))
+        except (TypeError, ValueError):
+            step = -1
+        if step >= 2:
+            steady_compiles += 1
+    compile_summary = {
+        "count": len(compile_durs),
+        "total_s": sum(compile_durs),
+        "max_ms": max(compile_durs) * 1e3 if compile_durs else 0.0,
+        "steady_state_count": steady_compiles,
+    }
+
     return {
         "events": len(events),
         "spans": len(spans),
@@ -129,6 +155,7 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "client_phase_mix": client_mix,
         "transport_fraction": client_mix.get("transport", 0.0),
         "transport_decomposition_s": tsub,
+        "compile": compile_summary,
         "span_sum_over_wall_clock": coverage,
     }
 
@@ -153,6 +180,15 @@ def render(rep: Dict[str, Any]) -> str:
     lines.append("transport decomposition (total seconds):")
     for name, s in rep["transport_decomposition_s"].items():
         lines.append(f"  {name:<12} {s:>9.4f}")
+    comp = rep.get("compile") or {}
+    if comp.get("count"):
+        lines.append("")
+        lines.append(
+            f"xla compiles: {comp['count']} "
+            f"({comp['total_s']:.4f}s total, max {comp['max_ms']:.3f}ms); "
+            f"steady-state (step >= 2): {comp['steady_state_count']}"
+            + ("  <-- recompile storm"
+               if comp["steady_state_count"] else ""))
     cov = rep["span_sum_over_wall_clock"]
     if cov is not None:
         lines.append("")

@@ -26,8 +26,9 @@ import re
 import sys
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
-from split_learning_tpu.analysis.rules import (Finding, RULES, Src,
-                                               run_rules)
+from split_learning_tpu.analysis.rules import (Finding, PROJECT_RULES,
+                                               RULES, Src, run_rules,
+                                               run_project_rules)
 
 _WAIVER_RE = re.compile(
     r"#\s*slt-lint:\s*disable=([A-Z0-9,\s]+?)\s*\(([^)]*)\)")
@@ -81,7 +82,8 @@ def _load_waiver_file(path: str) -> Tuple[List[Tuple[str, str, str]],
         if not stripped or stripped.startswith("#"):
             continue
         parts = stripped.split(None, 2)
-        if len(parts) < 3 or parts[0] not in RULES:
+        if len(parts) < 3 or (parts[0] not in RULES
+                              and parts[0] not in PROJECT_RULES):
             problems.append(Finding(
                 "SLT000", path, lineno,
                 "malformed waiver-file entry — expected "
@@ -104,32 +106,46 @@ def iter_py_files(paths: Iterable[str]) -> Iterable[str]:
                     yield os.path.join(root, f)
 
 
-def lint_file(path: str,
-              file_waivers: Optional[List[Tuple[str, str, str]]] = None
-              ) -> List[Finding]:
+def _waive(f: Finding,
+           inline: Dict[int, Tuple[Set[str], str]],
+           file_waivers: Optional[List[Tuple[str, str, str]]],
+           posix: str) -> Finding:
+    waived, reason = False, ""
+    hit = inline.get(f.line)
+    if hit is not None and f.rule in hit[0]:
+        waived, reason = True, hit[1]
+    if not waived and file_waivers:
+        for rule, suffix, wf_reason in file_waivers:
+            if rule == f.rule and posix.endswith(suffix):
+                waived, reason = True, wf_reason
+                break
+    return Finding(f.rule, f.path, f.line, f.message,
+                   waived=waived, reason=reason)
+
+
+def _parse_src(path: str) -> Tuple[Optional[Src], List[Finding],
+                                   Dict[int, Tuple[Set[str], str]]]:
     with open(path, encoding="utf-8") as fh:
         text = fh.read()
     try:
         tree = ast.parse(text, filename=path)
     except SyntaxError as exc:
-        return [Finding("SLT000", path, exc.lineno or 1,
-                        f"cannot parse: {exc.msg}")]
+        return None, [Finding("SLT000", path, exc.lineno or 1,
+                              f"cannot parse: {exc.msg}")], {}
     src = Src(path=path, posix=_posix(path), tree=tree, text=text)
-    findings = run_rules(src)
     inline, problems = _parse_inline_waivers(text, path)
+    return src, problems, inline
+
+
+def lint_file(path: str,
+              file_waivers: Optional[List[Tuple[str, str, str]]] = None
+              ) -> List[Finding]:
+    src, problems, inline = _parse_src(path)
+    if src is None:
+        return problems
     out: List[Finding] = list(problems)
-    for f in findings:
-        waived, reason = False, ""
-        hit = inline.get(f.line)
-        if hit is not None and f.rule in hit[0]:
-            waived, reason = True, hit[1]
-        if not waived and file_waivers:
-            for rule, suffix, wf_reason in file_waivers:
-                if rule == f.rule and src.posix.endswith(suffix):
-                    waived, reason = True, wf_reason
-                    break
-        out.append(Finding(f.rule, f.path, f.line, f.message,
-                           waived=waived, reason=reason))
+    for f in run_rules(src):
+        out.append(_waive(f, inline, file_waivers, src.posix))
     return out
 
 
@@ -142,8 +158,23 @@ def lint_paths(paths: Iterable[str],
     if waiver_file:
         file_waivers, problems = _load_waiver_file(waiver_file)
     findings = list(problems)
+    srcs: List[Src] = []
+    inline_by_posix: Dict[str, Dict[int, Tuple[Set[str], str]]] = {}
     for path in iter_py_files(paths):
-        findings.extend(lint_file(path, file_waivers))
+        src, file_problems, inline = _parse_src(path)
+        findings.extend(file_problems)
+        if src is None:
+            continue
+        srcs.append(src)
+        inline_by_posix[src.posix] = inline
+        for f in run_rules(src):
+            findings.append(_waive(f, inline, file_waivers, src.posix))
+    # project rules see the whole parsed tree at once (cross-file
+    # pairing); waivers apply against the file each finding lands in
+    for f in run_project_rules(srcs):
+        posix = _posix(f.path)
+        findings.append(_waive(f, inline_by_posix.get(posix, {}),
+                               file_waivers, posix))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings
 
@@ -162,7 +193,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = parser.parse_args(argv)
 
     if args.list_rules:
-        for rule_id, (_fn, doc) in sorted(RULES.items()):
+        catalog = {**RULES, **PROJECT_RULES}
+        for rule_id, (_fn, doc) in sorted(catalog.items()):
             print(f"{rule_id}: {doc}")
         return 0
 

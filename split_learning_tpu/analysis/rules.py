@@ -606,3 +606,21 @@ def run_rules(src: Src) -> List[Finding]:
     for _rule_id, (fn, _doc) in sorted(RULES.items()):
         out.extend(fn(src))
     return out
+
+
+# Phase-2 rules live in their own module; the import sits at the bottom
+# because rules_jax needs Finding/Src and the shared helpers above.
+from split_learning_tpu.analysis import rules_jax as _rules_jax  # noqa: E402
+
+RULES.update(_rules_jax.RULES)
+
+# Project rules see every parsed file at once (cross-file pairing);
+# the engine runs them after the per-file loop.
+PROJECT_RULES = dict(_rules_jax.PROJECT_RULES)
+
+
+def run_project_rules(srcs) -> List[Finding]:
+    out: List[Finding] = []
+    for _rule_id, (fn, _doc) in sorted(PROJECT_RULES.items()):
+        out.extend(fn(srcs))
+    return out

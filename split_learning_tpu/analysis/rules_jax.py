@@ -1,0 +1,886 @@
+"""slt-lint phase 2: JAX dispatch-hygiene rules (ISSUE 7).
+
+Phase 1 (rules.py) guards the concurrency invariants; these five guard
+the dispatch discipline the jit hot path rests on — the invariants that
+turn into silent compile storms or corrupted buffers instead of
+exceptions when broken:
+
+========  ==============================================================
+SLT006    use-after-donate — a variable passed in a ``donate_argnums``
+          position of a jitted callable is dead after the call; any
+          later read (before a rebind) sees an invalidated buffer
+SLT007    retrace hazards — varying Python literals at traced arg
+          positions, non-hashable static args, and jit-wrapped closures
+          capturing mutable ``self`` attributes (baked in at trace time,
+          silently stale forever after)
+SLT008    implicit host sync — ``bool()``/``if``/``while`` on a traced
+          value always blocks; ``float()``/``int()`` on one result of a
+          dispatch *before* the bulk ``np.asarray`` of another result
+          of the same dispatch serializes the transfer twice
+SLT009    PRNG key discipline — a key consumed twice (or consumed
+          inside a loop it was not bound in) without an interposed
+          ``split``/``fold_in`` reuses randomness
+SLT010    wire-schema contract (project-scope) — codec encode/decode
+          field sets, client/server HTTP payload keys, and the ctypes
+          bindings vs the exported C symbols must pair up exactly: a
+          field written on one side and never read on the other is dead
+          wire bytes or a latent KeyError
+========  ==============================================================
+
+Same engine, waiver syntax, and exit-code contract as phase 1. SLT010 is
+the first *project* rule: it sees every parsed file at once (engine.py
+``run_project_rules``) because its whole point is cross-file pairing.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from split_learning_tpu.analysis import cfg as cfg_mod
+from split_learning_tpu.analysis.rules import (Finding, Src,
+                                               _barrier_scan_roots,
+                                               _call_root, _in_dir, _unparse)
+
+
+# ---------------------------------------------------------------------- #
+# shared: the per-file registry of jitted callables
+# ---------------------------------------------------------------------- #
+
+@dataclasses.dataclass
+class _JitSite:
+    name: str                       # 'self._split_step' / bare local name
+    donate: Set[int]
+    static: Set[int]
+    fns: List[ast.AST]              # wrapped FunctionDef/Lambda, if resolvable
+    line: int
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    f = call.func
+    return (isinstance(f, ast.Attribute) and f.attr == "jit"
+            and _call_root(f) == "jax")
+
+
+def _argnums(call: ast.Call, kw_name: str) -> Set[int]:
+    for kw in call.keywords:
+        if kw.arg != kw_name:
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return {v.value}
+        if isinstance(v, (ast.Tuple, ast.List)):
+            return {e.value for e in v.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)}
+    return set()
+
+
+def _jit_registry(tree: ast.AST) -> Dict[str, _JitSite]:
+    """name -> _JitSite for every ``<target> = jax.jit(fn, ...)`` in the
+    file. Targets are bare names or ``self._attr`` chains; re-assignment
+    of the same name (fused.py builds mesh and non-mesh variants) merges
+    argnum sets and keeps every resolvable wrapped fn."""
+    local_fns: Dict[str, ast.AST] = {}
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            local_fns.setdefault(n.name, n)
+    reg: Dict[str, _JitSite] = {}
+    for n in ast.walk(tree):
+        if not (isinstance(n, ast.Assign) and len(n.targets) == 1
+                and isinstance(n.value, ast.Call)
+                and _is_jit_call(n.value)):
+            continue
+        t = n.targets[0]
+        if not isinstance(t, (ast.Name, ast.Attribute)):
+            continue
+        name = _unparse(t)
+        call = n.value
+        fns: List[ast.AST] = []
+        if call.args:
+            a0 = call.args[0]
+            if isinstance(a0, ast.Name) and a0.id in local_fns:
+                fns.append(local_fns[a0.id])
+            elif isinstance(a0, ast.Lambda):
+                fns.append(a0)
+        site = reg.get(name)
+        if site is None:
+            reg[name] = _JitSite(name, _argnums(call, "donate_argnums"),
+                                 _argnums(call, "static_argnums"),
+                                 fns, n.lineno)
+        else:
+            site.donate |= _argnums(call, "donate_argnums")
+            site.static |= _argnums(call, "static_argnums")
+            site.fns.extend(f for f in fns if f not in site.fns)
+    return reg
+
+
+def _own_stmts(fn: ast.AST) -> Iterator[ast.stmt]:
+    """Statements of ``fn`` excluding bodies of nested defs/lambdas —
+    those execute in their own frame, not here."""
+    stack: List[ast.stmt] = list(fn.body)
+    while stack:
+        s = stack.pop(0)
+        yield s
+        for child in ast.iter_child_nodes(s):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(child, ast.stmt):
+                stack.append(child)
+            else:
+                stack.extend(c for c in ast.walk(child)
+                             if isinstance(c, ast.stmt))
+    return
+
+
+def _target_names(t: ast.expr) -> Set[str]:
+    """Bound names of an assignment target: bare names and self-attr
+    chains (``self.state``); tuple/starred targets flatten."""
+    if isinstance(t, ast.Name):
+        return {t.id}
+    if isinstance(t, ast.Attribute):
+        return {_unparse(t)}
+    if isinstance(t, (ast.Tuple, ast.List)):
+        out: Set[str] = set()
+        for e in t.elts:
+            out |= _target_names(e)
+        return out
+    if isinstance(t, ast.Starred):
+        return _target_names(t.value)
+    return set()
+
+
+def _stmt_binds(stmt: ast.stmt) -> Set[str]:
+    targets: List[ast.expr] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        targets = [i.optional_vars for i in stmt.items
+                   if i.optional_vars is not None]
+    out: Set[str] = set()
+    for t in targets:
+        out |= _target_names(t)
+    return out
+
+
+def _reads_name(root: ast.AST, name: str) -> bool:
+    for n in ast.walk(root):
+        if (isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+                and n.id == name):
+            return True
+        if (isinstance(n, ast.Attribute) and isinstance(n.ctx, ast.Load)
+                and _unparse(n) == name):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------- #
+# SLT006: use-after-donate
+# ---------------------------------------------------------------------- #
+
+def _donating_calls(stmt: ast.stmt, donating: Dict[str, _JitSite]
+                    ) -> List[Tuple[str, List[str]]]:
+    """(callee name, donated variable exprs) for each donating call in
+    the statement. Only bare-name / self-attr args are trackable — a
+    donated temporary (``jnp.asarray(x)``) dies with the expression."""
+    out: List[Tuple[str, List[str]]] = []
+    nodes: List[ast.AST] = []
+    for root in _barrier_scan_roots(stmt):
+        nodes.extend(ast.walk(root))
+    for node in nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        site = donating.get(_unparse(node.func))
+        if site is None:
+            continue
+        exprs: List[str] = []
+        for pos in sorted(site.donate):
+            if pos < len(node.args):
+                a = node.args[pos]
+                if isinstance(a, (ast.Name, ast.Attribute)):
+                    exprs.append(_unparse(a))
+        if exprs:
+            out.append((site.name, exprs))
+    return out
+
+
+def check_slt006(src: Src) -> Iterator[Finding]:
+    if not _in_dir(src, "runtime", "parallel", "ops", "models"):
+        return
+    reg = _jit_registry(src.tree)
+    donating = {n: s for n, s in reg.items() if s.donate}
+    if not donating:
+        return
+    for fn in ast.walk(src.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        sites = [(stmt, call_name, exprs)
+                 for stmt in _own_stmts(fn)
+                 for call_name, exprs in _donating_calls(stmt, donating)]
+        if not sites:
+            continue
+        graph = cfg_mod.build(fn)
+        for stmt, call_name, exprs in sites:
+            rebound = _stmt_binds(stmt)
+            dead = [e for e in exprs if e not in rebound]
+            for var in dead:
+                hit = _first_read_after(graph, stmt, var)
+                if hit is not None:
+                    yield Finding(
+                        "SLT006", src.path, hit,
+                        f"{var!r} was donated to {call_name}() "
+                        f"(donate_argnums) at line {stmt.lineno} and is "
+                        f"read here — the buffer is invalidated by XLA; "
+                        f"rebind the call's result over it or drop the "
+                        f"donation")
+                    break  # one finding per donating statement
+
+
+def _first_read_after(graph: cfg_mod.CFG, stmt: ast.stmt,
+                      var: str) -> Optional[int]:
+    """Line of the first reachable read of ``var`` after ``stmt`` on any
+    path, or None. A statement that rebinds ``var`` without reading it
+    kills the search along that path."""
+    seen: Set[int] = set()
+    frontier: List[cfg_mod.Node] = []
+    for node in graph.nodes_for(stmt):
+        # normal flow only out of the donating statement itself: if the
+        # call raised, XLA never took ownership of the buffer
+        frontier.extend(t for t, c in node.succs
+                        if not (isinstance(c, tuple) and c
+                                and c[0] == "exc"))
+    while frontier:
+        node = frontier.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        s = node.stmt
+        if s is not None and s is not stmt:
+            reads = any(_reads_name(root, var)
+                        for root in _barrier_scan_roots(s))
+            if not reads and isinstance(s, ast.AugAssign):
+                # `var += x` reads the dead buffer even though the
+                # target ctx is Store
+                reads = var in _target_names(s.target)
+            if reads:
+                return s.lineno
+            if var in _stmt_binds(s):
+                continue  # rebound: the name is live again on this path
+        frontier.extend(t for t, _c in node.succs)
+    return None
+
+
+# ---------------------------------------------------------------------- #
+# SLT007: retrace hazards
+# ---------------------------------------------------------------------- #
+
+def _mutable_self_attrs(tree: ast.AST) -> Set[str]:
+    """Attributes assigned through ``self`` anywhere outside __init__ /
+    __post_init__ — the ones whose value can change after trace time."""
+    out: Set[str] = set()
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        for meth in cls.body:
+            if not isinstance(meth, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if meth.name in ("__init__", "__post_init__"):
+                continue
+            for n in ast.walk(meth):
+                if (isinstance(n, ast.Attribute)
+                        and isinstance(n.ctx, ast.Store)
+                        and isinstance(n.value, ast.Name)
+                        and n.value.id == "self"):
+                    out.add(n.attr)
+    return out
+
+
+_NONHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                ast.SetComp, ast.GeneratorExp)
+
+
+def check_slt007(src: Src) -> Iterator[Finding]:
+    if not _in_dir(src, "runtime", "parallel", "ops", "models"):
+        return
+    reg = _jit_registry(src.tree)
+    if not reg:
+        return
+
+    # (a) jit-wrapped closures capturing mutable self attributes: the
+    # closed-over value is baked in at trace time and NEVER retraces —
+    # the mutation is silently ignored forever after
+    mutable = _mutable_self_attrs(src.tree)
+    for site in reg.values():
+        for fn in site.fns:
+            for n in ast.walk(fn):
+                if (isinstance(n, ast.Attribute)
+                        and isinstance(n.value, ast.Name)
+                        and n.value.id == "self" and n.attr in mutable):
+                    what = ("assigns" if isinstance(n.ctx, ast.Store)
+                            else "closes over")
+                    yield Finding(
+                        "SLT007", src.path, n.lineno,
+                        f"jit-wrapped callable behind {site.name} {what} "
+                        f"mutable attribute 'self.{n.attr}' — the traced "
+                        f"value is frozen at compile time (no retrace on "
+                        f"change); pass it as an argument instead")
+
+    # call sites of each jitted name, for (b) and (c)
+    calls: Dict[str, List[ast.Call]] = {}
+    for n in ast.walk(src.tree):
+        if isinstance(n, ast.Call):
+            nm = _unparse(n.func)
+            if nm in reg:
+                calls.setdefault(nm, []).append(n)
+
+    for nm, cs in sorted(calls.items()):
+        site = reg[nm]
+        # (b) Python literals varying across call sites at a traced
+        # (non-static) position: every distinct value is a fresh trace
+        # signature hazard (shape/dtype feeds) and a precision trap
+        by_pos: Dict[int, List[Tuple[object, int]]] = {}
+        for c in cs:
+            for i, a in enumerate(c.args):
+                if i in site.static:
+                    # (c) static args must be hashable — a list/dict/set
+                    # literal raises at call time
+                    if isinstance(a, _NONHASHABLE):
+                        yield Finding(
+                            "SLT007", src.path, a.lineno,
+                            f"non-hashable literal passed at static arg "
+                            f"{i} of {nm}() — static_argnums values must "
+                            f"be hashable (use a tuple)")
+                    continue
+                if (isinstance(a, ast.Constant)
+                        and isinstance(a.value, (bool, int, float))):
+                    by_pos.setdefault(i, []).append((a.value, a.lineno))
+        for i, vals in sorted(by_pos.items()):
+            distinct = sorted({repr(v) for v, _l in vals})
+            if len(distinct) > 1:
+                line = max(l for _v, l in vals)
+                yield Finding(
+                    "SLT007", src.path, line,
+                    f"{nm}() is called with differing Python literals at "
+                    f"traced arg {i} across call sites ({', '.join(distinct)})"
+                    f" — if the value feeds a shape each one retraces; "
+                    f"mark the position static_argnums (intentional "
+                    f"per-value compile) or pass an array")
+
+
+# ---------------------------------------------------------------------- #
+# SLT008: implicit host sync on traced values
+# ---------------------------------------------------------------------- #
+
+def _match_traced(expr: ast.expr, traced: Dict[str, int]) -> Optional[str]:
+    if isinstance(expr, ast.Name) and expr.id in traced:
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        nm = _unparse(expr)
+        if nm in traced:
+            return nm
+    return None
+
+
+def check_slt008(src: Src) -> Iterator[Finding]:
+    if not _in_dir(src, "runtime", "ops", "parallel"):
+        return
+    reg = _jit_registry(src.tree)
+    if not reg:
+        return
+    for fn in ast.walk(src.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield from _slt008_fn(src, fn, reg)
+
+
+def _slt008_fn(src: Src, fn: ast.AST,
+               reg: Dict[str, _JitSite]) -> Iterator[Finding]:
+    traced: Dict[str, int] = {}   # var -> id of the producing dispatch
+    call_of: Dict[str, str] = {}  # var -> callee name (messages)
+    scalar_evts: List[Tuple[Tuple[int, int], int, str, int]] = []
+    bulk_evts: List[Tuple[Tuple[int, int], int]] = []
+    findings: List[Finding] = []
+    dispatch_id = 0
+
+    def pos(n: ast.AST) -> Tuple[int, int]:
+        return (n.lineno, n.col_offset)
+
+    stmts = sorted(_own_stmts(fn),
+                   key=lambda s: (s.lineno, s.col_offset))
+    for stmt in stmts:
+        roots = _barrier_scan_roots(stmt)
+        # control flow on a traced value blocks the dispatch pipeline
+        # right here, unconditionally
+        if isinstance(stmt, (ast.If, ast.While)):
+            var = _match_traced(stmt.test, traced)
+            if var is not None:
+                findings.append(Finding(
+                    "SLT008", src.path, stmt.lineno,
+                    f"branching on traced value {var!r} (result of "
+                    f"{call_of[var]}()) forces a blocking host sync "
+                    f"inside the hot path — materialize explicitly "
+                    f"first (np.asarray / jax.device_get)"))
+        for root in roots:
+            for n in ast.walk(root):
+                if not isinstance(n, ast.Call):
+                    continue
+                f = n.func
+                if (isinstance(f, ast.Name) and f.id == "bool"
+                        and n.args):
+                    var = _match_traced(n.args[0], traced)
+                    if var is not None:
+                        findings.append(Finding(
+                            "SLT008", src.path, n.lineno,
+                            f"bool() on traced value {var!r} (result of "
+                            f"{call_of[var]}()) is an implicit blocking "
+                            f"host sync — materialize explicitly first"))
+                elif (isinstance(f, ast.Name)
+                        and f.id in ("float", "int") and n.args):
+                    var = _match_traced(n.args[0], traced)
+                    if var is not None:
+                        scalar_evts.append((pos(n), traced[var], var,
+                                            n.lineno))
+                elif isinstance(f, ast.Attribute):
+                    root_nm = _call_root(f)
+                    is_bulk = ((f.attr == "asarray"
+                                and root_nm in ("np", "numpy"))
+                               or (f.attr == "device_get"
+                                   and root_nm == "jax"))
+                    if is_bulk and n.args:
+                        var = _match_traced(n.args[0], traced)
+                        if var is not None:
+                            bulk_evts.append((pos(n), traced[var]))
+        # bindings last: `g = np.asarray(g)` reads the traced value
+        # above, then rebinds the name to a host array
+        binds = _stmt_binds(stmt)
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)) and (
+                stmt.value is not None):
+            produced = any(isinstance(c, ast.Call)
+                           and _unparse(c.func) in reg
+                           for c in ast.walk(stmt.value))
+            if produced:
+                callee = next(_unparse(c.func)
+                              for c in ast.walk(stmt.value)
+                              if isinstance(c, ast.Call)
+                              and _unparse(c.func) in reg)
+                dispatch_id += 1
+                for b in binds:
+                    traced[b] = dispatch_id
+                    call_of[b] = callee
+                continue
+        for b in binds:
+            traced.pop(b, None)
+
+    for spos, did, var, line in scalar_evts:
+        # a bulk transfer of the same dispatch at or before the scalar
+        # means the pipeline already drained — only flag a scalar that
+        # jumps the queue ahead of a later bulk transfer
+        if any(bpos <= spos for bpos, bdid in bulk_evts if bdid == did):
+            continue
+        if any(bpos > spos for bpos, bdid in bulk_evts if bdid == did):
+            findings.append(Finding(
+                "SLT008", src.path, line,
+                f"float()/int() on {var!r} syncs the host on one result "
+                f"of {call_of.get(var, '?')}() while a bulk np.asarray "
+                f"of the same dispatch happens later — materialize the "
+                f"bulk transfer first (or in the same statement) so the "
+                f"device pipeline drains once"))
+    yield from findings
+
+
+# ---------------------------------------------------------------------- #
+# SLT009: PRNG key discipline
+# ---------------------------------------------------------------------- #
+
+def _is_jax_random(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if (isinstance(f, ast.Attribute) and _call_root(f) == "jax"
+            and isinstance(f.value, ast.Attribute)
+            and f.value.attr == "random"):
+        return f.attr
+    # `from jax import random` style: random.split(...)
+    if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+            and f.value.id == "random"):
+        return f.attr
+    return None
+
+
+_KEY_PRODUCERS = ("PRNGKey", "key", "split", "fold_in")
+_KEY_PARAM_RE = re.compile(r"(^|_)(key|rng)$")
+
+
+def check_slt009(src: Src) -> Iterator[Finding]:
+    if not _in_dir(src, "ops", "models", "data"):
+        return
+    for fn in ast.walk(src.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield from _slt009_fn(src, fn)
+
+
+def _slt009_fn(src: Src, fn: ast.AST) -> Iterator[Finding]:
+    loops = [(n.lineno, getattr(n, "end_lineno", n.lineno))
+             for n in _own_stmts(fn)
+             if isinstance(n, (ast.For, ast.AsyncFor, ast.While))]
+
+    def in_loop_not_bound_in(line: int, bind_line: int) -> bool:
+        return any(lo <= line <= hi and not (lo <= bind_line <= hi)
+                   for lo, hi in loops)
+
+    keys: Dict[str, Tuple[int, int]] = {}  # name -> (consumers, bind line)
+    for a in list(fn.args.posonlyargs) + list(fn.args.args) + list(
+            fn.args.kwonlyargs):
+        if _KEY_PARAM_RE.search(a.arg):
+            keys[a.arg] = (0, fn.lineno)
+
+    for stmt in sorted(_own_stmts(fn),
+                       key=lambda s: (s.lineno, s.col_offset)):
+        for root in _barrier_scan_roots(stmt):
+            for n in ast.walk(root):
+                if not isinstance(n, ast.Call):
+                    continue
+                rfn = _is_jax_random(n)
+                if rfn in ("split", "fold_in"):
+                    continue  # the sanctioned derivation ops
+                for a in n.args:
+                    if not (isinstance(a, ast.Name) and a.id in keys):
+                        continue
+                    count, bind_line = keys[a.id]
+                    if in_loop_not_bound_in(n.lineno, bind_line):
+                        yield Finding(
+                            "SLT009", src.path, n.lineno,
+                            f"PRNG key {a.id!r} (bound at line "
+                            f"{bind_line}) is consumed inside a loop — "
+                            f"every iteration reuses the same "
+                            f"randomness; split/fold_in per iteration")
+                        keys[a.id] = (count, bind_line)
+                        continue
+                    count += 1
+                    keys[a.id] = (count, bind_line)
+                    if count == 2:
+                        yield Finding(
+                            "SLT009", src.path, n.lineno,
+                            f"PRNG key {a.id!r} flows to a second "
+                            f"consumer without an interposed split/"
+                            f"fold_in — both draws see identical "
+                            f"randomness")
+        # (re)bindings: fresh key from PRNGKey/split/fold_in resets the
+        # consumer count; any other RHS takes the name out of play
+        binds = _stmt_binds(stmt)
+        if binds and isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            value = stmt.value
+            fresh = (value is not None and any(
+                isinstance(c, ast.Call)
+                and _is_jax_random(c) in _KEY_PRODUCERS
+                for c in ast.walk(value)))
+            for b in binds:
+                if "." in b:
+                    continue
+                if fresh:
+                    keys[b] = (0, stmt.lineno)
+                else:
+                    keys.pop(b, None)
+
+
+# ---------------------------------------------------------------------- #
+# SLT010: wire-schema contract (project-scope)
+# ---------------------------------------------------------------------- #
+
+def _module_str_consts(tree: ast.AST) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    body = tree.body if isinstance(tree, ast.Module) else []
+    for n in body:
+        if (isinstance(n, ast.Assign) and len(n.targets) == 1
+                and isinstance(n.targets[0], ast.Name)
+                and isinstance(n.value, ast.Constant)
+                and isinstance(n.value.value, str)):
+            out[n.targets[0].id] = n.value.value
+    return out
+
+
+def _const_key(node: Optional[ast.expr],
+               consts: Dict[str, str]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    return None
+
+
+def _dict_keys(d: ast.Dict, consts: Dict[str, str]) -> Set[str]:
+    out: Set[str] = set()
+    for k in d.keys:
+        kk = _const_key(k, consts)
+        if kk is not None:
+            out.add(kk)
+    return out
+
+
+def _key_reads(root: ast.AST, consts: Dict[str, str],
+               recv_ok=None, hard_only: bool = False) -> Set[str]:
+    """Constant keys read via ``x[k]``, ``x.get(k…)``/``x.pop(k…)``, and
+    ``k in x``. ``hard_only`` keeps only the subscript form (reads that
+    raise when the field is missing). ``recv_ok`` filters the receiver
+    expression."""
+    reads: Set[str] = set()
+    for n in ast.walk(root):
+        if (isinstance(n, ast.Subscript) and isinstance(n.ctx, ast.Load)):
+            k = _const_key(n.slice, consts)
+            if k is not None and (recv_ok is None or recv_ok(n.value)):
+                reads.add(k)
+        elif hard_only:
+            continue
+        elif (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+                and n.func.attr in ("get", "pop") and n.args):
+            k = _const_key(n.args[0], consts)
+            if k is not None and (recv_ok is None
+                                  or recv_ok(n.func.value)):
+                reads.add(k)
+        elif (isinstance(n, ast.Compare) and len(n.ops) == 1
+                and isinstance(n.ops[0], (ast.In, ast.NotIn))):
+            k = _const_key(n.left, consts)
+            if k is not None and (recv_ok is None
+                                  or recv_ok(n.comparators[0])):
+                reads.add(k)
+    return reads
+
+
+def _fn_writes(fn: ast.AST, consts: Dict[str, str]) -> Set[str]:
+    """Keys written anywhere in ``fn``: dict literals, ``d.update(k=…)``
+    keywords, and ``d[k] = …`` stores."""
+    writes: Set[str] = set()
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Dict):
+            writes |= _dict_keys(n, consts)
+        elif (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "update"):
+            writes |= {kw.arg for kw in n.keywords if kw.arg}
+        elif (isinstance(n, ast.Subscript)
+                and isinstance(n.ctx, ast.Store)):
+            k = _const_key(n.slice, consts)
+            if k is not None:
+                writes.add(k)
+    return writes
+
+
+def _slt010_codec(src: Src) -> Iterator[Finding]:
+    """Pair each ``<stem>_compress`` writer against every other function
+    in the codec module (``<stem>_decompress``, the ``is_<stem>`` tag
+    check, byte accounting): a field only one side knows about is dead
+    wire bytes or a latent KeyError."""
+    consts = _module_str_consts(src.tree)
+    fns = {n.name: n for n in src.tree.body
+           if isinstance(n, ast.FunctionDef)} if isinstance(
+               src.tree, ast.Module) else {}
+    for name, fn in sorted(fns.items()):
+        m = re.match(r"(\w+?)_compress$", name)
+        if not m:
+            continue
+        writes = _fn_writes(fn, consts)
+        reads: Set[str] = set()
+        for oname, ofn in fns.items():
+            if oname != name:
+                reads |= _key_reads(ofn, consts)
+        for k in sorted(writes - reads):
+            yield Finding(
+                "SLT010", src.path, fn.lineno,
+                f"wire field {k!r} is written by {name}() but read by "
+                f"no decode/accounting path — dead bytes on every "
+                f"compressed exchange; drop it or consume it")
+        dec = fns.get(m.group(1) + "_decompress")
+        if dec is not None:
+            hard = _key_reads(dec, consts, hard_only=True)
+            for k in sorted(hard - writes):
+                yield Finding(
+                    "SLT010", src.path, dec.lineno,
+                    f"wire field {k!r} is required (d[{k!r}]) by "
+                    f"{dec.name}() but never written by {name}() — "
+                    f"KeyError on the first real frame")
+
+
+def _assigned_first_target(stmt: ast.stmt) -> Optional[str]:
+    """First bound name of an Assign: ``req, up = …`` -> 'req'."""
+    if not isinstance(stmt, ast.Assign) or not stmt.targets:
+        return None
+    t = stmt.targets[0]
+    if isinstance(t, ast.Tuple) and t.elts:
+        t = t.elts[0]
+    return t.id if isinstance(t, ast.Name) else None
+
+
+def _slt010_http(http_src: Src,
+                 peers: Sequence[Src]) -> Iterator[Finding]:
+    """Pair the request direction (client payload dicts vs server reads
+    of ``req``) and the reply direction (server ``resp`` dicts vs client
+    reads) across transport/http.py and transport/local.py."""
+    req_writes: Dict[str, int] = {}   # key -> witness line
+    resp_writes: Dict[str, int] = {}
+    req_reads: Set[str] = set()
+    resp_reads: Set[str] = set()
+
+    def note(dst: Dict[str, int], keys: Set[str], line: int) -> None:
+        for k in keys:
+            dst.setdefault(k, line)
+
+    for src in [http_src, *peers]:
+        consts = _module_str_consts(src.tree)
+        for n in ast.walk(src.tree):
+            # client request payloads: the dict handed to _post()
+            if (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "_post" and len(n.args) >= 2
+                    and isinstance(n.args[1], ast.Dict)):
+                note(req_writes, _dict_keys(n.args[1], consts), n.lineno)
+            # _post-internal payload mutations: dict(payload, k=…) and
+            # payload[k] = …
+            if (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                    and n.func.id == "dict" and n.args
+                    and isinstance(n.args[0], ast.Name)
+                    and n.args[0].id == "payload"):
+                note(req_writes,
+                     {kw.arg for kw in n.keywords if kw.arg}, n.lineno)
+            if (isinstance(n, ast.Subscript)
+                    and isinstance(n.ctx, ast.Store)
+                    and isinstance(n.value, ast.Name)
+                    and n.value.id in ("payload", "resp")):
+                k = _const_key(n.slice, consts)
+                if k is not None:
+                    dst = (req_writes if n.value.id == "payload"
+                           else resp_writes)
+                    dst.setdefault(k, n.lineno)
+            # standalone payload/resp dict literals (server replies, the
+            # aggregate payload)
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Dict):
+                tgt = _assigned_first_target(n)
+                if tgt == "payload":
+                    note(req_writes, _dict_keys(n.value, consts), n.lineno)
+                elif tgt == "resp":
+                    note(resp_writes, _dict_keys(n.value, consts),
+                         n.lineno)
+            # LocalTransport wire emulation: `req, _ = self._wire({…})`
+            # is the request direction, `resp, _ = …` the reply
+            if (isinstance(n, ast.Assign) and isinstance(n.value, ast.Call)
+                    and isinstance(n.value.func, ast.Attribute)
+                    and n.value.func.attr == "_wire" and n.value.args
+                    and isinstance(n.value.args[0], ast.Dict)):
+                tgt = _assigned_first_target(n)
+                keys = _dict_keys(n.value.args[0], consts)
+                if tgt == "req":
+                    note(req_writes, keys, n.lineno)
+                elif tgt == "resp":
+                    note(resp_writes, keys, n.lineno)
+
+        def recv_req(e: ast.expr) -> bool:
+            return isinstance(e, ast.Name) and e.id == "req"
+
+        def recv_resp(e: ast.expr) -> bool:
+            return (isinstance(e, ast.Call)
+                    or (isinstance(e, ast.Name)
+                        and e.id in ("out", "resp", "tree")))
+
+        req_reads |= _key_reads(src.tree, consts, recv_ok=recv_req)
+        resp_reads |= _key_reads(src.tree, consts, recv_ok=recv_resp)
+
+    for k, line in sorted(req_writes.items()):
+        if k not in req_reads:
+            yield Finding(
+                "SLT010", http_src.path, line,
+                f"request field {k!r} is sent by the client but never "
+                f"read server-side — dead wire bytes or a schema drift")
+    for k, line in sorted(resp_writes.items()):
+        if k not in resp_reads:
+            yield Finding(
+                "SLT010", http_src.path, line,
+                f"reply field {k!r} is written by the server but never "
+                f"read by any client path — dead wire bytes or a "
+                f"schema drift")
+
+
+_CC_DEF_RE = re.compile(
+    r"^\s*(?:[A-Za-z_][A-Za-z0-9_]*\s+)+?(slt_[a-z0-9_]+)\s*\(",
+    re.MULTILINE)
+
+
+def _slt010_native(src: Src) -> Iterator[Finding]:
+    """ctypes bindings (``lib.slt_*`` in native/codec.py) vs the
+    ``extern "C"`` exports of native/slt_codec.cc — a binding without a
+    symbol fails at load time on the machine that builds the library,
+    an export without a binding is dead native code."""
+    cc_path = os.path.join(os.path.dirname(src.path) or ".",
+                           "slt_codec.cc")
+    try:
+        with open(cc_path, encoding="utf-8") as fh:
+            cc_text = fh.read()
+    except OSError:
+        return  # source tree without the native half: nothing to pair
+    lo = cc_text.find('extern "C"')
+    defined = set(_CC_DEF_RE.findall(cc_text[lo:] if lo >= 0 else cc_text))
+    bound: Dict[str, int] = {}
+    for n in ast.walk(src.tree):
+        if (isinstance(n, ast.Attribute) and isinstance(n.value, ast.Name)
+                and n.value.id == "lib" and n.attr.startswith("slt_")):
+            bound.setdefault(n.attr, n.lineno)
+    for sym, line in sorted(bound.items()):
+        if sym not in defined:
+            yield Finding(
+                "SLT010", src.path, line,
+                f"ctypes binding {sym!r} has no extern \"C\" definition "
+                f"in slt_codec.cc — AttributeError the first time the "
+                f"native library loads")
+    for sym in sorted(defined - set(bound)):
+        yield Finding(
+            "SLT010", src.path, 1,
+            f"native symbol {sym!r} is exported by slt_codec.cc but "
+            f"never bound in native/codec.py — dead native code or a "
+            f"missing binding")
+
+
+def check_slt010(srcs: Sequence[Src]) -> Iterator[Finding]:
+    codec_src = http_src = None
+    peers: List[Src] = []
+    for s in srcs:
+        if s.posix.endswith("transport/codec.py"):
+            codec_src = s
+        elif s.posix.endswith("transport/http.py"):
+            http_src = s
+        elif s.posix.endswith("transport/local.py"):
+            peers.append(s)
+        elif s.posix.endswith("native/codec.py"):
+            yield from _slt010_native(s)
+    if codec_src is not None:
+        yield from _slt010_codec(codec_src)
+    if http_src is not None:
+        yield from _slt010_http(http_src, peers)
+
+
+# ---------------------------------------------------------------------- #
+
+RULES = {
+    "SLT006": (check_slt006,
+               "no read of a donate_argnums buffer after the jitted "
+               "call (rebind or drop the donation)"),
+    "SLT007": (check_slt007,
+               "no retrace hazards: varying literals at traced args, "
+               "non-hashable statics, mutable-self closure capture"),
+    "SLT008": (check_slt008,
+               "no implicit host sync on traced values (bool/if/early "
+               "float before the bulk transfer)"),
+    "SLT009": (check_slt009,
+               "PRNG keys reach at most one consumer without an "
+               "interposed split/fold_in"),
+}
+
+PROJECT_RULES = {
+    "SLT010": (check_slt010,
+               "wire-schema contract: codec/http/native field sets "
+               "pair up across encode and decode sides"),
+}

@@ -35,6 +35,12 @@ D2H = "d2h"
 # and, under SLT_LOCK_DEBUG=1, by obs/locks.py InstrumentedLock
 LOCK_HOLD = "lock_hold"
 
+# XLA compile events surfaced by obs/dispatch_debug.py under
+# SLT_DISPATCH_DEBUG=1 — a recompile storm shows up on the timeline and
+# in trace_report.py's compile summary; deliberately NOT in SERVER_PHASES
+# (a compile nests inside ``dispatch``, counting both would double-book)
+COMPILE = "xla_compile"
+
 # the client-level phases that tile a step — the denominator of the
 # compute-vs-wire fraction (encode/wire are sub-phases of transport and
 # queue_wait/dispatch belong to the server party; counting either would

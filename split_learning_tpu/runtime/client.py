@@ -37,6 +37,7 @@ import numpy as np
 
 from split_learning_tpu.core.losses import cross_entropy
 from split_learning_tpu.core.stage import SplitPlan, stage_backward
+from split_learning_tpu.obs import dispatch_debug as obs_dispatch
 from split_learning_tpu.obs import spans
 from split_learning_tpu.obs import trace as obs_trace
 from split_learning_tpu.runtime.state import (
@@ -108,6 +109,9 @@ class SplitClientTrainer:
         self._fwd = jax.jit(stage.apply)
         self._bwd = jax.jit(
             lambda p, x, g: stage_backward(stage, p, x, g))
+        # dispatch watchdog (slt-lint phase 2): None unless enabled
+        self._dd = obs_dispatch.attach()
+        self._ddtok = obs_dispatch.token()
 
     @property
     def wire_ef(self) -> Optional[Any]:
@@ -150,8 +154,12 @@ class SplitClientTrainer:
         tid = tr.new_trace_id(self.client_id, step) if tr is not None else None
         t_step0 = time.perf_counter() if tr is not None else 0.0
         with phase("compute_fwd"):
-            acts = self._fwd(self.state.params, jnp.asarray(x))
-            acts_host = np.asarray(acts)
+            with obs_dispatch.step_scope(
+                    self._dd, (self._ddtok, "client_fwd"),
+                    sig_fn=lambda: (x.shape, str(x.dtype))):
+                acts = self._fwd(self.state.params, jnp.asarray(x))
+            with obs_dispatch.expected_d2h(self._dd):
+                acts_host = np.asarray(acts)
         if tr is not None:
             tr.record(spans.CLIENT_FWD, t_step0,
                       time.perf_counter() - t_step0, trace_id=tid,
@@ -207,8 +215,12 @@ class SplitClientTrainer:
 
         with phase("compute_bwd"):
             t_b0 = time.perf_counter() if tr is not None else 0.0
-            g_params = self._bwd(self.state.params, jnp.asarray(x),
-                                 jnp.asarray(g_acts))
+            with obs_dispatch.step_scope(
+                    self._dd, (self._ddtok, "client_bwd"),
+                    sig_fn=lambda: (x.shape, str(x.dtype),
+                                    np.asarray(g_acts).shape)):
+                g_params = self._bwd(self.state.params, jnp.asarray(x),
+                                     jnp.asarray(g_acts))
             if tr is not None:
                 jax.block_until_ready(g_params)
                 t_b1 = time.perf_counter()
@@ -295,6 +307,9 @@ class USplitClientTrainer:
         self._head_step = jax.jit(head_step)
         self._bwd_a = jax.jit(
             lambda p, x, g: stage_backward(stage_a, p, x, g))
+        # dispatch watchdog (slt-lint phase 2): None unless enabled
+        self._dd = obs_dispatch.attach()
+        self._ddtok = obs_dispatch.token()
 
     def ensure_init(self, sample_x: np.ndarray) -> None:
         if self.state_a is None:
@@ -307,21 +322,33 @@ class USplitClientTrainer:
 
     def train_step(self, x: np.ndarray, y: np.ndarray, step: int) -> float:
         self.ensure_init(x)
-        acts = self._fwd_a(self.state_a.params, jnp.asarray(x))
+        dd = self._dd
+        sig = (x.shape, str(x.dtype)) if dd is not None else None
+        with obs_dispatch.step_scope(dd, (self._ddtok, "u_fwd_a"),
+                                     sig_fn=lambda: sig):
+            acts = self._fwd_a(self.state_a.params, jnp.asarray(x))
         # hop 1: activations -> trunk features
-        feats = self.transport.u_forward(np.asarray(acts), step,
-                                         self.client_id)
+        with obs_dispatch.expected_d2h(dd):
+            acts_host = np.asarray(acts)
+        feats = self.transport.u_forward(acts_host, step, self.client_id)
         # local head: loss + grads (labels stay here)
-        loss, g_c, g_feats = self._head_step(
-            self.state_c.params, jnp.asarray(feats), jnp.asarray(y))
+        with obs_dispatch.step_scope(dd, (self._ddtok, "u_head_step"),
+                                     sig_fn=lambda: sig):
+            loss, g_c, g_feats = self._head_step(
+                self.state_c.params, jnp.asarray(feats), jnp.asarray(y))
         self.state_c = apply_grads(self._tx, self.state_c, g_c)
         # hop 2: feature grads -> activation grads (server updates trunk)
-        g_acts = self.transport.u_backward(np.asarray(g_feats), step,
+        with obs_dispatch.expected_d2h(dd):
+            g_feats_host = np.asarray(g_feats)
+        g_acts = self.transport.u_backward(g_feats_host, step,
                                            self.client_id)
-        g_a = self._bwd_a(self.state_a.params, jnp.asarray(x),
-                          jnp.asarray(g_acts))
+        with obs_dispatch.step_scope(dd, (self._ddtok, "u_bwd_a"),
+                                     sig_fn=lambda: sig):
+            g_a = self._bwd_a(self.state_a.params, jnp.asarray(x),
+                              jnp.asarray(g_acts))
         self.state_a = apply_grads(self._tx, self.state_a, g_a)
-        return float(loss)
+        with obs_dispatch.expected_d2h(dd):
+            return float(loss)
 
     def train(self, data_iter, epochs: Optional[int] = None,
               start_step: int = 0,
@@ -363,6 +390,9 @@ class FederatedClientTrainer:
             return apply_grads(self._tx, state, grads), loss
 
         self._step = jax.jit(step_fn, donate_argnums=(0,))
+        # dispatch watchdog (slt-lint phase 2): None unless enabled
+        self._dd = obs_dispatch.attach()
+        self._ddtok = obs_dispatch.token()
 
     def ensure_init(self, sample_x: np.ndarray) -> None:
         if self.state is None:
@@ -380,15 +410,22 @@ class FederatedClientTrainer:
             n_examples = 0
             for x, y in data_iter():
                 self.ensure_init(x)
-                self.state, loss = self._step(
-                    self.state, jnp.asarray(x), jnp.asarray(y))
-                epoch_losses.append(float(loss))
+                with obs_dispatch.step_scope(
+                        self._dd, (self._ddtok, "fed_step"),
+                        sig_fn=lambda: (np.asarray(x).shape,
+                                        np.asarray(y).shape)):
+                    self.state, loss = self._step(
+                        self.state, jnp.asarray(x), jnp.asarray(y))
+                with obs_dispatch.expected_d2h(self._dd):
+                    epoch_losses.append(float(loss))
                 n_examples += len(y)
                 step += 1
             avg_loss = float(np.mean(epoch_losses)) if epoch_losses else float("nan")
             # per-epoch sync ≡ src/client_part.py:171-194, weighted by
             # this client's example count (canonical FedAvg)
-            params_np = jax.tree_util.tree_map(np.asarray, self.state.params)
+            with obs_dispatch.expected_d2h(self._dd):
+                params_np = jax.tree_util.tree_map(np.asarray,
+                                                   self.state.params)
             agg = self.transport.aggregate(params_np, epoch, avg_loss, step,
                                            num_examples=n_examples or None)
             agg = jax.tree_util.tree_map(jnp.asarray, agg)

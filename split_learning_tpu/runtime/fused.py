@@ -31,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from split_learning_tpu.core.losses import cross_entropy
 from split_learning_tpu.core.stage import SplitPlan, remat_plan
+from split_learning_tpu.obs import dispatch_debug as obs_dispatch
 from split_learning_tpu.parallel.mesh import (
     DATA_AXIS, SEQ_AXIS, batch_sharding, replicated, tp_param_sharding)
 from split_learning_tpu.runtime.state import (
@@ -175,6 +176,9 @@ class FusedSplitTrainer:
             self._step = jax.jit(step_fn, donate_argnums=(0,))
             self._epoch = jax.jit(epoch_fn, donate_argnums=(0,))
             self._seq_sharding = None
+        # dispatch watchdog (slt-lint phase 2): None unless enabled
+        self._dd = obs_dispatch.attach()
+        self._ddtok = obs_dispatch.token()
 
     def train_step(self, x: np.ndarray, y: np.ndarray) -> float:
         """One fused step on the global batch (sharded over clients)."""
@@ -183,8 +187,12 @@ class FusedSplitTrainer:
         if self._x_sharding is not None:
             x = jax.device_put(x, self._x_sharding)
             y = jax.device_put(y, self._y_sharding)
-        self.state, loss = self._step(self.state, x, y)
-        return float(loss)
+        with obs_dispatch.step_scope(
+                self._dd, (self._ddtok, "fused_step"),
+                sig_fn=lambda: (x.shape, str(x.dtype), y.shape)):
+            self.state, loss = self._step(self.state, x, y)
+        with obs_dispatch.expected_d2h(self._dd):
+            return float(loss)
 
     def train_epoch(self, xs, ys) -> jax.Array:
         """Run ``xs.shape[0]`` steps in one device dispatch; returns the
@@ -195,7 +203,10 @@ class FusedSplitTrainer:
             ep_x, ep_y = self._seq_sharding
             xs = jax.device_put(xs, ep_x)
             ys = jax.device_put(ys, ep_y)
-        self.state, losses = self._epoch(self.state, xs, ys)
+        with obs_dispatch.step_scope(
+                self._dd, (self._ddtok, "fused_epoch"),
+                sig_fn=lambda: (xs.shape, str(xs.dtype), ys.shape)):
+            self.state, losses = self._epoch(self.state, xs, ys)
         return losses
 
     def train_step_async(self, x, y) -> jax.Array:
@@ -206,7 +217,10 @@ class FusedSplitTrainer:
         if self._x_sharding is not None:
             x = jax.device_put(x, self._x_sharding)
             y = jax.device_put(y, self._y_sharding)
-        self.state, loss = self._step(self.state, x, y)
+        with obs_dispatch.step_scope(
+                self._dd, (self._ddtok, "fused_step"),
+                sig_fn=lambda: (x.shape, str(x.dtype), y.shape)):
+            self.state, loss = self._step(self.state, x, y)
         return loss
 
     def step_flops(self, x, y) -> float:

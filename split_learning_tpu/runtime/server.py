@@ -30,6 +30,7 @@ import numpy as np
 from split_learning_tpu.core.losses import (
     cross_entropy, per_example_cross_entropy)
 from split_learning_tpu.core.stage import SplitPlan
+from split_learning_tpu.obs import dispatch_debug as obs_dispatch
 from split_learning_tpu.obs import locks as obs_locks
 from split_learning_tpu.obs import spans
 from split_learning_tpu.obs import trace as obs_trace
@@ -109,6 +110,10 @@ class ServerRuntime:
         self._metrics = Registry()
         self._lock = obs_locks.make_lock("ServerRuntime._lock",
                                          registry=self._metrics)
+        # dispatch watchdog (slt-lint phase 2): None unless
+        # SLT_DISPATCH_DEBUG=1 — every hook below gates on it
+        self._dd = obs_dispatch.attach()
+        self._ddtok = obs_dispatch.token()
         # per-client step handshake (multi-client split: SURVEY.md config 3);
         # _step_floor is a global minimum installed by resume_from so that
         # EVERY client — known or not — must resume at or after the
@@ -283,15 +288,21 @@ class ServerRuntime:
             with self._lock:
                 t_d0 = time.perf_counter() if tr is not None else 0.0
                 self._check_step(step, client_id)
-                self.state, g_acts, loss = self._split_step(
-                    self.state, jnp.asarray(activations),
-                    jnp.asarray(labels))
+                with obs_dispatch.step_scope(
+                        self._dd, (self._ddtok, "split_step"),
+                        sig_fn=lambda: (activations.shape,
+                                        str(activations.dtype),
+                                        labels.shape, str(labels.dtype))):
+                    self.state, g_acts, loss = self._split_step(
+                        self.state, jnp.asarray(activations),
+                        jnp.asarray(labels))
                 if not self.overlap:
                     # legacy placement: the transfer rides inside the
                     # lock (and inside the dispatch span — the old span
                     # taxonomy, where dispatch = jit + materialization)
                     self._sleep_d2h()
-                    g_host, loss_f = np.asarray(g_acts), float(loss)
+                    with obs_dispatch.expected_d2h(self._dd):
+                        g_host, loss_f = np.asarray(g_acts), float(loss)
                 # max(): with strict_steps off (pipelined clients) steps
                 # can arrive out of order, and the acknowledged step —
                 # what /health reports and checkpoints are labeled with —
@@ -306,7 +317,8 @@ class ServerRuntime:
                 # futures (async dispatch), so forcing the transfer here
                 # lets step t's D2H overlap step t+1's device compute
                 self._sleep_d2h()
-                g_host, loss_f = np.asarray(g_acts), float(loss)
+                with obs_dispatch.expected_d2h(self._dd):
+                    g_host, loss_f = np.asarray(g_acts), float(loss)
             res = (g_host, loss_f)
             if entry is not None:
                 self.replay.resolve(entry, res)
@@ -407,19 +419,26 @@ class ServerRuntime:
             weights = np.zeros((padded,), np.float32)
             weights[:total] = 1.0 / total
             sig = (acts.shape, acts.dtype.str, labels.dtype.str)
-            if sig not in self._coalesce_shapes:
+            fresh = sig not in self._coalesce_shapes
+            if fresh:
                 self._coalesce_shapes.add(sig)
                 self._coalescer.stats.incr("compile_count")
             t_d0 = time.perf_counter() if tr is not None else 0.0
-            self.state, g_acts, per_ex = self._coalesced_step(
-                self.state, jnp.asarray(acts), jnp.asarray(labels),
-                jnp.asarray(weights))
+            # the coalescer already tracks padded-shape signatures (the
+            # compile_count counter above) — hand its freshness verdict
+            # to the watchdog instead of double-tracking
+            with obs_dispatch.step_scope(
+                    self._dd, (self._ddtok, "coalesced_step"), fresh=fresh):
+                self.state, g_acts, per_ex = self._coalesced_step(
+                    self.state, jnp.asarray(acts), jnp.asarray(labels),
+                    jnp.asarray(weights))
             if not self.overlap:
                 # legacy placement: the whole group's transfer inside
                 # the lock (dispatch span = jit + materialization)
                 self._sleep_d2h()
-                g_acts = np.asarray(g_acts)
-                per_ex = np.asarray(per_ex)
+                with obs_dispatch.expected_d2h(self._dd):
+                    g_acts = np.asarray(g_acts)
+                    per_ex = np.asarray(per_ex)
             dw = time.perf_counter() - t_d0 if tr is not None else 0.0
             pg = (_GroupD2H(self, g_acts, per_ex, tr)
                   if self.overlap else None)
@@ -475,7 +494,13 @@ class ServerRuntime:
                 "the full model; evaluate locally)", status=400)
         with self._lock:
             params = self.state.params
-        return np.asarray(self._predict(params, jnp.asarray(activations)))
+        with obs_dispatch.step_scope(
+                self._dd, (self._ddtok, "predict"),
+                sig_fn=lambda: (np.asarray(activations).shape,
+                                str(np.asarray(activations).dtype))):
+            out = self._predict(params, jnp.asarray(activations))
+        with obs_dispatch.expected_d2h(self._dd):
+            return np.asarray(out)
 
     # bounds on residuals awaiting their hop-2 u_backward. Per-client FIFO
     # cap: one client's backlog can never evict another's live residual.
@@ -502,7 +527,10 @@ class ServerRuntime:
             with self._lock:
                 self._check_step(step, client_id)
                 acts = jnp.asarray(activations)
-                feats = self._u_fwd(self.state.params, acts)
+                with obs_dispatch.step_scope(
+                        self._dd, (self._ddtok, "u_fwd"),
+                        sig_fn=lambda: (acts.shape, str(acts.dtype))):
+                    feats = self._u_fwd(self.state.params, acts)
                 self._u_residual[(client_id, step)] = acts
                 mine = [k for k in self._u_residual if k[0] == client_id]
                 # FIFO eviction (dict preserves insertion order): this
@@ -517,11 +545,13 @@ class ServerRuntime:
                         del self._u_residual[key]
                 if not self.overlap:
                     self._sleep_d2h()
-                    feats_host = np.asarray(feats)
+                    with obs_dispatch.expected_d2h(self._dd):
+                        feats_host = np.asarray(feats)
             if self.overlap:
                 # off the lock: async dispatch returned device futures
                 self._sleep_d2h()
-                feats_host = np.asarray(feats)
+                with obs_dispatch.expected_d2h(self._dd):
+                    feats_host = np.asarray(feats)
             if entry is not None:
                 self.replay.resolve(entry, feats_host)
             return feats_host
@@ -550,11 +580,17 @@ class ServerRuntime:
                     raise ProtocolError(
                         f"u_backward for unknown step {step} "
                         f"(client {client_id})")
-                self.state, g_acts = self._u_bwd(
-                    self.state, acts, jnp.asarray(feat_grads))
+                with obs_dispatch.step_scope(
+                        self._dd, (self._ddtok, "u_bwd"),
+                        sig_fn=lambda: (acts.shape, str(acts.dtype),
+                                        feat_grads.shape,
+                                        str(feat_grads.dtype))):
+                    self.state, g_acts = self._u_bwd(
+                        self.state, acts, jnp.asarray(feat_grads))
                 if not self.overlap:
                     self._sleep_d2h()
-                    g_host = np.asarray(g_acts)
+                    with obs_dispatch.expected_d2h(self._dd):
+                        g_host = np.asarray(g_acts)
                 # max(): with strict_steps off (pipelined clients) steps
                 # can arrive out of order, and the acknowledged step —
                 # what /health reports and checkpoints are labeled with —
@@ -566,7 +602,8 @@ class ServerRuntime:
             if self.overlap:
                 # off the lock: async dispatch returned device futures
                 self._sleep_d2h()
-                g_host = np.asarray(g_acts)
+                with obs_dispatch.expected_d2h(self._dd):
+                    g_host = np.asarray(g_acts)
             if entry is not None:
                 self.replay.resolve(entry, g_host)
             return g_host
@@ -677,6 +714,10 @@ class ServerRuntime:
                 rc.pop("replay_cache_size"))
             for k, v in rc.items():
                 snap["counters"][f"{k}_total"] = float(v)
+        if self._dd is not None:
+            # watchdog gauges fold in at scrape time (the replay-counter
+            # pattern); render_prometheus prefixes them slt_
+            snap["gauges"].update(self._dd.gauges())
         return snap
 
     # -- wire-server replay hooks (transport/http.py) -------------------- #
@@ -738,8 +779,9 @@ class _GroupD2H:
             if self.g is None:
                 t_h0 = time.perf_counter() if self._tr is not None else 0.0
                 self._runtime._sleep_d2h()
-                g = np.asarray(self._g_dev)
-                per_ex = np.asarray(self._per_ex_dev)
+                with obs_dispatch.expected_d2h(self._runtime._dd):
+                    g = np.asarray(self._g_dev)
+                    per_ex = np.asarray(self._per_ex_dev)
                 if self._tr is not None:
                     self.t_h0 = t_h0
                     self.hw = time.perf_counter() - t_h0

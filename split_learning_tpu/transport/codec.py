@@ -236,8 +236,7 @@ def topk8_compress(arr: np.ndarray, density: float,
         flat = a.copy().reshape(-1)
     n = flat.size
     d: dict = {_TOPK8_KEY: True, "n": n, "shape": list(a.shape),
-               "dtype": str(np.asarray(arr).dtype),
-               "density": float(density)}
+               "dtype": str(np.asarray(arr).dtype)}
     if n == 0:
         d.update(idx=np.zeros(0, np.int32), q=np.zeros(0, np.int8),
                  scale=_Q8_EPS)

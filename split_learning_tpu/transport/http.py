@@ -478,6 +478,14 @@ class HttpTransport(Transport):
             except Exception:
                 self._rollback("acts")
                 raise
+            # the reply echoes the request step; a mismatch means the
+            # frame was routed to the wrong in-flight exchange (replayed
+            # frames carry the original — matching — step, so replay
+            # stays transparent here)
+            if int(out["step"]) != step:
+                raise TransportError(
+                    f"/forward_pass reply step {out['step']} does not "
+                    f"echo request step {step}")
             return out["grads"], float(out["loss"])
 
     def u_forward(self, activations: np.ndarray, step: int,

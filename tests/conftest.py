@@ -46,6 +46,24 @@ def _lock_watchdog_gate():
             "\n".join(v["message"] for v in violations))
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _dispatch_watchdog_gate():
+    """Under SLT_DISPATCH_DEBUG=1 the runtimes run their jitted calls
+    inside dispatch_debug step scopes; a steady-state recompile (local
+    ordinal >= 2 with a previously-seen signature) or an unexpected-D2H
+    report from the suite's own trainers is a real bug — fail the
+    session at teardown. (Watchdog regression tests use private
+    DispatchTracker instances, so they never trip this gate; arming is
+    env-only — dispatch_debug.force() bench overrides don't count.)"""
+    from split_learning_tpu.obs import dispatch_debug
+    yield
+    if os.environ.get("SLT_DISPATCH_DEBUG", "") not in ("", "0"):
+        violations = dispatch_debug.tracker().violations
+        assert not violations, (
+            "dispatch watchdog reports from the test session:\n" +
+            "\n".join(v["message"] for v in violations))
+
+
 @pytest.fixture(scope="session")
 def devices():
     # NOTE: ask for the cpu backend explicitly — bare jax.devices() resolves

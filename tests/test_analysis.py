@@ -12,11 +12,13 @@ import time
 from pathlib import Path
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from split_learning_tpu.analysis import cfg as cfg_mod
 from split_learning_tpu.analysis import engine
+from split_learning_tpu.obs import dispatch_debug
 from split_learning_tpu.obs import locks, spans
 from split_learning_tpu.obs import trace as obs_trace
 from split_learning_tpu.obs.metrics import Registry
@@ -361,6 +363,295 @@ def test_slt005_consistent_order_is_clean(tmp_path):
 
 
 # ---------------------------------------------------------------------- #
+# SLT006: use-after-donate
+# ---------------------------------------------------------------------- #
+
+def test_slt006_read_after_donate(tmp_path):
+    findings = _lint(tmp_path, "runtime/trainer.py", """
+        import jax
+        class T:
+            def __init__(self, step_fn):
+                self._step = jax.jit(step_fn, donate_argnums=(0,))
+            def train(self, state, x):
+                new_state, loss = self._step(state, x)
+                norm = state.norm()
+                return new_state, loss, norm
+    """)
+    assert _rules(findings) == ["SLT006"]
+    assert "donate_argnums" in findings[0].message
+
+
+def test_slt006_rebind_over_donation_is_clean(tmp_path):
+    findings = _lint(tmp_path, "runtime/trainer.py", """
+        import jax
+        class T:
+            def __init__(self, step_fn):
+                self._step = jax.jit(step_fn, donate_argnums=(0,))
+            def train(self, state, x):
+                state, loss = self._step(state, x)
+                return state.norm(), loss
+            def fresh_then_read(self, state, x):
+                new_state, loss = self._step(state, x)
+                state = new_state
+                return state.norm(), loss
+    """)
+    assert findings == []
+
+
+def test_slt006_inline_waiver(tmp_path):
+    findings = _lint(tmp_path, "runtime/trainer.py", """
+        import jax
+        class T:
+            def __init__(self, step_fn):
+                self._step = jax.jit(step_fn, donate_argnums=(0,))
+            def train(self, state, x):
+                new_state, loss = self._step(state, x)
+                norm = state.norm()  # slt-lint: disable=SLT006 (demo)
+                return new_state, loss, norm
+    """)
+    assert _rules(findings, waived=True) == ["SLT006"]
+    assert _rules(findings, waived=False) == []
+
+
+# ---------------------------------------------------------------------- #
+# SLT007: retrace hazards
+# ---------------------------------------------------------------------- #
+
+def test_slt007_jit_closure_over_mutable_self_attr(tmp_path):
+    findings = _lint(tmp_path, "runtime/trainer.py", """
+        import jax
+        class T:
+            def __init__(self):
+                def step(x):
+                    return x * self._scale
+                self._step = jax.jit(step)
+            def set_scale(self, s):
+                self._scale = s
+    """)
+    assert _rules(findings) == ["SLT007"]
+    assert "_scale" in findings[0].message
+
+
+def test_slt007_varying_literals_and_nonhashable_static(tmp_path):
+    findings = _lint(tmp_path, "ops/kern.py", """
+        import jax
+        def f(x, n):
+            return x * n
+        _g = jax.jit(f)
+        _h = jax.jit(f, static_argnums=(1,))
+        def a(x):
+            return _g(x, 2)
+        def b(x):
+            return _g(x, 3)
+        def c(x):
+            return _h(x, 2)
+        def d(x):
+            return _h(x, 3)
+        def e(x):
+            return _h(x, [1, 2])
+    """)
+    # _g varies a traced literal; _h's variation is static (fine) but
+    # the list literal at a static position is non-hashable
+    assert _rules(findings) == ["SLT007", "SLT007"]
+
+
+def test_slt007_immutable_attr_and_same_literal_are_clean(tmp_path):
+    findings = _lint(tmp_path, "runtime/trainer.py", """
+        import jax
+        class T:
+            def __init__(self, lr):
+                self._lr = lr
+                def step(x):
+                    return x * self._lr
+                self._step = jax.jit(step)
+            def go(self, x):
+                return self._step(x)
+    """)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------- #
+# SLT008: implicit host sync on traced values
+# ---------------------------------------------------------------------- #
+
+def test_slt008_branch_bool_and_scalar_before_bulk(tmp_path):
+    findings = _lint(tmp_path, "runtime/worker.py", """
+        import jax
+        import numpy as np
+        def step_fn(x):
+            return x
+        _step = jax.jit(step_fn)
+        class R:
+            def brancher(self, x):
+                loss = _step(x)
+                if loss:
+                    return 0.0
+                return 1.0
+            def boolsync(self, x):
+                loss = _step(x)
+                return bool(loss)
+            def eager_scalar(self, x):
+                g, loss = _step(x)
+                l = float(loss)
+                gh = np.asarray(g)
+                return gh, l
+    """)
+    assert _rules(findings) == ["SLT008", "SLT008", "SLT008"]
+
+
+def test_slt008_bulk_first_and_lone_scalar_are_clean(tmp_path):
+    findings = _lint(tmp_path, "runtime/worker.py", """
+        import jax
+        import numpy as np
+        def step_fn(x):
+            return x
+        _step = jax.jit(step_fn)
+        class R:
+            def drained(self, x):
+                g, loss = _step(x)
+                gh = np.asarray(g)
+                return gh, float(loss)
+            def lone_scalar(self, x):
+                loss = _step(x)
+                return float(loss)
+            def host_if(self, x):
+                loss = _step(x)
+                loss = float(loss)
+                if loss:
+                    return 0.0
+                return loss
+    """)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------- #
+# SLT009: PRNG key discipline
+# ---------------------------------------------------------------------- #
+
+def test_slt009_double_consumption_and_loop_reuse(tmp_path):
+    findings = _lint(tmp_path, "ops/noise.py", """
+        import jax
+        def double(key, shape):
+            a = jax.random.normal(key, shape)
+            b = jax.random.uniform(key, shape)
+            return a + b
+        def loopy(key, xs):
+            out = 0.0
+            for x in xs:
+                out = out + jax.random.normal(key, x.shape)
+            return out
+    """)
+    assert _rules(findings) == ["SLT009", "SLT009"]
+
+
+def test_slt009_split_and_fold_in_are_clean(tmp_path):
+    findings = _lint(tmp_path, "ops/noise.py", """
+        import jax
+        def ok(key, shape):
+            k1, k2 = jax.random.split(key)
+            a = jax.random.normal(k1, shape)
+            b = jax.random.normal(k2, shape)
+            return a + b
+        def per_step(key, xs):
+            out = 0.0
+            for i, x in enumerate(xs):
+                k = jax.random.fold_in(key, i)
+                out = out + jax.random.normal(k, x.shape)
+            return out
+    """)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------- #
+# SLT010: wire-schema contract (project rule, cross-file)
+# ---------------------------------------------------------------------- #
+
+def _lint_tree(tmp_path, files, waiver_text=None):
+    for rel, srctext in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(srctext))
+    wf = None
+    if waiver_text is not None:
+        wfp = tmp_path / "waivers"
+        wfp.write_text(waiver_text)
+        wf = str(wfp)
+    return engine.lint_paths([str(tmp_path)], waiver_file=wf)
+
+
+_CODEC_DRIFT = {"transport/codec.py": """
+    def foo_compress(arr):
+        return {"tag": True, "n": 3, "ghost": 1}
+    def foo_decompress(d):
+        return (d["tag"], d["n"], d["missing"])
+"""}
+
+
+def test_slt010_codec_field_drift_both_directions(tmp_path):
+    findings = _lint_tree(tmp_path, _CODEC_DRIFT)
+    assert _rules(findings) == ["SLT010", "SLT010"]
+    msgs = " ".join(f.message for f in findings)
+    assert "ghost" in msgs and "missing" in msgs
+
+
+def test_slt010_matched_codec_is_clean(tmp_path):
+    findings = _lint_tree(tmp_path, {"transport/codec.py": """
+        def foo_compress(arr):
+            return {"tag": True, "n": 3}
+        def foo_decompress(d):
+            return (d["tag"], d["n"])
+    """})
+    assert findings == []
+
+
+def test_slt010_http_reply_field_never_read(tmp_path):
+    findings = _lint_tree(tmp_path, {"transport/http.py": """
+        class HttpTransport:
+            def split_step(self, acts, step):
+                out = self._post("/forward_pass",
+                                 {"acts": acts, "step": step})
+                return out["grads"], float(out["loss"])
+        def handle_forward(req, runtime):
+            grads, loss = runtime.split_step(req["acts"], req["step"])
+            resp = {"grads": grads, "loss": loss, "debug": 1}
+            return resp
+    """})
+    assert _rules(findings) == ["SLT010"]
+    assert "debug" in findings[0].message
+
+
+def test_slt010_native_binding_pairing(tmp_path):
+    cc = (tmp_path / "native")
+    cc.mkdir(parents=True, exist_ok=True)
+    (cc / "slt_codec.cc").write_text(
+        'extern "C" {\n'
+        "int slt_encode(const char* buf) {\n  return 0;\n}\n"
+        "int slt_unused(int x) {\n  return 1;\n}\n"
+        "}\n")
+    findings = _lint_tree(tmp_path, {"native/codec.py": """
+        lib = None
+        def encode(buf):
+            return lib.slt_encode(buf)
+        def missing(buf):
+            return lib.slt_missing(buf)
+    """})
+    assert _rules(findings) == ["SLT010", "SLT010"]
+    msgs = " ".join(f.message for f in findings)
+    assert "slt_missing" in msgs and "slt_unused" in msgs
+
+
+def test_slt010_waiver_file(tmp_path):
+    for rel, srctext in _CODEC_DRIFT.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(srctext))
+    wf = tmp_path / "waivers"
+    wf.write_text("SLT010 transport/codec.py legacy peer still sends it\n")
+    assert engine.main([str(tmp_path), "--waiver-file", str(wf)]) == 0
+    assert engine.main([str(tmp_path)]) == 1
+
+
+# ---------------------------------------------------------------------- #
 # engine: exit codes, waiver file, real tree
 # ---------------------------------------------------------------------- #
 
@@ -409,7 +700,8 @@ def test_syntax_error_is_a_finding(tmp_path):
 def test_list_rules(capsys):
     assert engine.main(["--list-rules"]) == 0
     out = capsys.readouterr().out
-    for rule in ("SLT001", "SLT002", "SLT003", "SLT004", "SLT005"):
+    for rule in ("SLT001", "SLT002", "SLT003", "SLT004", "SLT005",
+                 "SLT006", "SLT007", "SLT008", "SLT009", "SLT010"):
         assert rule in out
 
 
@@ -448,13 +740,14 @@ def test_trace_report_fallback_matches_registry():
                     fallback[s.targets[0].id] = ast.literal_eval(s.value)
     assert fallback["CLIENT_PHASES"] == spans.CLIENT_PHASES
     assert fallback["TRANSPORT_SUB"] == spans.TRANSPORT_SUB
+    assert fallback["COMPILE"] == spans.COMPILE
 
 
 def test_analysis_package_is_stdlib_only():
     """The CI lint step must not need jax/numpy: the analysis package
     imports nothing outside the stdlib and itself."""
     import importlib
-    for mod in ("engine", "rules", "cfg"):
+    for mod in ("engine", "rules", "rules_jax", "cfg"):
         m = importlib.import_module(f"split_learning_tpu.analysis.{mod}")
         src = Path(m.__file__).read_text()
         tree = ast.parse(src)
@@ -602,3 +895,146 @@ def test_watchdog_loss_series_bit_identical(monkeypatch):
     on = series(True)
     assert locks.default_graph().violations == []
     assert on == series(False)
+
+
+# ---------------------------------------------------------------------- #
+# obs/dispatch_debug.py: the dispatch watchdog
+# ---------------------------------------------------------------------- #
+
+def _with_listener(t):
+    """Feed jax.monitoring compile events into a private tracker; the
+    returned callable detaches it (best-effort: the unregister hook is
+    a private API)."""
+    def listener(event, secs, **_kw):
+        t.on_compile_event(event, secs)
+    jax.monitoring.register_event_duration_secs_listener(listener)
+
+    def detach():
+        try:
+            from jax._src import monitoring as _mon
+            _mon._unregister_event_duration_listener_by_callback(listener)
+        except Exception:
+            pass
+    return detach
+
+
+def test_dispatch_tracker_flags_steady_state_recompile():
+    """A jit whose static arg varies per step compiles on EVERY call;
+    from local ordinal 2 on, with the signature already seen, each one
+    is a steady-state-recompile violation (deduped per ordinal)."""
+    t = dispatch_debug.DispatchTracker()
+    detach = _with_listener(t)
+    try:
+        f = jax.jit(lambda x, n: x * n, static_argnums=(1,))
+        x = jnp.ones((4,), jnp.float32)
+        for i in range(5):
+            with t.scope(("trainer", "step"), sig=(x.shape, "float32")):
+                f(x, i).block_until_ready()
+    finally:
+        detach()
+    assert t.compile_count >= 5  # one real backend compile per call
+    kinds = [v["kind"] for v in t.violations]
+    assert kinds == ["steady-state-recompile"] * 3  # ordinals 2, 3, 4
+    assert t.gauges()["steady_state_recompiles"] == 3.0
+    assert t.gauges()["compile_count"] == float(t.compile_count)
+
+
+def test_dispatch_tracker_fresh_signature_is_exempt():
+    """New input shapes legitimately compile at any ordinal — the
+    signature set marks those scopes fresh and nothing is flagged."""
+    t = dispatch_debug.DispatchTracker()
+    detach = _with_listener(t)
+    try:
+        g = jax.jit(lambda x: x * 2.0)
+        for n in (3, 4, 5, 6):
+            with t.scope("g", sig=((n,), "float32")):
+                g(jnp.ones((n,), jnp.float32)).block_until_ready()
+    finally:
+        detach()
+    assert t.compile_count >= 4
+    assert t.violations == []
+
+
+def test_dispatch_guard_error_is_counted_and_reraised():
+    """The transfer guard is inert on the CPU backend (module
+    docstring), so the reporting path is exercised with a synthetic
+    guard-shaped error: counted, reported, re-raised."""
+    t = dispatch_debug.DispatchTracker()
+    with pytest.raises(RuntimeError):
+        with t.scope("k"):
+            raise RuntimeError(
+                "Disallowed device-to-host transfer: from platform cpu")
+    assert t.unexpected_d2h == 1
+    assert [v["kind"] for v in t.violations] == ["unexpected-d2h"]
+    assert t.gauges()["unexpected_d2h_total"] == 1.0
+    with pytest.raises(RuntimeError):  # unrelated errors pass uncounted
+        with t.scope("k"):
+            raise RuntimeError("boom")
+    assert t.unexpected_d2h == 1
+
+
+def test_dispatch_helpers_off_are_shared_nullcontext(monkeypatch):
+    monkeypatch.delenv("SLT_DISPATCH_DEBUG", raising=False)
+    assert dispatch_debug.attach() is None
+    assert (dispatch_debug.step_scope(None, "k")
+            is dispatch_debug.expected_d2h(None))
+
+
+def test_dispatch_force_enables_attach(monkeypatch):
+    externally_on = dispatch_debug.enabled()
+    monkeypatch.delenv("SLT_DISPATCH_DEBUG", raising=False)
+    dispatch_debug.force(True)
+    try:
+        t = dispatch_debug.attach()
+        assert t is dispatch_debug.tracker()
+        assert set(t.gauges()) == {"compile_count",
+                                   "unexpected_d2h_total",
+                                   "steady_state_recompiles"}
+    finally:
+        dispatch_debug.force(False)
+        if not externally_on:
+            dispatch_debug.uninstall()
+
+
+def test_dispatch_watchdog_loss_series_bit_identical(monkeypatch):
+    """SLT_DISPATCH_DEBUG wraps the jitted calls in scopes and nothing
+    else: the same three steps produce a bit-identical loss series on
+    and off — and on the shipped default (off) every hook is None and
+    step_scope/expected_d2h return the shared nullcontext."""
+    from split_learning_tpu.models import get_plan
+    from split_learning_tpu.runtime import ServerRuntime, SplitClientTrainer
+    from split_learning_tpu.transport.local import LocalTransport
+    from split_learning_tpu.utils import Config
+
+    externally_on = dispatch_debug.enabled()
+
+    def series(debug):
+        if debug:
+            monkeypatch.setenv("SLT_DISPATCH_DEBUG", "1")
+        else:
+            monkeypatch.delenv("SLT_DISPATCH_DEBUG", raising=False)
+        cfg = Config(mode="split", batch_size=4, num_clients=1)
+        plan = get_plan(mode="split")
+        sample = np.zeros((4, 28, 28, 1), np.float32)
+        server = ServerRuntime(plan, cfg, jax.random.PRNGKey(2), sample)
+        assert (server._dd is not None) is debug
+        client = SplitClientTrainer(plan, cfg, jax.random.PRNGKey(0),
+                                    LocalTransport(server))
+        assert (client._dd is not None) is debug
+        rs = np.random.RandomState(7)
+        try:
+            return [client.train_step(
+                rs.randn(4, 28, 28, 1).astype(np.float32),
+                rs.randint(0, 10, 4).astype(np.int64), i)
+                for i in range(3)]
+        finally:
+            server.close()
+
+    try:
+        on = series(True)
+        # steady steps over fixed shapes: no watchdog report
+        assert dispatch_debug.tracker().violations == []
+        assert on == series(False)
+    finally:
+        if not externally_on:
+            dispatch_debug.uninstall()

@@ -93,6 +93,28 @@ def test_bench_topk8_role_quick():
     # in the 15-minute full leg
     assert tk["valid"] is True, tk["invalid_reason"]
     assert "synthetic-wire" in tk["platform"]
+    # dispatch watchdog rode along: the leg compiled its jits once and
+    # never retraced in steady state (gated into valid above)
+    cc = tk["compile_count"]
+    assert cc["total"] >= 1
+    assert cc["steady_state"] == 0
+
+
+@pytest.mark.slow
+def test_bench_coalesced_compile_count_quick():
+    """The multi_client_coalesced leg publishes per-leg compile counts
+    from the dispatch watchdog (obs/dispatch_debug.py, forced in-process
+    for the timed runs) and gates steady-state recompiles at 0 — the
+    pow2-padded group signatures must hold across every occupancy."""
+    sys.path.insert(0, REPO)
+    from bench import measure_coalesced
+
+    co = measure_coalesced(quick=True)
+    assert co["leg"] == "multi_client_coalesced"
+    cc = co["compile_count"]
+    assert cc["total"] >= 1
+    assert cc["steady_state"] == 0
+    assert co["valid"] is True, co["invalid_reason"]
 
 
 @pytest.mark.slow

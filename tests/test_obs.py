@@ -350,6 +350,43 @@ def test_trace_report_tolerates_truncated_file(tmp_path):
     assert len(events) >= 5  # metadata + all complete span lines
 
 
+def test_trace_report_compile_summary(tmp_path):
+    """xla_compile spans (the dispatch watchdog's trace export) get
+    their own section: count/total/max plus the steady-state count
+    (args.step >= 2 — a recompile storm). Tolerant: missing or
+    non-numeric step fields count as non-steady, and a trace without
+    compile events renders with no compile section at all."""
+    report = _load_trace_report()
+    lines = [
+        '{"ph": "X", "name": "client_fwd", "ts": 0, "dur": 1000, '
+        '"pid": 1, "tid": 1}',
+        '{"ph": "X", "name": "xla_compile", "ts": 0, "dur": 250000, '
+        '"pid": 2, "tid": 1, "args": {"step": 0}}',
+        '{"ph": "X", "name": "xla_compile", "ts": 1, "dur": 50000, '
+        '"pid": 2, "tid": 1, "args": {"step": 3}}',
+        '{"ph": "X", "name": "xla_compile", "ts": 2, "dur": 10000, '
+        '"pid": 2, "tid": 1}',
+        '{"ph": "X", "name": "xla_compile", "ts": 3, "dur": 10000, '
+        '"pid": 2, "tid": 1, "args": {"step": "?"}}',
+        '{"ph": "X", "name": "xla_comp',  # torn tail of a live file
+    ]
+    torn = tmp_path / "live.json"
+    torn.write_text("[\n" + ",\n".join(lines))
+    events = report.load_events(str(torn))
+    rep = report.summarize(events)
+    comp = rep["compile"]
+    assert comp["count"] == 4
+    assert comp["total_s"] == pytest.approx(0.32)
+    assert comp["max_ms"] == pytest.approx(250.0)
+    assert comp["steady_state_count"] == 1
+    text = report.render(rep)
+    assert "xla compiles: 4" in text and "recompile storm" in text
+    rep0 = report.summarize(
+        [e for e in events if e.get("name") != "xla_compile"])
+    assert rep0["compile"]["count"] == 0
+    assert "xla compiles" not in report.render(rep0)
+
+
 # --------------------------------------------------------------------- #
 # runtime.metrics() snapshot (the in-process twin of GET /metrics)
 

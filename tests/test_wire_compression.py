@@ -98,10 +98,10 @@ def test_bitmap_vs_index_encoding_switch():
     assert "m" in dense and "idx" not in dense
     sparse, _ = codec.topk8_compress(a, 0.01)
     assert "idx" in sparse and "m" not in sparse
-    for packed in (dense, sparse):
+    for packed, density in ((dense, 0.1), (sparse, 0.01)):
         out = codec.decompress_tree(codec.decode(codec.encode(packed)))
         assert out.shape == a.shape and out.dtype == a.dtype
-        k = math.ceil(packed["density"] * a.size)
+        k = math.ceil(density * a.size)
         assert int(np.count_nonzero(out)) <= k
 
 
