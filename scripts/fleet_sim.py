@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Fleet simulation CLI — drive N LocalTransport clients at one in-process
+server and print a JSON summary of per-tenant latency tails.
+
+The runnable face of runtime/fleet.py: builds a split-mode ServerRuntime
+(same recipe as tests/test_coalesce.py), warms it with warm_fleet (shape
+priming + burst rounds — measured runs see zero in-run compiles), then
+runs the configured fleet and prints one JSON object with per-tenant and
+pooled p50/p99 queue-wait and step latency, admission counters, and the
+replay/compile integrity numbers the bench gates on.
+
+Used by CI as a smoke gate (`--gate-dropped-steps` exits 1 if any step
+was dropped) and by hand for regime exploration:
+
+    # 64 bursty clients, 4 tenants, continuous batching
+    python scripts/fleet_sim.py --clients 64 --tenants 4 \
+        --arrival burst --rate 0.05 --burst-size 2 --batching continuous
+
+    # chaos-composed twin of the same run
+    python scripts/fleet_sim.py --clients 64 --tenants 4 --chaos
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from split_learning_tpu.models import get_plan  # noqa: E402
+from split_learning_tpu.obs import dispatch_debug  # noqa: E402
+from split_learning_tpu.runtime.fleet import (  # noqa: E402
+    FleetConfig, run_fleet, warm_fleet)
+from split_learning_tpu.runtime.server import ServerRuntime  # noqa: E402
+from split_learning_tpu.transport.chaos import (  # noqa: E402
+    ChaosPolicy, ChaosTransport)
+from split_learning_tpu.transport.local import LocalTransport  # noqa: E402
+from split_learning_tpu.utils import Config  # noqa: E402
+
+
+def build_server(args: argparse.Namespace) -> ServerRuntime:
+    cfg = Config(mode="split", batch_size=args.batch,
+                 num_clients=args.num_client_slots)
+    plan = get_plan(mode="split")
+    sample = np.zeros((args.batch, 28, 28, 1), np.float32)
+    return ServerRuntime(
+        plan, cfg, jax.random.PRNGKey(args.seed), sample,
+        strict_steps=True,
+        coalesce_max=args.coalesce_max,
+        coalesce_window_ms=args.window_ms,
+        batching=args.batching,
+        tenants=args.tenants,
+        quota=args.quota,
+        slo_ms=args.slo_ms)
+
+
+def make_factory(server: ServerRuntime, args: argparse.Namespace):
+    if not args.chaos:
+        return lambda cid: LocalTransport(server)
+
+    def factory(cid: int):
+        # per-client seeded policy: the chaos twin of a clean run offers
+        # the identical arrival load and a deterministic fault schedule
+        policy = ChaosPolicy(args.chaos_spec,
+                             seed=args.chaos_seed * 1_000_003 + cid)
+        return ChaosTransport(LocalTransport(server), policy)
+    return factory
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--clients", type=int, default=64)
+    ap.add_argument("--tenants", type=int, default=1)
+    ap.add_argument("--steps", type=int, default=3,
+                    help="steps per client")
+    ap.add_argument("--arrival", choices=("poisson", "burst", "diurnal"),
+                    default="burst")
+    ap.add_argument("--rate", type=float, default=0.05,
+                    help="per-client mean arrival rate (Hz)")
+    ap.add_argument("--burst-size", type=int, default=2)
+    ap.add_argument("--batching", choices=("window", "continuous"),
+                    default="continuous")
+    ap.add_argument("--coalesce-max", type=int, default=4)
+    ap.add_argument("--window-ms", type=float, default=50.0)
+    ap.add_argument("--quota", type=float, default=None,
+                    help="per-tenant admitted steps/s (token bucket)")
+    ap.add_argument("--slo-ms", type=float, default=None,
+                    help="per-tenant SLO -> EDF deadline priority")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--workers", type=int, default=16)
+    ap.add_argument("--num-client-slots", type=int, default=1 << 20,
+                    help="server-side client-id capacity")
+    ap.add_argument("--no-warm", action="store_true",
+                    help="skip warm_fleet (compiles land in the run)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="wrap every client wire in ChaosTransport")
+    ap.add_argument("--chaos-spec", default="drop_resp=0.05,dup=0.02",
+                    help="ChaosPolicy spec for --chaos")
+    ap.add_argument("--chaos-seed", type=int, default=7)
+    ap.add_argument("--gate-dropped-steps", action="store_true",
+                    help="exit 1 unless dropped_steps == 0 and every "
+                         "scheduled step completed")
+    args = ap.parse_args()
+
+    server = build_server(args)
+    factory = make_factory(server, args)
+    fcfg = FleetConfig(
+        n_clients=args.clients, tenants=args.tenants,
+        steps_per_client=args.steps, arrival=args.arrival,
+        rate_hz=args.rate, burst_size=args.burst_size,
+        seed=args.seed, workers=args.workers, batch=args.batch)
+
+    dispatch_debug.force(True)
+    try:
+        warm_rounds = 0
+        if not args.no_warm:
+            warm_rounds = warm_fleet(server, factory, fcfg)
+        coalescing = server.health().get("coalescing", {})
+        compiles_before = coalescing.get("compile_count", 0)
+        res = run_fleet(fcfg, factory)
+        health = server.health()
+        coalescing = health.get("coalescing", {})
+        replay = (server.replay.counters()
+                  if server.replay is not None else None)
+    finally:
+        dispatch_debug.force(False)
+        server.close()
+
+    expected = args.clients * args.steps
+    completed = int(res.counters.get("fleet_steps_total", 0))
+    dropped = int(res.counters.get("fleet_dropped_steps", 0))
+    summary = {
+        "config": {
+            "clients": args.clients, "tenants": args.tenants,
+            "steps_per_client": args.steps, "arrival": args.arrival,
+            "rate_hz": args.rate, "burst_size": args.burst_size,
+            "batching": args.batching, "coalesce_max": args.coalesce_max,
+            "window_ms": args.window_ms, "quota": args.quota,
+            "slo_ms": args.slo_ms, "seed": args.seed,
+            "chaos": bool(args.chaos),
+        },
+        "warm_rounds": warm_rounds,
+        "wall_s": round(res.wall_s, 3),
+        "steps_expected": expected,
+        "steps_completed": completed,
+        "dropped_steps": dropped,
+        "backpressure_total": int(
+            res.counters.get("fleet_backpressure_total", 0)),
+        "retries_total": int(res.counters.get("fleet_retries_total", 0)),
+        "mean_loss": None if completed == 0 else round(res.mean_loss, 6),
+        "compiles_in_run": (coalescing.get("compile_count", 0)
+                            - compiles_before),
+        "overall": {k: round(v, 3) for k, v in res.overall.items()},
+        "per_tenant": {
+            str(t): {k: (round(v, 3) if isinstance(v, float) else v)
+                     for k, v in row.items()}
+            for t, row in res.per_tenant.items()},
+        "admission": health.get("admission"),
+        "replay": replay,
+    }
+    print(json.dumps(summary, indent=1))
+
+    if args.gate_dropped_steps:
+        ok = dropped == 0 and completed == expected
+        if not ok:
+            print(f"[fleet_sim] GATE FAILED: dropped={dropped} "
+                  f"completed={completed}/{expected}", file=sys.stderr)
+            return 1
+        print(f"[fleet_sim] gate ok: {completed}/{expected} steps, "
+              f"0 dropped", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -82,7 +82,37 @@ def _percentile(sorted_xs: List[float], q: float) -> float:
     return sorted_xs[lo] + (sorted_xs[hi] - sorted_xs[lo]) * (idx - lo)
 
 
-def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+def tenant_queue_waits(events: List[Dict[str, Any]],
+                       tenants: int) -> Dict[str, Any]:
+    """Per-tenant ``queue_wait`` tail table. Server spans carry the
+    client id as the Chrome ``tid`` field, and the admission layer's
+    tenant mapping is ``client_id % tenants`` (runtime/admission.py
+    default) — so a multi-tenant fleet trace splits into per-tenant
+    queue-wait distributions with no extra instrumentation. Tolerant:
+    spans with a missing/non-numeric tid land in tenant 0."""
+    by_tenant: Dict[int, List[float]] = {t: [] for t in range(tenants)}
+    for e in events:
+        if e.get("ph") != "X" or e.get("name") != "queue_wait":
+            continue
+        try:
+            tid = int(e.get("tid", 0))
+        except (TypeError, ValueError):
+            tid = 0
+        by_tenant[tid % tenants].append(float(e.get("dur", 0.0)) / 1e6)
+    table = {}
+    for t, xs in sorted(by_tenant.items()):
+        xs = sorted(xs)
+        table[str(t)] = {
+            "count": len(xs),
+            "mean_ms": (sum(xs) / len(xs) * 1e3) if xs else 0.0,
+            "p50_ms": _percentile(xs, 50) * 1e3,
+            "p99_ms": _percentile(xs, 99) * 1e3,
+        }
+    return table
+
+
+def summarize(events: List[Dict[str, Any]],
+              tenants: int = 0) -> Dict[str, Any]:
     spans = [e for e in events if e.get("ph") == "X"]
     by_phase: Dict[str, List[float]] = {}
     for e in spans:
@@ -147,7 +177,7 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "steady_state_count": steady_compiles,
     }
 
-    return {
+    rep = {
         "events": len(events),
         "spans": len(spans),
         "steps_with_wall_clock": len(ratios),
@@ -158,6 +188,9 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "compile": compile_summary,
         "span_sum_over_wall_clock": coverage,
     }
+    if tenants > 0:
+        rep["tenant_queue_wait"] = tenant_queue_waits(events, tenants)
+    return rep
 
 
 def render(rep: Dict[str, Any]) -> str:
@@ -189,6 +222,16 @@ def render(rep: Dict[str, Any]) -> str:
             f"steady-state (step >= 2): {comp['steady_state_count']}"
             + ("  <-- recompile storm"
                if comp["steady_state_count"] else ""))
+    tqw = rep.get("tenant_queue_wait")
+    if tqw:
+        lines.append("")
+        lines.append("per-tenant queue wait (client_id % tenants):")
+        lines.append(f"  {'tenant':<8} {'count':>6} {'mean_ms':>9} "
+                     f"{'p50_ms':>9} {'p99_ms':>9}")
+        for t, row in tqw.items():
+            lines.append(
+                f"  {t:<8} {row['count']:>6d} {row['mean_ms']:>9.3f} "
+                f"{row['p50_ms']:>9.3f} {row['p99_ms']:>9.3f}")
     cov = rep["span_sum_over_wall_clock"]
     if cov is not None:
         lines.append("")
@@ -204,13 +247,17 @@ def main(argv=None) -> int:
     ap.add_argument("trace", help="Chrome-trace file (obs export)")
     ap.add_argument("--json", action="store_true",
                     help="emit the report as JSON instead of the table")
+    ap.add_argument("--tenants", type=int, default=0,
+                    help="split server queue_wait spans into N tenants "
+                         "(client_id %% N) and add a per-tenant tail "
+                         "table")
     args = ap.parse_args(argv)
     events = load_events(args.trace)
     if not events:
         print(f"[trace_report] no events parsed from {args.trace}",
               file=sys.stderr)
         return 1
-    rep = summarize(events)
+    rep = summarize(events, tenants=max(args.tenants, 0))
     try:
         print(json.dumps(rep, indent=2) if args.json else render(rep))
     except BrokenPipeError:  # | head

@@ -893,7 +893,11 @@ def cmd_serve(args) -> int:
                                 strict_steps=not args.allow_out_of_order,
                                 coalesce_max=args.coalesce_max,
                                 coalesce_window_ms=args.coalesce_window_ms,
-                                overlap=not args.no_overlap)
+                                overlap=not args.no_overlap,
+                                batching=args.batching,
+                                tenants=args.tenants,
+                                quota=args.quota,
+                                slo_ms=args.slo_ms)
     except ValueError as e:  # e.g. --coalesce-max outside split mode
         print(f"[error] {e}", file=sys.stderr)
         return 2
@@ -1411,6 +1415,29 @@ def main(argv: Optional[list] = None) -> int:
                     help="how long a coalescing group waits for peers "
                          "after its first request before flushing partial "
                          "(only with --coalesce-max > 1)")
+    ps.add_argument("--batching", choices=["window", "continuous"],
+                    default="window",
+                    help="coalescer flush policy (with --coalesce-max > "
+                         "1): 'window' waits out --coalesce-window-ms "
+                         "for peers; 'continuous' dispatches whatever is "
+                         "admitted the moment the previous group is in "
+                         "flight, earliest-SLO-deadline first (see "
+                         "README 'Continuous batching & admission "
+                         "control')")
+    ps.add_argument("--tenants", type=int, default=1,
+                    help="admission control: number of tenants; clients "
+                         "map to tenants by client_id %% tenants")
+    ps.add_argument("--quota", type=float, default=None,
+                    help="admission control: per-tenant quota in "
+                         "steps/sec (token bucket; burst = one second "
+                         "of quota). Over-quota requests get HTTP 429 "
+                         "+ Retry-After instead of queueing; unset = "
+                         "unlimited")
+    ps.add_argument("--slo-ms", dest="slo_ms", type=float, default=None,
+                    help="admission control: per-tenant latency SLO; "
+                         "admitted requests are stamped now+slo-ms and "
+                         "the continuous batcher picks groups earliest-"
+                         "deadline-first")
     ps.add_argument("--no-overlap", dest="no_overlap", action="store_true",
                     help="materialize step results while holding the "
                          "device lock instead of off-lock (disables the "

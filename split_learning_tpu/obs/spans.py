@@ -35,6 +35,18 @@ D2H = "d2h"
 # and, under SLT_LOCK_DEBUG=1, by obs/locks.py InstrumentedLock
 LOCK_HOLD = "lock_hold"
 
+# -- admission control (runtime/admission.py) -------------------------- #
+# metrics-only names: counters/gauges the AdmissionController feeds and
+# ServerRuntime.metrics() folds in (render_prometheus adds the slt_
+# prefix -> slt_admission_*). Deliberately NOT in the phase tuples below:
+# admission happens before a request has a trace, and the pinned tuples
+# are byte-equal-mirrored by scripts/trace_report.py's stdlib fallback.
+ADMISSION_ADMITTED = "admission_admitted"
+ADMISSION_REJECTED = "admission_rejected"
+ADMISSION_QUEUE_DEPTH = "admission_queue_depth"
+# histogram of the advised Retry-After delays handed to rejected callers
+ADMISSION_RETRY_AFTER = "admission_retry_after"
+
 # XLA compile events surfaced by obs/dispatch_debug.py under
 # SLT_DISPATCH_DEBUG=1 — a recompile storm shows up on the timeline and
 # in trace_report.py's compile summary; deliberately NOT in SERVER_PHASES

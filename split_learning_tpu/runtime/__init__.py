@@ -5,7 +5,10 @@ from split_learning_tpu.runtime.client import (
     StepRecord,
     USplitClientTrainer,
 )
+from split_learning_tpu.runtime.admission import AdmissionController
 from split_learning_tpu.runtime.breaker import CircuitBreaker
+from split_learning_tpu.runtime.coalesce import (
+    ContinuousBatcher, RequestCoalescer)
 from split_learning_tpu.runtime.checkpoint import Checkpointer, joint_state
 from split_learning_tpu.runtime.generate import (
     generate_remote, greedy_generate, sample_generate)
@@ -30,4 +33,5 @@ __all__ = [
     "PipelinedSplitClientTrainer", "greedy_generate", "sample_generate",
     "evaluate", "evaluate_remote", "generate_remote",
     "CircuitBreaker", "ReplayCache",
+    "AdmissionController", "ContinuousBatcher", "RequestCoalescer",
 ]

@@ -1,0 +1,178 @@
+"""Multi-tenant admission control — token-bucket quotas + EDF deadlines.
+
+The serve path's missing layer for the ROADMAP's bursty-fleet north
+star: without it, an over-subscribed server makes every client pay in
+silent queue time (the coalescer future times out after 120 s with no
+explanation), and one greedy tenant can starve everyone else. This
+module makes refusal *explicit and typed*:
+
+- **Per-tenant token buckets.** Each tenant (``client_id %% tenants`` by
+  default) accrues ``quota`` tokens/second up to a ``burst`` cap; one
+  admitted step spends one token. An empty bucket raises
+  :class:`~split_learning_tpu.transport.base.Backpressure` carrying
+  exactly how long until the next token accrues — HTTP transports map
+  it to 429 + ``Retry-After``, LocalTransport surfaces it in-process.
+- **SLO-aware deadlines.** Admission stamps each request with
+  ``now + slo_ms`` for its tenant; the continuous batcher
+  (runtime/coalesce.py) picks its next group head
+  earliest-deadline-first, so a tight-SLO tenant's request overtakes a
+  batch-tenant backlog instead of waiting FIFO behind it.
+
+Deterministic by design: no RNG, all timing from one injectable
+monotonic clock — a fleet-sim run with a virtual clock reproduces its
+admission sequence exactly. Lock discipline (slt-lint SLT001): the one
+lock guards pure bucket arithmetic; nothing under it blocks, sleeps, or
+materializes.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+from split_learning_tpu.obs import locks as obs_locks
+from split_learning_tpu.obs import spans
+from split_learning_tpu.transport.base import Backpressure
+
+
+def _per_tenant(value: Union[None, float, Sequence[float]],
+                tenants: int, name: str) -> Optional[List[float]]:
+    """Broadcast a scalar knob (or validate a per-tenant sequence) into
+    one float per tenant; None stays None (feature off)."""
+    if value is None:
+        return None
+    if isinstance(value, (int, float)):
+        return [float(value)] * tenants
+    vals = [float(v) for v in value]
+    if len(vals) != tenants:
+        raise ValueError(
+            f"{name} must be a scalar or one value per tenant "
+            f"(got {len(vals)} values for {tenants} tenants)")
+    return vals
+
+
+class AdmissionController:
+    """Thread-safe admission gate in front of the split-step path.
+
+    ``admit(client_id)`` either returns the request's EDF deadline (a
+    monotonic-clock instant, or None when no SLO is configured) or
+    raises :class:`Backpressure` with the advised retry delay.
+    ``complete(client_id)`` releases the in-flight slot the admit
+    charged — the per-tenant queue-depth gauge is the difference.
+
+    ``quota`` is in admitted steps/second per tenant (None = unlimited:
+    every request admits, deadlines still apply). ``burst`` caps the
+    bucket (default: one second of quota, floor 1 token) so an idle
+    tenant can open with a burst without banking unbounded credit.
+    """
+
+    def __init__(self, tenants: int = 1,
+                 quota: Union[None, float, Sequence[float]] = None,
+                 burst: Union[None, float, Sequence[float]] = None,
+                 slo_ms: Union[None, float, Sequence[float]] = None,
+                 tenant_of: Optional[Callable[[int], int]] = None,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        if tenants < 1:
+            raise ValueError(f"tenants must be >= 1 (got {tenants})")
+        self.tenants = int(tenants)
+        self._quota = _per_tenant(quota, self.tenants, "quota")
+        if self._quota is not None and any(q <= 0 for q in self._quota):
+            raise ValueError(f"quota must be > 0 (got {self._quota})")
+        if burst is None and self._quota is not None:
+            self._burst = [max(q, 1.0) for q in self._quota]
+        else:
+            self._burst = _per_tenant(burst, self.tenants, "burst")
+        if self._burst is not None and any(b < 1 for b in self._burst):
+            raise ValueError(
+                f"burst must allow at least one token (got {self._burst})")
+        slo = _per_tenant(slo_ms, self.tenants, "slo_ms")
+        self._slo_s = None if slo is None else [v / 1e3 for v in slo]
+        self._tenant_of = tenant_of
+        self._clock = clock
+        self._lock = obs_locks.make_lock("AdmissionController._lock")
+        # buckets start full: a fresh server admits an opening burst
+        self._tokens = (list(self._burst) if self._burst is not None
+                        else None)
+        self._refill_at = [self._clock()] * self.tenants
+        self._depth = [0] * self.tenants
+        self._admitted = [0] * self.tenants
+        self._rejected = [0] * self.tenants
+
+    # ------------------------------------------------------------------ #
+    def tenant_of(self, client_id: int) -> int:
+        if self._tenant_of is not None:
+            return int(self._tenant_of(client_id)) % self.tenants
+        return int(client_id) % self.tenants
+
+    def admit(self, client_id: int) -> Optional[float]:
+        """Charge one step against ``client_id``'s tenant. Returns the
+        EDF deadline (monotonic seconds; None without an SLO) or raises
+        :class:`Backpressure` with ``retry_after_s`` = time until the
+        bucket next holds a whole token."""
+        t = self.tenant_of(client_id)
+        now = self._clock()
+        with self._lock:
+            if self._quota is not None:
+                rate = self._quota[t]
+                tokens = min(
+                    self._burst[t],
+                    self._tokens[t] + (now - self._refill_at[t]) * rate)
+                self._refill_at[t] = now
+                if tokens < 1.0:
+                    self._tokens[t] = tokens
+                    self._rejected[t] += 1
+                    retry_after = (1.0 - tokens) / rate
+                else:
+                    self._tokens[t] = tokens - 1.0
+                    retry_after = None
+            else:
+                retry_after = None
+            if retry_after is None:
+                self._admitted[t] += 1
+                self._depth[t] += 1
+        if retry_after is not None:
+            raise Backpressure(
+                f"tenant {t} over quota ({self._quota[t]:g} steps/s): "
+                f"retry in {retry_after:.3f}s", retry_after_s=retry_after)
+        return (now + self._slo_s[t]) if self._slo_s is not None else None
+
+    def complete(self, client_id: int) -> None:
+        """Release the in-flight slot an :meth:`admit` charged (success
+        or failure — callers pair the two in try/finally)."""
+        t = self.tenant_of(client_id)
+        with self._lock:
+            self._depth[t] = max(self._depth[t] - 1, 0)
+
+    # ------------------------------------------------------------------ #
+    def counters(self) -> Dict[str, float]:
+        """Snapshot for /health and ServerRuntime.metrics(): totals plus
+        per-tenant admitted/rejected splits (``_t<i>`` suffixed, the
+        starvation test's measurement surface)."""
+        with self._lock:
+            admitted = list(self._admitted)
+            rejected = list(self._rejected)
+        out: Dict[str, float] = {
+            spans.ADMISSION_ADMITTED: float(sum(admitted)),
+            spans.ADMISSION_REJECTED: float(sum(rejected)),
+        }
+        for i in range(self.tenants):
+            out[f"{spans.ADMISSION_ADMITTED}_t{i}"] = float(admitted[i])
+            out[f"{spans.ADMISSION_REJECTED}_t{i}"] = float(rejected[i])
+        return out
+
+    def gauges(self) -> Dict[str, float]:
+        """Per-tenant in-flight depth (admitted minus completed) — the
+        queue-depth gauge /metrics exposes as
+        ``slt_admission_queue_depth_t<i>``."""
+        with self._lock:
+            depth = list(self._depth)
+        return {f"{spans.ADMISSION_QUEUE_DEPTH}_t{i}": float(depth[i])
+                for i in range(self.tenants)}
+
+    def config(self) -> Dict[str, object]:
+        """The knobs as configured, for /health introspection."""
+        return {"tenants": self.tenants,
+                "quota": self._quota,
+                "burst": self._burst,
+                "slo_ms": (None if self._slo_s is None
+                           else [s * 1e3 for s in self._slo_s])}

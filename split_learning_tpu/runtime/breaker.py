@@ -70,7 +70,7 @@ class CircuitBreaker:
         self.counters: Dict[str, int] = {
             "breaker_opened": 0, "breaker_probes": 0,
             "breaker_probe_failures": 0, "breaker_reclosed": 0,
-            "breaker_reopened": 0}
+            "breaker_reopened": 0, "breaker_backpressure_waits": 0}
 
     # ------------------------------------------------------------------ #
     def record_failure(self) -> None:
@@ -93,6 +93,19 @@ class CircuitBreaker:
             if self.state != CLOSED:
                 self.state = CLOSED
                 self.counters["breaker_reclosed"] += 1
+
+    def backpressure_wait(self, delay_s: float) -> None:
+        """Honor an explicit 429/Retry-After (transport/base.py
+        Backpressure): wait the peer's advised delay WITHOUT counting a
+        failure — the server is healthy and talking, it just refused
+        this tenant's step, so tripping the breaker open (and burning
+        /health probes against a fine server) would be a spurious open.
+        State and the consecutive-failure count are untouched."""
+        with self._lock:
+            self.counters["breaker_backpressure_waits"] += 1
+        # sleep outside the lock: other threads' record_* must not queue
+        # behind a quota wait
+        self._sleep(max(float(delay_s), 0.0))
 
     # ------------------------------------------------------------------ #
     def before_attempt(self) -> None:

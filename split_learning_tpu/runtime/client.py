@@ -42,7 +42,8 @@ from split_learning_tpu.obs import spans
 from split_learning_tpu.obs import trace as obs_trace
 from split_learning_tpu.runtime.state import (
     TrainState, apply_grads, make_state, make_tx)
-from split_learning_tpu.transport.base import Transport, TransportError
+from split_learning_tpu.transport.base import (
+    Backpressure, Transport, TransportError)
 from split_learning_tpu.utils.config import Config
 
 
@@ -192,6 +193,23 @@ class SplitClientTrainer:
                               time.perf_counter() - t_tr0, trace_id=tid,
                               tid=self.client_id, step=step)
                 break
+            except Backpressure as exc:
+                # explicit 429/Retry-After: flow control from a healthy
+                # server, not a wire failure — never counts toward the
+                # breaker threshold, and the wait is the peer's advised
+                # delay instead of blind exponential backoff
+                attempt += 1
+                if (self.failure_policy == FailurePolicy.RETRY
+                        and attempt <= self.max_retries):
+                    if self.breaker is not None:
+                        self.breaker.backpressure_wait(exc.retry_after_s)
+                    elif exc.retry_after_s > 0:
+                        time.sleep(exc.retry_after_s)
+                    continue
+                if self.failure_policy == FailurePolicy.SKIP:
+                    self.dropped_batches += 1
+                    return None
+                raise
             except TransportError:
                 if self.breaker is not None:
                     self.breaker.record_failure()

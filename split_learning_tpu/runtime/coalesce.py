@@ -17,6 +17,22 @@ of one reproduces the serialized semantics. A group of one is also what a
 window flush with a single waiter produces, which is why ``max_group=1``
 servers skip this module entirely (bit-for-bit serialized path).
 
+Two flush policies share the queue (``mode`` ctor knob):
+
+- ``"window"`` (default, the original): block for a head request, then
+  wait out ``window_s`` from its arrival hoping peers show up. Best
+  batches under steady offered load, but every window is accelerator
+  idle time when traffic is bursty.
+- ``"continuous"`` (:class:`ContinuousBatcher`): the flusher NEVER
+  sleeps on a timer while work is queued — the moment the previous
+  group's dispatch returns (with async dispatch, PR 5, that is the
+  moment the jitted call is *enqueued*, not completed), the next group
+  is whatever is admitted right now, picked earliest-deadline-first on
+  the ``deadline`` the admission layer stamped (runtime/admission.py).
+  Group size therefore adapts to arrival rate up to ``max_group``
+  by itself: idle server -> groups of one at minimum latency; backlog
+  -> full groups at maximum amortization.
+
 This is the queue half; the batched math lives in
 :meth:`ServerRuntime._dispatch_group` (runtime/server.py), injected as
 ``dispatch`` so the coalescer stays free of jax and trivially testable.
@@ -64,6 +80,9 @@ class CoalesceRequest:
     trace_id: Optional[str] = None
     t_enqueue: Optional[float] = None
     server_spans: Optional[dict] = None
+    # EDF priority (continuous mode): the monotonic-clock SLO deadline
+    # the admission layer stamped, None = no SLO (sorts last, FIFO)
+    deadline: Optional[float] = None
 
     def shape_key(self) -> tuple:
         """Requests coalesce only when everything but the batch row count
@@ -91,7 +110,8 @@ class RequestCoalescer:
     """
 
     def __init__(self, dispatch: Callable[[List[CoalesceRequest], str], None],
-                 max_group: int, window_s: float) -> None:
+                 max_group: int, window_s: float,
+                 mode: str = "window") -> None:
         if max_group < 2:
             raise ValueError(
                 f"coalescing needs max_group >= 2 (got {max_group}); "
@@ -99,9 +119,13 @@ class RequestCoalescer:
                 "coalescer for it")
         if window_s < 0:
             raise ValueError(f"window must be >= 0 (got {window_s})")
+        if mode not in ("window", "continuous"):
+            raise ValueError(
+                f"mode must be 'window' or 'continuous' (got {mode!r})")
         self._dispatch = dispatch
         self.max_group = max_group
         self.window_s = window_s
+        self.mode = mode
         self.stats = TransportStats()
         self._queue: List[CoalesceRequest] = []
         self._cond = threading.Condition(
@@ -115,7 +139,8 @@ class RequestCoalescer:
     def submit(self, acts: np.ndarray, labels: np.ndarray, step: int,
                client_id: int, timeout: float = 120.0,
                trace_id: Optional[str] = None,
-               t_enqueue: Optional[float] = None
+               t_enqueue: Optional[float] = None,
+               deadline: Optional[float] = None
                ) -> Tuple[np.ndarray, float]:
         """Enqueue one request and block until its group's dispatch
         resolves it. Server-side errors (ProtocolError included) re-raise
@@ -128,7 +153,7 @@ class RequestCoalescer:
         CTX so the transport can return them to the client."""
         req = CoalesceRequest(np.asarray(acts), np.asarray(labels),
                               step, client_id, trace_id=trace_id,
-                              t_enqueue=t_enqueue)
+                              t_enqueue=t_enqueue, deadline=deadline)
         with self._cond:
             if self._closed:
                 raise RuntimeError("coalescer is closed")
@@ -159,14 +184,43 @@ class RequestCoalescer:
 
     # ------------------------------------------------------------------ #
     def _collect_group(self) -> Optional[Tuple[List[CoalesceRequest], str]]:
-        """Block for a head request, then gather same-shape peers until
-        the group is full or the window since the head's arrival closes.
-        Returns None only at shutdown."""
+        """Block for a head request, then form the next group by mode:
+        window mode gathers same-shape peers until the group is full or
+        the window since the head's arrival closes; continuous mode takes
+        whatever is queued RIGHT NOW (earliest-deadline-first head, then
+        its same-shape peers in EDF order) without ever sleeping on a
+        timer. Returns None only at shutdown."""
         with self._cond:
             while not self._queue and not self._closed:
                 self._cond.wait()
             if not self._queue:
                 return None  # closed and drained
+
+            if self.mode == "continuous":
+                # EDF: undeadlined requests sort last, arrival order
+                # breaks ties — a tight-SLO tenant's request becomes the
+                # head even behind a batch-tenant backlog
+                order = sorted(
+                    range(len(self._queue)),
+                    key=lambda i: (
+                        self._queue[i].deadline
+                        if self._queue[i].deadline is not None
+                        else float("inf"), i))
+                key = self._queue[order[0]].shape_key()
+                group: List[CoalesceRequest] = []
+                taken = set()
+                for i in order:
+                    if len(group) >= self.max_group:
+                        break
+                    if self._queue[i].shape_key() == key:
+                        group.append(self._queue[i])
+                        taken.add(i)
+                self._queue = [r for i, r in enumerate(self._queue)
+                               if i not in taken]
+                reason = ("full" if len(group) >= self.max_group
+                          else "continuous")
+                return group, reason
+
             head = self._queue[0]
             key = head.shape_key()
             deadline = time.monotonic() + self.window_s
@@ -180,9 +234,9 @@ class RequestCoalescer:
                         remaining.append(r)
                 self._queue = remaining
 
-            group: List[CoalesceRequest] = []
+            group = []
             take_matching(group)
-            while len(group) < self.max_group:
+            while len(group) < self.max_group and not self._closed:
                 budget = deadline - time.monotonic()
                 if budget <= 0:
                     break
@@ -226,8 +280,32 @@ class RequestCoalescer:
 
     def close(self, timeout: float = 5.0) -> None:
         """Stop accepting requests, flush what is queued, join the
-        flusher. Idempotent."""
+        flusher, then fail anything STILL queued (flusher wedged in a
+        dispatch, or more arrived than it drained before the join
+        deadline) with a terminal error — a waiter must never hang out
+        its full submit() timeout because the server shut down under it.
+        Idempotent."""
         with self._cond:
             self._closed = True
             self._cond.notify_all()
         self._thread.join(timeout=timeout)
+        with self._cond:
+            leftovers, self._queue = self._queue, []
+        for r in leftovers:
+            if not r.done.is_set():
+                r.error = RuntimeError(
+                    "coalescer closed before dispatch")
+                r.done.set()
+
+
+class ContinuousBatcher(RequestCoalescer):
+    """A :class:`RequestCoalescer` pinned to continuous mode: the next
+    dispatch group is whatever is admitted the moment the previous
+    group's dispatch returns — no window timer, EDF head selection.
+    ``window_s`` exists only so the two modes are ctor-compatible for
+    the runtime's ``batching`` knob; continuous collection never waits
+    on it."""
+
+    def __init__(self, dispatch: Callable[[List[CoalesceRequest], str], None],
+                 max_group: int, window_s: float = 0.0) -> None:
+        super().__init__(dispatch, max_group, window_s, mode="continuous")

@@ -1,0 +1,404 @@
+"""Fleet-simulation harness — thousands of cheap clients against one server.
+
+The workload-generation half of the ROADMAP's bursty-fleet proof: the
+continuous batcher and the admission layer claim to survive "millions of
+clients hitting one server half", and a claim like that needs a harness,
+not a microbenchmark. This module drives N ``LocalTransport`` clients
+(thread-pooled — a client here is one pending step event, not a jitted
+trainer, so 1000 clients cost 1000 list entries) through deterministic
+per-client arrival processes and records per-tenant p50/p99 queue-wait
+and step latency from the PR-2 histograms.
+
+Arrival processes (all seeded per client — run twice, get the same
+offered load to the microsecond):
+
+- ``poisson``: exponential inter-arrivals at ``rate_hz`` per client —
+  the steady-state baseline.
+- ``burst``: arrivals clump in groups of ``burst_size`` separated by
+  quiet gaps — the window-flusher's worst case (every burst pays the
+  window, every gap wastes it) and the continuous batcher's best.
+- ``diurnal``: a slow sinusoidal modulation of the poisson rate — the
+  day/night load curve replication work will care about.
+
+Chaos composes: pass a ``make_transport`` factory that wraps each
+client's LocalTransport in a ChaosTransport (transport/chaos.py) and the
+fleet inherits the seeded fault schedule; the retry loop here rides the
+same bounded-faults guarantee the trainers do. Backpressure (429 /
+Retry-After) is honored per client: the advised delay reschedules the
+step instead of burning a retry.
+
+Lock discipline (SLT001): the scheduler condition guards only the event
+heap; waiting happens in ``cond.wait`` (held-receiver, allowed) and every
+transport call runs lock-free on the worker thread.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import math
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from split_learning_tpu.obs import locks as obs_locks
+from split_learning_tpu.obs import spans
+from split_learning_tpu.obs import trace as obs_trace
+from split_learning_tpu.obs.metrics import Registry, histogram_percentile
+from split_learning_tpu.transport.base import Backpressure, TransportError
+
+# cut-layer payload shape of the default mnist split plan (what
+# tests/test_chaos.py drives the raw wire with); the harness shares ONE
+# activations/labels pair across the whole fleet — offered load is about
+# arrival times and admission, not per-client data
+CUT_SHAPE = (26, 26, 32)
+
+# pooled-across-tenants histogram suffix: the fleet-level p99 the bench
+# gate compares (per-tenant tails have 1/tenants the samples — noisier)
+OVERALL = "overall"
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """One fleet run: who arrives, when, and how hard."""
+
+    n_clients: int = 64
+    tenants: int = 1
+    steps_per_client: int = 3
+    arrival: str = "poisson"          # poisson | burst | diurnal
+    rate_hz: float = 50.0             # per-client mean arrival rate
+    burst_size: int = 8               # burst mode: arrivals per clump
+    diurnal_period_s: float = 2.0     # diurnal mode: one "day"
+    seed: int = 0
+    workers: int = 16
+    batch: int = 8
+    # client ids are offset..offset+n_clients-1: a warmup fleet against
+    # the SAME server uses a disjoint id range (offset by a multiple of
+    # ``tenants``, preserving the tenant mapping) so the strict step
+    # handshake never sees a step replayed across phases
+    client_id_offset: int = 0
+    max_retries: int = 6              # transient TransportError budget
+    backpressure_budget_s: float = 30.0  # max cumulative 429 waiting/step
+    trace: bool = True                # per-request server queue-wait
+
+    def __post_init__(self) -> None:
+        if self.arrival not in ("poisson", "burst", "diurnal"):
+            raise ValueError(
+                f"arrival must be poisson|burst|diurnal "
+                f"(got {self.arrival!r})")
+        if self.n_clients < 1 or self.steps_per_client < 1:
+            raise ValueError("need at least one client and one step")
+        if self.rate_hz <= 0:
+            raise ValueError(f"rate_hz must be > 0 (got {self.rate_hz})")
+
+
+@dataclasses.dataclass
+class FleetResult:
+    """What a run proves: per-tenant latency tails + integrity counters."""
+
+    counters: Dict[str, float]
+    per_tenant: Dict[int, Dict[str, float]]
+    # pooled across tenants: N× the per-tenant sample count, so the p99
+    # the bench gate compares isn't one tenant's single worst sample
+    overall: Dict[str, float]
+    losses: Dict[Tuple[int, int], float]   # (client_id, step) -> loss
+    wall_s: float
+
+    @property
+    def mean_loss(self) -> float:
+        return (sum(self.losses.values()) / len(self.losses)
+                if self.losses else float("nan"))
+
+
+def arrival_offsets(cfg: FleetConfig, client_id: int) -> List[float]:
+    """The client's deterministic arrival schedule: absolute offsets (s)
+    from fleet start for each of its steps. Seeded per (seed, client_id)
+    so a rerun — or a chaos-wrapped twin — offers the identical load."""
+    rng = random.Random(cfg.seed * 1_000_003 + client_id)
+    mean_gap = 1.0 / cfg.rate_hz
+    t = rng.random() * mean_gap  # desynchronized start
+    out: List[float] = []
+    for k in range(cfg.steps_per_client):
+        out.append(t)
+        if cfg.arrival == "poisson":
+            t += rng.expovariate(cfg.rate_hz)
+        elif cfg.arrival == "burst":
+            # clump burst_size arrivals ~together, then a gap long
+            # enough to keep the mean rate: worst case for a window
+            # flusher, best case for continuous batching
+            if (k + 1) % cfg.burst_size:
+                t += mean_gap * 0.02 * rng.random()
+            else:
+                t += mean_gap * cfg.burst_size * (0.75 + 0.5 * rng.random())
+        else:  # diurnal
+            phase = 2.0 * math.pi * (t / cfg.diurnal_period_s)
+            rate = cfg.rate_hz * (0.55 + 0.45 * math.sin(phase))
+            t += rng.expovariate(max(rate, 1e-6))
+    return out
+
+
+class FleetHarness:
+    """Runs one fleet against a transport factory.
+
+    ``make_transport(client_id)`` returns the client's wire — plain
+    ``LocalTransport(server)`` for a clean run, a ChaosTransport wrap
+    for a faulty twin. Per-client steps are strictly sequential (the
+    server's step handshake requires it); the fleet-level interleaving
+    comes from the arrival schedules.
+    """
+
+    def __init__(self, cfg: FleetConfig,
+                 make_transport: Callable[[int], Any]) -> None:
+        self.cfg = cfg
+        self._make_transport = make_transport
+        self.registry = Registry()
+        rs = np.random.RandomState(cfg.seed)
+        self._acts = rs.randn(cfg.batch, *CUT_SHAPE).astype(np.float32)
+        self._labels = rs.randint(0, 10, (cfg.batch,)).astype(np.int64)
+        self._cond = threading.Condition(
+            obs_locks.make_lock("FleetHarness._cond"))
+        # (due, seq, client_id, step) — seq breaks due-time ties FIFO
+        self._heap: List[Tuple[float, int, int, int]] = []
+        self._seq = 0
+        self._inflight = 0
+        self._losses: Dict[Tuple[int, int], float] = {}
+        off = cfg.client_id_offset
+        self._schedules = {off + c: arrival_offsets(cfg, off + c)
+                           for c in range(cfg.n_clients)}
+
+    # -- scheduler ----------------------------------------------------- #
+    def _push(self, due: float, client_id: int, step: int) -> None:
+        with self._cond:
+            heapq.heappush(self._heap, (due, self._seq, client_id, step))
+            self._seq += 1
+            self._cond.notify()
+
+    def _pop_due(self) -> Optional[Tuple[int, int]]:
+        """Next (client_id, step) whose due time has arrived; None when
+        the fleet is drained. Waiting happens on the held condition, so
+        an earlier-due push wakes us instead of oversleeping."""
+        with self._cond:
+            while True:
+                if not self._heap and self._inflight == 0:
+                    return None
+                now = time.monotonic()
+                if self._heap and self._heap[0][0] <= now:
+                    due, _, client_id, step = heapq.heappop(self._heap)
+                    self._inflight += 1
+                    return client_id, step
+                timeout = (min(self._heap[0][0] - now, 0.2)
+                           if self._heap else 0.2)
+                self._cond.wait(timeout=timeout)
+
+    def _done_one(self) -> None:
+        with self._cond:
+            self._inflight -= 1
+            self._cond.notify_all()
+
+    # -- one step ------------------------------------------------------ #
+    def _run_step(self, transport: Any, client_id: int, step: int) -> None:
+        cfg = self.cfg
+        tenant = client_id % cfg.tenants
+        reg = self.registry
+        retries = 0
+        bp_waited = 0.0
+        # per-call server queue-wait: the traced transport folds the
+        # server's spans into its stats as span_<name>_s counters; this
+        # client's transport is driven serially by one worker, so the
+        # before/after delta is exactly this step's queue wait
+        qw_key = f"span_{spans.QUEUE_WAIT}_s"
+        qw0 = transport.stats.counters.get(qw_key, 0.0)
+        t0 = time.perf_counter()
+        while True:
+            try:
+                _, loss = transport.split_step(
+                    self._acts, self._labels, step, client_id)
+                break
+            except Backpressure as exc:
+                reg.incr("fleet_backpressure_total")
+                reg.incr(f"fleet_backpressure_t{tenant}")
+                if bp_waited >= cfg.backpressure_budget_s:
+                    reg.incr("fleet_dropped_steps")
+                    reg.incr(f"fleet_dropped_t{tenant}")
+                    return
+                delay = min(max(exc.retry_after_s, 1e-3),
+                            cfg.backpressure_budget_s - bp_waited)
+                bp_waited += delay
+                time.sleep(delay)
+            except TransportError:
+                retries += 1
+                reg.incr("fleet_retries_total")
+                if retries > cfg.max_retries:
+                    reg.incr("fleet_dropped_steps")
+                    reg.incr(f"fleet_dropped_t{tenant}")
+                    return
+        dt = time.perf_counter() - t0
+        reg.observe(f"fleet_step_latency_t{tenant}", dt)
+        reg.observe(f"fleet_step_latency_{OVERALL}", dt)
+        reg.incr("fleet_steps_total")
+        reg.incr(f"fleet_steps_t{tenant}")
+        if cfg.trace:
+            # server-side queue wait (enqueue -> group pickup), the
+            # number continuous batching exists to shrink
+            qw = transport.stats.counters.get(qw_key, 0.0) - qw0
+            if qw > 0.0:
+                reg.observe(f"fleet_queue_wait_t{tenant}", qw)
+                reg.observe(f"fleet_queue_wait_{OVERALL}", qw)
+        loss_f = float(loss)  # materialize outside the scheduler lock
+        with self._cond:
+            self._losses[(client_id, step)] = loss_f
+
+    def _worker(self) -> None:
+        transports: Dict[int, Any] = {}
+        while True:
+            item = self._pop_due()
+            if item is None:
+                return
+            client_id, step = item
+            tr = transports.get(client_id)
+            if tr is None:
+                # per-worker cache: LocalTransports are cheap, and
+                # chaos wrappers keep their per-(path, step) attempt
+                # counters coherent because a client's steps are
+                # sequential (never two workers in the same step)
+                tr = transports[client_id] = self._make_transport(client_id)
+            try:
+                self._run_step(tr, client_id, step)
+            finally:
+                nxt = step + 1
+                if nxt < self.cfg.steps_per_client:
+                    sched = self._t_start + self._schedules[client_id][nxt]
+                    self._push(max(sched, time.monotonic()), client_id, nxt)
+                self._done_one()
+
+    # -- entry point --------------------------------------------------- #
+    def run(self) -> FleetResult:
+        cfg = self.cfg
+        tracer_was_on = obs_trace.get_tracer() is not None
+        if cfg.trace and not tracer_was_on:
+            obs_trace.enable(
+                max_spans=max(200_000,
+                              cfg.n_clients * cfg.steps_per_client * 12))
+        self._t_start = time.monotonic()
+        for c in self._schedules:
+            self._push(self._t_start + self._schedules[c][0], c, 0)
+        threads = [threading.Thread(target=self._worker,
+                                    name=f"slt-fleet-{i}", daemon=True)
+                   for i in range(cfg.workers)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall = time.monotonic() - self._t_start
+        if cfg.trace and not tracer_was_on:
+            obs_trace.disable()
+        return self._result(wall)
+
+    def _result(self, wall_s: float) -> FleetResult:
+        snap = self.registry.snapshot()
+        counters = dict(snap["counters"])
+        counters.setdefault("fleet_steps_total", 0.0)
+        counters.setdefault("fleet_dropped_steps", 0.0)
+        counters.setdefault("fleet_backpressure_total", 0.0)
+        per_tenant: Dict[int, Dict[str, float]] = {}
+        for t in range(self.cfg.tenants):
+            row: Dict[str, float] = {
+                "steps": counters.get(f"fleet_steps_t{t}", 0.0),
+                "dropped": counters.get(f"fleet_dropped_t{t}", 0.0),
+                "backpressure": counters.get(f"fleet_backpressure_t{t}", 0.0),
+            }
+            for stem, label in (("fleet_step_latency", "step"),
+                                ("fleet_queue_wait", "queue_wait")):
+                hist = snap["histograms"].get(f"{stem}_t{t}")
+                if hist:
+                    row[f"{label}_p50_ms"] = (
+                        histogram_percentile(hist, 50) * 1e3)
+                    row[f"{label}_p99_ms"] = (
+                        histogram_percentile(hist, 99) * 1e3)
+            per_tenant[t] = row
+        overall: Dict[str, float] = {}
+        for stem, label in (("fleet_step_latency", "step"),
+                            ("fleet_queue_wait", "queue_wait")):
+            hist = snap["histograms"].get(f"{stem}_{OVERALL}")
+            if hist:
+                overall[f"{label}_p50_ms"] = (
+                    histogram_percentile(hist, 50) * 1e3)
+                overall[f"{label}_p99_ms"] = (
+                    histogram_percentile(hist, 99) * 1e3)
+        return FleetResult(counters=counters, per_tenant=per_tenant,
+                           overall=overall,
+                           losses=dict(self._losses), wall_s=wall_s)
+
+
+def run_fleet(cfg: FleetConfig,
+              make_transport: Callable[[int], Any]) -> FleetResult:
+    """One-call wrapper: build the harness, run it, return the result."""
+    return FleetHarness(cfg, make_transport).run()
+
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def warm_fleet(server: Any, make_transport: Callable[[int], Any],
+               cfg: FleetConfig, max_rounds: int = 3) -> int:
+    """Warm the server so a measured twin run sees steady-state dispatch
+    latency instead of multi-hundred-ms XLA compiles landing in its
+    queue-wait tail (and the bench gate ``compile_count.steady_state ==
+    0`` becomes meaningful).
+
+    Shape priming is deterministic, not stochastic: the coalesced jit
+    signature depends only on the pow2-padded *total* row count of a
+    group, so one oversized-batch request compiles the identical shape
+    a k-request group would — no need to coax exact group sizes out of
+    arrival timing (a paired burst at the wrong rate can miss the
+    two-request bucket for a whole warmup and leak the compile into the
+    measured tail). Every bucket a group of 1..max_group batch-
+    ``cfg.batch`` requests can pad to gets one priming step; short
+    burst fleets afterwards warm threads, transports, and the replay
+    path until the compile count is stable.
+
+    Warmup clients use id ranges disjoint from (and above) the measured
+    fleet's, offset by multiples of ``cfg.tenants`` to preserve the
+    tenant mapping, so the strict step handshake never collides across
+    phases. Returns the number of warmup rounds run (shape priming
+    counts as one round)."""
+    tenants = max(cfg.tenants, 1)
+    # first id safely above the measured range, tenant-aligned
+    base = cfg.client_id_offset + cfg.n_clients
+    base += (-base) % tenants
+    rounds = 0
+    coalescer = getattr(server, "_coalescer", None)
+    if coalescer is not None:
+        rounds += 1
+        buckets = sorted({_pow2(k * cfg.batch)
+                          for k in range(1, coalescer.max_group + 1)})
+        rs = np.random.RandomState(cfg.seed + 1)
+        for i, rows in enumerate(buckets):
+            acts = rs.randn(rows, *CUT_SHAPE).astype(np.float32)
+            labels = rs.randint(0, 10, (rows,)).astype(np.int64)
+            make_transport(base + i).split_step(acts, labels, 0, base + i)
+        base += len(buckets) + (-(base + len(buckets))) % tenants
+    warm_n = max(tenants * 4, 8)
+    prev = None
+    for round_i in range(max_rounds):
+        warm_cfg = dataclasses.replace(
+            cfg, n_clients=warm_n, steps_per_client=2, trace=False,
+            arrival="burst", rate_hz=max(cfg.rate_hz * 8, 20.0),
+            burst_size=max(cfg.burst_size, 8),
+            client_id_offset=base + round_i * warm_n,
+            seed=cfg.seed + 7919 * (round_i + 1))
+        run_fleet(warm_cfg, make_transport)
+        rounds += 1
+        compiles = server.health().get("coalescing", {}).get(
+            "compile_count", 0)
+        if compiles == prev:
+            break
+        prev = compiles
+    return rounds

@@ -35,6 +35,7 @@ from split_learning_tpu.obs import locks as obs_locks
 from split_learning_tpu.obs import spans
 from split_learning_tpu.obs import trace as obs_trace
 from split_learning_tpu.obs.metrics import Registry
+from split_learning_tpu.runtime.admission import AdmissionController
 from split_learning_tpu.runtime.coalesce import (
     CoalesceRequest, RequestCoalescer, pow2_bucket)
 from split_learning_tpu.runtime.replay import ReplayCache
@@ -66,12 +67,32 @@ class ServerRuntime:
                  coalesce_window_ms: float = 2.0,
                  replay_window: int = 8,
                  overlap: bool = True,
-                 d2h_delay_s: float = 0.0) -> None:
+                 d2h_delay_s: float = 0.0,
+                 batching: str = "window",
+                 tenants: int = 1,
+                 quota: Optional[Any] = None,
+                 slo_ms: Optional[Any] = None) -> None:
         """coalesce_max > 1 turns on request coalescing (classic split
         mode only): concurrent split_step calls that arrive within
         ``coalesce_window_ms`` of each other batch into one dispatch, up
         to ``coalesce_max`` per group (runtime/coalesce.py). 1 = the
         serialized path, bit-for-bit — the coalescer is never built.
+        ``batching`` picks the flush policy for that coalescer:
+        ``"window"`` (the original fixed window/size flusher) or
+        ``"continuous"`` (runtime/coalesce.py ContinuousBatcher — the
+        next group is whatever is admitted the moment the previous
+        group's jitted call is dispatched, picked EDF on admission
+        deadlines; requires coalesce_max >= 2).
+
+        ``tenants`` / ``quota`` / ``slo_ms`` switch on multi-tenant
+        admission control (runtime/admission.py): clients map to
+        tenants by ``client_id %% tenants``; ``quota`` (steps/sec per
+        tenant, scalar or per-tenant sequence) bounds each tenant with
+        a token bucket — an over-quota split_step raises
+        ``Backpressure`` (HTTP 429 + Retry-After on the wire) instead
+        of queueing silently; ``slo_ms`` stamps each admitted request
+        with an earliest-deadline-first priority the continuous batcher
+        honors. Defaults leave the admission layer off entirely.
 
         ``replay_window`` bounds the per-(client, op) reply cache that
         makes step delivery exactly-once within the window: a duplicate
@@ -130,6 +151,21 @@ class ServerRuntime:
                 f"coalesce_max={coalesce_max} is split-mode only (the "
                 "batched group step computes the loss server-side); mode "
                 f"is {cfg.mode!r}")
+        if batching not in ("window", "continuous"):
+            raise ValueError(
+                f"batching must be 'window' or 'continuous' "
+                f"(got {batching!r})")
+        if batching == "continuous" and coalesce_max < 2:
+            raise ValueError(
+                "continuous batching runs inside the coalescer — raise "
+                f"coalesce_max to >= 2 (got {coalesce_max})")
+        self.batching = batching
+        # admission layer: built only when any knob is non-default, so
+        # existing servers pay nothing (admit() is never called)
+        self._admission: Optional[AdmissionController] = None
+        if tenants > 1 or quota is not None or slo_ms is not None:
+            self._admission = AdmissionController(
+                tenants=tenants, quota=quota, slo_ms=slo_ms)
 
         if cfg.mode == "federated":
             # federated server keeps the full model (ref src/model_def.py:56-57)
@@ -150,7 +186,7 @@ class ServerRuntime:
                 self._coalesce_shapes: set = set()
                 self._coalescer = RequestCoalescer(
                     self._dispatch_group, coalesce_max,
-                    coalesce_window_ms / 1e3)
+                    coalesce_window_ms / 1e3, mode=batching)
         # exactly-once within a window: applied replies are cached and
         # replayed verbatim to duplicate deliveries; below the window the
         # strict-step 409 still holds (a replay that stale is a protocol
@@ -268,21 +304,34 @@ class ServerRuntime:
         # gated on it — the untraced serialized path takes no extra
         # locks and allocates nothing (the zero-overhead-off contract)
         tr = obs_trace.get_tracer()
+        admitted = False
+        deadline = None
         try:
+            if self._admission is not None:
+                # quota gate: Backpressure raised here rides the
+                # except-path below, so replay.fail releases the claim
+                # and the advised retry re-owns the step cleanly
+                deadline = self._admission.admit(client_id)
+                admitted = True
             if self._coalescer is not None:
                 # block on the group's future; the handshake runs at
                 # dispatch-admission time so a replayed step 409s its own
                 # client without poisoning the group
                 if tr is None:
                     res = self._coalescer.submit(activations, labels,
-                                                 step, client_id)
+                                                 step, client_id,
+                                                 deadline=deadline)
                 else:
                     res = self._coalescer.submit(
                         activations, labels, step, client_id,
                         trace_id=obs_trace.CTX.trace_id,
-                        t_enqueue=time.perf_counter())
+                        t_enqueue=time.perf_counter(),
+                        deadline=deadline)
                 if entry is not None:
                     self.replay.resolve(entry, res)
+                if admitted:
+                    admitted = False
+                    self._admission.complete(client_id)
                 return res
             t_q0 = time.perf_counter() if tr is not None else 0.0
             with self._lock:
@@ -322,6 +371,9 @@ class ServerRuntime:
             res = (g_host, loss_f)
             if entry is not None:
                 self.replay.resolve(entry, res)
+            if admitted:
+                admitted = False
+                self._admission.complete(client_id)
             if tr is not None:
                 self._record_server_spans(
                     tr, t_q0, t_d0 - t_q0, t_d0, t_d1 - t_d0, t_d1,
@@ -329,9 +381,17 @@ class ServerRuntime:
                     obs_trace.CTX.trace_id, step, client_id)
             return res
         except BaseException as exc:
-            # the apply never produced a reply (admission 409, dispatch
-            # error): release the claim so a retry can re-own the step,
-            # and hand the error to anyone already blocked on it
+            # the apply never produced a reply (admission 409, quota
+            # 429, dispatch error): release the claim so a retry can
+            # re-own the step, and hand the error to anyone already
+            # blocked on it
+            # pair the admit before releasing the claim: the in-flight
+            # depth gauge must drain on failure too, and doing it here
+            # (not in a finally) keeps the claim's fail() the last
+            # replay-visible act on the path — a finally would give the
+            # handler an exit that skips fail() (slt-lint SLT002)
+            if admitted:
+                self._admission.complete(client_id)
             if entry is not None:
                 self.replay.fail(entry, exc)
             raise
@@ -694,7 +754,13 @@ class ServerRuntime:
             info["coalescing"] = {
                 "coalesce_max": self._coalescer.max_group,
                 "coalesce_window_ms": self._coalescer.window_s * 1e3,
+                "batching": self._coalescer.mode,
                 **self._coalescer.counters()}
+        if self._admission is not None:
+            info["admission"] = {
+                **self._admission.config(),
+                **self._admission.counters(),
+                **self._admission.gauges()}
         return info
 
     def metrics(self) -> Dict[str, Any]:
@@ -707,7 +773,14 @@ class ServerRuntime:
         h = self.health()
         snap["gauges"]["acked_step"] = float(h["step"])
         for k, v in h.get("coalescing", {}).items():
-            snap["counters"][f"coalesce_{k}"] = float(v)
+            if isinstance(v, (int, float)):
+                snap["counters"][f"coalesce_{k}"] = float(v)
+        if self._admission is not None:
+            # counters already carry the admission_ prefix (obs/spans.py
+            # names); render_prometheus turns them into slt_admission_*
+            for k, v in self._admission.counters().items():
+                snap["counters"][k] = float(v)
+            snap["gauges"].update(self._admission.gauges())
         if self.replay is not None:
             rc = self.replay.counters()
             snap["gauges"]["replay_cache_size"] = float(

@@ -38,6 +38,20 @@ class TransportError(RuntimeError):
     """A transport round-trip failed (network error, bad status, codec)."""
 
 
+class Backpressure(TransportError):
+    """The peer explicitly refused admission (tenant quota exhausted,
+    queue full) and said when to come back — HTTP 429 + ``Retry-After``
+    on the wire, this exception in-process. Subclasses TransportError so
+    generic transient handling still applies, but callers that care
+    (runtime/client.py, runtime/breaker.py) catch it first: an explicit
+    429 is flow control, not a sick wire, so it must neither trip the
+    circuit breaker nor be retried before ``retry_after_s`` elapses."""
+
+    def __init__(self, message: str, retry_after_s: float = 0.0) -> None:
+        super().__init__(message)
+        self.retry_after_s = max(float(retry_after_s), 0.0)
+
+
 @dataclasses.dataclass
 class TransportStats:
     """Per-op latency accounting — the reference has no timing at all

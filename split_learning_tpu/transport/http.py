@@ -33,7 +33,7 @@ from split_learning_tpu.obs import spans
 from split_learning_tpu.obs import trace as obs_trace
 from split_learning_tpu.transport import codec
 from split_learning_tpu.transport.base import (
-    Transport, TransportError, backoff_delays, timed)
+    Backpressure, Transport, TransportError, backoff_delays, timed)
 from split_learning_tpu.transport.chaos import _AttemptCounter, CHAOS_OPS
 
 CRC_HEADER = "X-SLT-CRC32"
@@ -82,10 +82,16 @@ class SplitHTTPServer:
 
             def _reply(self, status: int, body: bytes,
                        ctype: str = "application/octet-stream",
-                       crc: Optional[int] = None) -> None:
+                       crc: Optional[int] = None,
+                       headers: Optional[Dict[str, str]] = None) -> None:
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                # extra response headers (the 429 path's Retry-After —
+                # a header, not a body field, so the payload-key contract
+                # between client and server codecs stays unchanged)
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 # frame integrity the reference's raw pickle bodies lack
                 # (crc override: the chaos 'corrupt' fault ships a frame
                 # the client's checksum gate must refuse)
@@ -293,6 +299,13 @@ class SplitHTTPServer:
                         outer.runtime.attach_reply_body(
                             cid, op, int(req["step"]), body)
                     self._send_200(body, fault)
+                except Backpressure as exc:
+                    # admission refused the step: the canonical wire form
+                    # of the typed in-process signal — 429 plus the
+                    # advised delay in the standard Retry-After header
+                    self._reply(
+                        429, codec.encode({"error": str(exc)}),
+                        headers={"Retry-After": f"{exc.retry_after_s:.3f}"})
                 except ProtocolError as exc:
                     self._reply(exc.status, codec.encode({"error": str(exc)}))
                 except Exception as exc:  # noqa: BLE001 — server must not die
@@ -436,6 +449,15 @@ class HttpTransport(Transport):
             if not crc_ok:
                 raise TransportError(
                     f"POST {path}: response checksum mismatch")
+        if resp.status_code == 429:
+            try:
+                ra = float(resp.headers.get("Retry-After", "0") or 0)
+            except ValueError:
+                ra = 0.0
+            raise Backpressure(
+                f"POST {path} -> 429: "
+                f"{codec.decode(resp.content).get('error', '')}",
+                retry_after_s=ra)
         if resp.status_code in (400, 409):
             raise ProtocolError(codec.decode(resp.content).get("error", ""))
         if resp.status_code != 200:

@@ -16,7 +16,8 @@ import numpy as np
 from split_learning_tpu.obs import spans
 from split_learning_tpu.obs import trace as obs_trace
 from split_learning_tpu.transport import codec
-from split_learning_tpu.transport.base import Transport, TransportError, timed
+from split_learning_tpu.transport.base import (
+    Backpressure, Transport, TransportError, timed)
 
 
 class LocalTransport(Transport):
@@ -86,6 +87,11 @@ class LocalTransport(Transport):
         try:
             return fn(*args)
         except ProtocolError:
+            raise
+        except Backpressure:
+            # in-process equivalent of the HTTP 429 + Retry-After path:
+            # the typed signal (with its advised delay) reaches the
+            # caller intact instead of flattening into TransportError
             raise
         except Exception as exc:
             raise TransportError(str(exc)) from exc
