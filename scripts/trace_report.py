@@ -18,6 +18,11 @@ and a per-step accounting check (client phases summed vs the measured
 tracing PR).
 
 Run: python scripts/trace_report.py artifacts/trace.json [--json]
+Also: python scripts/trace_report.py --schedules slt-check-report.json
+summarizes an slt-check explorer report (``--check --report PATH``):
+per scenario, schedules explored vs pruned (sleep-set pruning ratio),
+the max preemption depth reached, and any invariant violations with
+their replayable schedule ids.
 
 Stdlib-only (no jax, no numpy): usable on any box the trace file lands
 on.
@@ -242,16 +247,95 @@ def render(rep: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def summarize_schedules(path: str) -> Dict[str, Any]:
+    """Digest an slt-check explorer report (the ``--check --report``
+    JSON) into the per-scenario exploration table. Tolerant of skipped
+    scenarios (``{"skipped": ...}`` entries) and absent keys, so a
+    report from an older/newer checker still renders."""
+    with open(path) as f:
+        rep = json.load(f)
+    scenarios = rep.get("scenarios", {})
+    table: Dict[str, Any] = {}
+    totals = {"schedules": 0, "pruned": 0, "violations": 0, "skipped": 0}
+    for name, e in sorted(scenarios.items()):
+        if "skipped" in e:
+            table[name] = {"skipped": e["skipped"]}
+            totals["skipped"] += 1
+            continue
+        row = {
+            "schedules": int(e.get("schedules", 0)),
+            "pruned": int(e.get("pruned", 0)),
+            "pruning_ratio": float(e.get("pruning_ratio", 0.0)),
+            "max_preemptions": int(e.get("max_preemptions", 0)),
+            "exhausted": bool(e.get("exhausted", False)),
+            "violations": list(e.get("violations", ())),
+        }
+        table[name] = row
+        totals["schedules"] += row["schedules"]
+        totals["pruned"] += row["pruned"]
+        totals["violations"] += len(row["violations"])
+    return {"scenarios": table, "totals": totals}
+
+
+def render_schedules(rep: Dict[str, Any]) -> str:
+    lines = []
+    lines.append(f"{'scenario':<26} {'scheds':>7} {'pruned':>7} "
+                 f"{'prune%':>7} {'maxPre':>7}  note")
+    for name, row in rep["scenarios"].items():
+        if "skipped" in row:
+            lines.append(f"{name:<26} {'-':>7} {'-':>7} {'-':>7} {'-':>7}"
+                         f"  skipped (requires {row['skipped']})")
+            continue
+        note = "exhausted" if row["exhausted"] else "budget-capped"
+        if row["violations"]:
+            note += f", {len(row['violations'])} VIOLATION(S)"
+        lines.append(
+            f"{name:<26} {row['schedules']:>7d} {row['pruned']:>7d} "
+            f"{row['pruning_ratio']:>7.1%} {row['max_preemptions']:>7d}"
+            f"  {note}")
+    t = rep["totals"]
+    lines.append("")
+    lines.append(
+        f"total: {t['schedules']} schedules explored, {t['pruned']} "
+        f"pruned (sleep sets / preemption bound), "
+        f"{t['violations']} violation(s), {t['skipped']} skipped")
+    for name, row in rep["scenarios"].items():
+        for v in row.get("violations", ()):
+            lines.append(
+                f"  VIOLATION [{v.get('invariant', '?')}] {name}: "
+                f"{v.get('message', '')}  "
+                f"(replay: python -m split_learning_tpu.analysis "
+                f"--schedule {v.get('schedule_id', '?')})")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("trace", help="Chrome-trace file (obs export)")
+    ap.add_argument("trace", nargs="?", default=None,
+                    help="Chrome-trace file (obs export)")
     ap.add_argument("--json", action="store_true",
                     help="emit the report as JSON instead of the table")
     ap.add_argument("--tenants", type=int, default=0,
                     help="split server queue_wait spans into N tenants "
                          "(client_id %% N) and add a per-tenant tail "
                          "table")
+    ap.add_argument("--schedules", default=None, metavar="PATH",
+                    help="summarize an slt-check explorer report "
+                         "(--check --report PATH) instead of / in "
+                         "addition to a trace")
     args = ap.parse_args(argv)
+    if args.trace is None and args.schedules is None:
+        ap.error("give a trace file and/or --schedules PATH")
+    if args.schedules:
+        srep = summarize_schedules(args.schedules)
+        try:
+            print(json.dumps(srep, indent=2) if args.json
+                  else render_schedules(srep))
+        except BrokenPipeError:
+            return 0
+        if args.trace is None:
+            return 0
+        print()
     events = load_events(args.trace)
     if not events:
         print(f"[trace_report] no events parsed from {args.trace}",

@@ -24,11 +24,22 @@ import ast
 import os
 import re
 import sys
-from typing import Dict, Iterable, List, Optional, Set, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
 
+from split_learning_tpu.analysis.invariants import (INVARIANTS,
+                                                    RULE_OF_INVARIANT)
 from split_learning_tpu.analysis.rules import (Finding, PROJECT_RULES,
                                                RULES, Src, run_rules,
                                                run_project_rules)
+
+# slt-check pseudo-rules (SLT1xx): one per dynamic invariant, so
+# model-checking findings ride the same waiver/exit-code contract as
+# the static rules. Docs come from the invariant functions themselves.
+CHECK_RULES: Dict[str, Tuple[None, str]] = {
+    rule_id: (None, (INVARIANTS[name].__doc__ or name).strip()
+              .splitlines()[0].rstrip("."))
+    for name, rule_id in sorted(RULE_OF_INVARIANT.items())
+}
 
 _WAIVER_RE = re.compile(
     r"#\s*slt-lint:\s*disable=([A-Z0-9,\s]+?)\s*\(([^)]*)\)")
@@ -83,7 +94,8 @@ def _load_waiver_file(path: str) -> Tuple[List[Tuple[str, str, str]],
             continue
         parts = stripped.split(None, 2)
         if len(parts) < 3 or (parts[0] not in RULES
-                              and parts[0] not in PROJECT_RULES):
+                              and parts[0] not in PROJECT_RULES
+                              and parts[0] not in CHECK_RULES):
             problems.append(Finding(
                 "SLT000", path, lineno,
                 "malformed waiver-file entry — expected "
@@ -179,6 +191,141 @@ def lint_paths(paths: Iterable[str],
     return findings
 
 
+# ---------------------------------------------------------------------- #
+# slt-check: interleaving exploration (analysis/sched.py) as a lint pass
+# ---------------------------------------------------------------------- #
+
+def _check_scenarios(only: Optional[str]):
+    """Resolve the scenario registry lazily — scenarios import numpy and
+    the runtime, which the pure-lint path must never pay for."""
+    from split_learning_tpu.analysis.scenarios import SCENARIOS
+    if only is not None:
+        if only not in SCENARIOS:
+            raise SystemExit(
+                f"slt-check: unknown scenario {only!r} "
+                f"(have: {', '.join(sorted(SCENARIOS))})")
+        return {only: SCENARIOS[only]}
+    return dict(sorted(SCENARIOS.items()))
+
+
+def run_check(args: "argparse.Namespace") -> int:
+    """Explore every registered scenario's schedules, assert the
+    invariants on each run, and report violations as SLT1xx findings
+    through the standard waiver/exit-code machinery."""
+    import json
+
+    from split_learning_tpu.analysis.invariants import check_run
+    from split_learning_tpu.analysis.sched import explore
+
+    scenarios = _check_scenarios(args.scenario)
+    file_waivers, problems = ([], [])
+    waiver_file = args.waiver_file
+    if waiver_file is None and os.path.exists(_DEFAULT_WAIVER_FILE):
+        waiver_file = _DEFAULT_WAIVER_FILE
+    if waiver_file:
+        file_waivers, problems = _load_waiver_file(waiver_file)
+
+    findings: List[Finding] = list(problems)
+    report: Dict[str, Any] = {"scenarios": {}, "total_schedules": 0,
+                              "budget_override": args.budget,
+                              "mode_override": args.mode}
+    for name, sc in scenarios.items():
+        if not sc.available():
+            print(f"slt-check: {name}: SKIPPED (requires {sc.requires})")
+            report["scenarios"][name] = {"skipped": sc.requires}
+            continue
+        budget = args.budget if args.budget is not None else sc.budget
+        bound = (args.max_preemptions if args.max_preemptions is not None
+                 else sc.bound)
+        mode = args.mode if args.mode is not None else sc.mode
+        seed = args.seed if args.seed is not None else sc.seed
+        violations: List[Any] = []
+        res = explore(
+            name, sc.fn, budget=budget, bound=bound, mode=mode, seed=seed,
+            on_run=lambda run, _inv=sc.invariants:
+                violations.extend(check_run(run, _inv)))
+        entry = res.summary()
+        entry["invariants"] = sorted(
+            {"deadlock_free", "no_lost_wakeup", "no_errors"}
+            | set(sc.invariants))
+        entry["violations"] = [
+            {"invariant": v.invariant, "schedule_id": v.schedule_id,
+             "message": v.message} for v in violations]
+        entry["sample_fingerprints"] = dict(res.sample)
+        report["scenarios"][name] = entry
+        report["total_schedules"] += res.schedules
+        status = (f"{res.schedules} schedules, {res.pruned} pruned, "
+                  f"max {res.max_preemptions} preemptions"
+                  + (", exhausted" if res.exhausted else ""))
+        if violations:
+            status += f", {len(violations)} VIOLATION(S)"
+        print(f"slt-check: {name}: {status}")
+        # one finding per (scenario, invariant): the FIRST violating
+        # schedule DFS reached — shortest decision prefix, i.e. the
+        # minimal counterexample — plus how many more schedules hit it
+        first: Dict[str, Any] = {}
+        extra: Dict[str, int] = {}
+        for v in violations:
+            if v.invariant in first:
+                extra[v.invariant] = extra.get(v.invariant, 0) + 1
+            else:
+                first[v.invariant] = v
+        for inv_name, v in first.items():
+            more = extra.get(inv_name, 0)
+            msg = (f"[{name}] {v.message} — replay: "
+                   f"--schedule {v.schedule_id}"
+                   + (f" (+{more} more schedule(s))" if more else ""))
+            f = Finding(RULE_OF_INVARIANT[inv_name],
+                        f"scenario://{name}", 1, msg)
+            findings.append(_waive(f, {}, file_waivers, f.path))
+
+    if args.report:
+        with open(args.report, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        print(f"slt-check: report written to {args.report}")
+
+    unwaived = [f for f in findings if not f.waived]
+    for f in findings:
+        print(f.format())
+    print(f"slt-check: {report['total_schedules']} schedules across "
+          f"{sum(1 for e in report['scenarios'].values() if 'skipped' not in e)} "
+          f"scenario(s); {len(unwaived)} unwaived finding(s), "
+          f"{sum(1 for f in findings if f.waived)} waived")
+    return 1 if unwaived else 0
+
+
+def replay_schedule(schedule_id: str) -> int:
+    """Re-execute one schedule bit-for-bit and re-assert its scenario's
+    invariants — how a counterexample becomes a regression check."""
+    from split_learning_tpu.analysis.invariants import check_run
+    from split_learning_tpu.analysis.sched import decode_choices, run_schedule
+
+    if ":" not in schedule_id:
+        raise SystemExit(
+            f"slt-check: bad schedule id {schedule_id!r} "
+            f"(want '<scenario>:<choices>')")
+    name, choices_text = schedule_id.split(":", 1)
+    scenarios = _check_scenarios(name)
+    sc = scenarios[name]
+    if not sc.available():
+        raise SystemExit(f"slt-check: scenario {name} requires "
+                         f"{sc.requires}, which is unavailable")
+    run = run_schedule(name, sc.fn, forced=decode_choices(choices_text))
+    print(f"slt-check: replayed {run.schedule_id} "
+          f"({run.transitions} transitions, {run.preemptions} "
+          f"preemptions, fingerprint {run.trace_fingerprint()})")
+    for tid, kind, obj in run.trace:
+        print(f"  t{tid} {kind:<12} {obj}")
+    violations = check_run(run, sc.invariants)
+    for v in violations:
+        print(f"VIOLATION {RULE_OF_INVARIANT[v.invariant]} "
+              f"[{v.invariant}] {v.message}")
+    if not violations:
+        print("slt-check: no invariant violated on this schedule")
+    return 1 if violations else 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m split_learning_tpu.analysis",
@@ -190,13 +337,40 @@ def main(argv: Optional[List[str]] = None) -> int:
                              f"{_DEFAULT_WAIVER_FILE} if present)")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule catalog and exit")
+    check = parser.add_argument_group(
+        "slt-check", "systematic interleaving exploration (model "
+        "checking) of the runtime's concurrency invariants")
+    check.add_argument("--check", action="store_true",
+                       help="explore scenario schedules and assert the "
+                            "SLT1xx invariants instead of linting")
+    check.add_argument("--budget", type=int, default=None,
+                       help="per-scenario schedule budget override "
+                            "(default: each scenario's own)")
+    check.add_argument("--max-preemptions", type=int, default=None,
+                       help="preemption bound override for DFS mode")
+    check.add_argument("--mode", choices=("dfs", "random"), default=None,
+                       help="exploration mode override")
+    check.add_argument("--seed", type=int, default=None,
+                       help="random-mode seed override")
+    check.add_argument("--scenario", default=None,
+                       help="restrict --check to one scenario")
+    check.add_argument("--schedule", default=None, metavar="ID",
+                       help="replay one schedule id bit-for-bit and "
+                            "re-assert its invariants")
+    check.add_argument("--report", default=None, metavar="PATH",
+                       help="write the explorer JSON report here "
+                            "(scripts/trace_report.py --schedules reads it)")
     args = parser.parse_args(argv)
 
     if args.list_rules:
-        catalog = {**RULES, **PROJECT_RULES}
+        catalog = {**RULES, **PROJECT_RULES, **CHECK_RULES}
         for rule_id, (_fn, doc) in sorted(catalog.items()):
             print(f"{rule_id}: {doc}")
         return 0
+    if args.schedule:
+        return replay_schedule(args.schedule)
+    if args.check:
+        return run_check(args)
 
     findings = lint_paths(args.paths or ["split_learning_tpu"],
                           args.waiver_file)

@@ -1,0 +1,271 @@
+"""Concurrency invariants slt-check asserts over every explored schedule.
+
+Each invariant is a function ``fn(run) -> None`` that raises
+:class:`Violation` when the :class:`~split_learning_tpu.analysis.sched.Run`
+breaks it. They read two surfaces:
+
+- the run's built-in diagnoses (``run.deadlock``, ``run.stalled``,
+  ``run.error``, ``run.thread_errors``), and
+- semantic **notes** the scenario emitted via ``ctx.note(kind, ...)``
+  while driving the real runtime objects — e.g. ``("begin", {"key":
+  ..., "owner": True})`` when a thread wins a ReplayCache claim.
+
+The generic invariants (:data:`GENERIC`) apply to every scenario; the
+named ones are opted into per scenario via the registry in
+scenarios.py. tests/test_sched.py reuses both against deliberately
+broken toy objects to prove each invariant actually fires.
+
+Stdlib-only (tests/test_analysis.py pins it): invariants see note
+tuples and plain dicts, never arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Tuple
+
+__all__ = ["Violation", "INVARIANTS", "GENERIC", "check_run",
+           "RULE_OF_INVARIANT"]
+
+
+class Violation(AssertionError):
+    """One invariant broken on one schedule — carries the replayable id."""
+
+    def __init__(self, invariant: str, schedule_id: str,
+                 message: str) -> None:
+        self.invariant = invariant
+        self.schedule_id = schedule_id
+        self.message = message
+        super().__init__(f"[{invariant}] {message} "
+                         f"(replay: --schedule {schedule_id})")
+
+
+def _notes(run: Any, kind: str) -> List[Dict[str, Any]]:
+    return [fields for k, fields in run.notes if k == kind]
+
+
+# --------------------------------------------------------------------- #
+# generic invariants — every scenario, every schedule
+# --------------------------------------------------------------------- #
+
+def deadlock_free(run: Any) -> None:
+    """No schedule may end with a lock wait-for cycle."""
+    if run.deadlock:
+        cycle = " -> ".join(t["name"] for t in run.deadlock["cycle"])
+        raise Violation("deadlock_free", run.schedule_id,
+                        f"lock cycle {cycle}")
+
+
+def no_lost_wakeup(run: Any) -> None:
+    """No schedule may end with threads blocked forever on a condition
+    or event that nothing will ever signal (and no lock cycle to blame
+    — that is :func:`deadlock_free`'s finding)."""
+    if run.stalled and not run.deadlock:
+        who = ", ".join(f"{t['name']}@{t['op']}({t['obj']})"
+                        for t in run.stalled)
+        raise Violation("no_lost_wakeup", run.schedule_id,
+                        f"threads stuck with nothing runnable: {who}")
+
+
+def no_errors(run: Any) -> None:
+    """Scenario code and its spawned threads completed without raising
+    (scenarios that *expect* an exception catch it and note it)."""
+    if run.error is not None:
+        raise Violation("no_errors", run.schedule_id,
+                        f"scenario raised {run.error!r}")
+    if run.thread_errors:
+        who = "; ".join(f"{e['name']}: {e['error']}"
+                        for e in run.thread_errors)
+        raise Violation("no_errors", run.schedule_id,
+                        f"thread raised: {who}")
+
+
+GENERIC: Tuple[Callable[[Any], None], ...] = (
+    deadlock_free, no_lost_wakeup, no_errors)
+
+
+# --------------------------------------------------------------------- #
+# named invariants — opted into by scenario
+# --------------------------------------------------------------------- #
+
+def exactly_once_claims(run: Any) -> None:
+    """ReplayCache claim lifecycle under a duplicate storm: per key,
+    exactly one ``begin`` wins ownership per claim generation, the apply
+    runs exactly once per resolved claim, and every duplicate's ``wait``
+    returns the owner's single materialized value.
+
+    Notes read: ``begin(key, owner)``, ``apply(key)``, ``resolve(key,
+    value)``, ``wait_return(key, value)``."""
+    owners: Dict[Any, int] = {}
+    applies: Dict[Any, int] = {}
+    resolved: Dict[Any, List[Any]] = {}
+    for f in _notes(run, "begin"):
+        if f.get("owner"):
+            owners[f["key"]] = owners.get(f["key"], 0) + 1
+    for f in _notes(run, "apply"):
+        applies[f["key"]] = applies.get(f["key"], 0) + 1
+    for f in _notes(run, "resolve"):
+        resolved.setdefault(f["key"], []).append(f.get("value"))
+    # a fail()ed or 429'd claim is released, so a retry legitimately
+    # re-owns the key; both note kinds mark that release
+    fails = ({f["key"] for f in _notes(run, "fail")}
+             | {f["key"] for f in _notes(run, "backpressure")})
+    for key, n in applies.items():
+        if n > 1:
+            raise Violation(
+                "exactly_once_claims", run.schedule_id,
+                f"step {key} applied {n} times — the update ran twice")
+    for key, n in owners.items():
+        if n > 1 and key not in fails:
+            raise Violation(
+                "exactly_once_claims", run.schedule_id,
+                f"step {key} claimed by {n} owners with no fail between")
+    for f in _notes(run, "wait_return"):
+        vals = resolved.get(f["key"], [])
+        if f.get("value") not in vals:
+            raise Violation(
+                "exactly_once_claims", run.schedule_id,
+                f"duplicate of {f['key']} returned {f.get('value')!r}, "
+                f"not the owner's resolved value {vals!r}")
+
+
+def edf_pickup_order(run: Any) -> None:
+    """Continuous-mode group pickup is earliest-deadline-first with
+    arrival order breaking ties: within each dispatched group, requests
+    are nondecreasing in ``(deadline ?? inf, seq)``, and no queued
+    request with an earlier deadline than the group head was left
+    behind at pickup time.
+
+    Notes read: ``pickup(group=[(deadline_or_None, seq), ...],
+    left=[(deadline_or_None, seq), ...])``."""
+    def sortkey(pair: Any) -> Tuple[float, int]:
+        deadline, seq = pair
+        return (float("inf") if deadline is None else deadline, seq)
+
+    for f in _notes(run, "pickup"):
+        group = [tuple(p) for p in f["group"]]
+        if group != sorted(group, key=sortkey):
+            raise Violation(
+                "edf_pickup_order", run.schedule_id,
+                f"group picked up out of EDF order: {group}")
+        left = [tuple(p) for p in f.get("left", ())]
+        if group and left:
+            head = min(sortkey(p) for p in group)
+            overtaken = [p for p in left if sortkey(p) < head]
+            if overtaken:
+                raise Violation(
+                    "edf_pickup_order", run.schedule_id,
+                    f"queued request(s) {overtaken} had earlier deadlines "
+                    f"than the picked head {group[0]}")
+
+
+def reclaimable_429(run: Any) -> None:
+    """A step refused by admission (429/Backpressure) must release its
+    replay claim so the advised retry can re-own it: every noted
+    ``backpressure(key)`` is followed by the key being re-owned and
+    finally applied exactly once.
+
+    Notes read: ``backpressure(key)``, ``begin(key, owner)``,
+    ``apply(key)``."""
+    bp_keys = [f["key"] for f in _notes(run, "backpressure")]
+    applies: Dict[Any, int] = {}
+    for f in _notes(run, "apply"):
+        applies[f["key"]] = applies.get(f["key"], 0) + 1
+    for key in bp_keys:
+        if applies.get(key, 0) != 1:
+            raise Violation(
+                "reclaimable_429", run.schedule_id,
+                f"step {key} hit backpressure and was applied "
+                f"{applies.get(key, 0)} times (want exactly 1: the "
+                f"refused claim must be released for the retry)")
+
+
+def admission_conservation(run: Any) -> None:
+    """Token/depth accounting closes: every admit is paired with a
+    complete (the in-flight depth gauge drains to zero), and admits
+    never exceed what the bucket could have issued.
+
+    Notes read: ``admitted(tenant)``, ``completed(tenant)``,
+    ``final_depth(tenant, depth)``, optional ``max_admits(tenant, n)``."""
+    admits: Dict[Any, int] = {}
+    completes: Dict[Any, int] = {}
+    for f in _notes(run, "admitted"):
+        admits[f["tenant"]] = admits.get(f["tenant"], 0) + 1
+    for f in _notes(run, "completed"):
+        completes[f["tenant"]] = completes.get(f["tenant"], 0) + 1
+    for t, n in admits.items():
+        if completes.get(t, 0) != n:
+            raise Violation(
+                "admission_conservation", run.schedule_id,
+                f"tenant {t}: {n} admits vs {completes.get(t, 0)} "
+                f"completes — in-flight slots leaked")
+    for f in _notes(run, "final_depth"):
+        if f["depth"] != 0:
+            raise Violation(
+                "admission_conservation", run.schedule_id,
+                f"tenant {f['tenant']} ended with in-flight depth "
+                f"{f['depth']} (want 0)")
+    for f in _notes(run, "max_admits"):
+        if admits.get(f["tenant"], 0) > f["n"]:
+            raise Violation(
+                "admission_conservation", run.schedule_id,
+                f"tenant {f['tenant']} admitted "
+                f"{admits.get(f['tenant'], 0)} steps, bucket only held "
+                f"{f['n']}")
+
+
+def all_resolved(run: Any) -> None:
+    """Every request handed to the coalescer/fleet came back resolved
+    exactly once — no waiter was dropped and none was double-resolved.
+
+    Notes read: ``enqueue(key)``, ``resolved(key)``."""
+    submitted = [f["key"] for f in _notes(run, "enqueue")]
+    resolved: Dict[Any, int] = {}
+    for f in _notes(run, "resolved"):
+        resolved[f["key"]] = resolved.get(f["key"], 0) + 1
+    for key in submitted:
+        n = resolved.get(key, 0)
+        if n != 1:
+            raise Violation(
+                "all_resolved", run.schedule_id,
+                f"request {key} resolved {n} times (want exactly 1)")
+
+
+INVARIANTS: Dict[str, Callable[[Any], None]] = {
+    "deadlock_free": deadlock_free,
+    "no_lost_wakeup": no_lost_wakeup,
+    "no_errors": no_errors,
+    "exactly_once_claims": exactly_once_claims,
+    "edf_pickup_order": edf_pickup_order,
+    "reclaimable_429": reclaimable_429,
+    "admission_conservation": admission_conservation,
+    "all_resolved": all_resolved,
+}
+
+# --check findings flow through slt-lint's waiver/exit-code machinery;
+# each invariant maps onto a pseudo-rule id in the SLT1xx block (the
+# static rules own SLT0xx)
+RULE_OF_INVARIANT: Dict[str, str] = {
+    "deadlock_free": "SLT104",
+    "no_lost_wakeup": "SLT102",
+    "no_errors": "SLT100",
+    "exactly_once_claims": "SLT101",
+    "edf_pickup_order": "SLT103",
+    "reclaimable_429": "SLT105",
+    "admission_conservation": "SLT106",
+    "all_resolved": "SLT107",
+}
+
+
+def check_run(run: Any, named: Tuple[str, ...] = ()) -> List[Violation]:
+    """Apply the generic invariants plus ``named`` ones to one run;
+    return every violation (does not stop at the first — one schedule
+    can break several)."""
+    out: List[Violation] = []
+    fns = list(GENERIC) + [INVARIANTS[n] for n in named
+                           if INVARIANTS[n] not in GENERIC]
+    for fn in fns:
+        try:
+            fn(run)
+        except Violation as v:
+            out.append(v)
+    return out

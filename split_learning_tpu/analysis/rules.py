@@ -15,6 +15,9 @@ SLT004    wire-path determinism — no module-global RNG, no unseeded
           RNG construction, no wall clock in chaos/codec/ops/breaker
 SLT005    lock-order — the statically visible nested-acquisition graph
           must be acyclic
+SLT011    condition ``wait()`` must sit inside a ``while``-predicate
+          loop (or use ``wait_for``) — the static twin of slt-check's
+          lost-wakeup exploration
 ========  ==============================================================
 
 Rules are deliberately project-shaped: scopes are path suffixes inside
@@ -584,6 +587,68 @@ def check_slt005(src: Src) -> Iterator[Finding]:
 
 
 # ---------------------------------------------------------------------- #
+# SLT011: condition wait() guarded by a while-predicate loop
+# ---------------------------------------------------------------------- #
+
+_CONDISH = ("cond", "condition", "cv")
+
+
+def _is_condish_name(name: str) -> bool:
+    base = name.rsplit(".", 1)[-1].lstrip("_")
+    return any(tok in base for tok in _CONDISH)
+
+
+class _Slt011Visitor(ast.NodeVisitor):
+    """Flags ``<cond>.wait(...)`` not lexically enclosed by a ``while``
+    in the same function. A bare or if-guarded wait returns on ANY
+    notify (or a spurious/timeout wake) with the predicate unchecked —
+    the lost-wakeup / stolen-wakeup shape slt-check explores
+    dynamically; this is its static twin. ``wait_for`` is exempt (it
+    loops internally)."""
+
+    def __init__(self, src: Src) -> None:
+        self.src = src
+        self.findings: List[Finding] = []
+        self._while = 0
+
+    def visit_While(self, node: ast.While) -> None:
+        self._while += 1
+        self.generic_visit(node)
+        self._while -= 1
+
+    def _nested_def(self, node: Any) -> None:
+        # a nested def's waits run in their own frame: restart tracking
+        saved, self._while = self._while, 0
+        self.generic_visit(node)
+        self._while = saved
+
+    visit_FunctionDef = _nested_def
+    visit_AsyncFunctionDef = _nested_def
+    visit_Lambda = _nested_def
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr == "wait"
+                and _is_condish_name(_unparse(f.value))
+                and self._while == 0):
+            self.findings.append(Finding(
+                "SLT011", self.src.path, node.lineno,
+                f"{_unparse(f.value)}.wait() outside a while-predicate "
+                f"loop — a notify meant for another waiter (or a timeout "
+                f"wake) returns with the predicate still false; loop "
+                f"`while not pred: cond.wait()` or use wait_for()"))
+        self.generic_visit(node)
+
+
+def check_slt011(src: Src) -> Iterator[Finding]:
+    if not _in_dir(src, "runtime", "transport"):
+        return
+    v = _Slt011Visitor(src)
+    v.visit(src.tree)
+    yield from v.findings
+
+
+# ---------------------------------------------------------------------- #
 
 RULES = {
     "SLT001": (check_slt001,
@@ -598,6 +663,9 @@ RULES = {
                "RNG, no unseeded RNG, no wall clock"),
     "SLT005": (check_slt005,
                "the static nested-lock-acquisition graph is acyclic"),
+    "SLT011": (check_slt011,
+               "condition wait() sits inside a while-predicate loop "
+               "(or uses wait_for)"),
 }
 
 

@@ -1,0 +1,451 @@
+"""slt-check scenarios — small concurrent workloads over the REAL runtime.
+
+Each scenario is a function ``fn(ctx) -> dict`` driving the actual
+runtime objects (ReplayCache, RequestCoalescer/ContinuousBatcher,
+AdmissionController, CircuitBreaker, FleetHarness, ServerRuntime with a
+stub dispatch) under the cooperative scheduler in sched.py: the objects
+construct their locks/events/conditions/threads through the
+``obs.locks`` seam, so every sync op is a yield point the explorer
+preempts around. Scenarios emit semantic notes (``ctx.note``) that the
+invariants in invariants.py assert over; end-of-run state checks can
+just ``assert`` — a failure rides the ``no_errors`` generic invariant
+and carries the schedule id.
+
+Registration: decorate with :func:`scenario`; the engine's ``--check``
+discovers everything in :data:`SCENARIOS`. Per-scenario knobs (budget,
+preemption bound, dfs/random mode) are tuned so the default full sweep
+is exhaustive where the space is small and seeded-random where it is
+not — and always deterministic.
+
+Scenarios tag racy *non-primitive* shared state (plain attribute reads
+the dependence relation cannot see) with ``ctx.step(tag)`` so the
+sleep-set pruner keeps both orders of the race.
+
+This module may import numpy and the runtime (unlike sched/invariants,
+which are pinned stdlib-only); the jax-backed scenarios gate on the
+import and skip cleanly where jax is absent.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from split_learning_tpu.analysis.sched import Ctx
+
+__all__ = ["Scenario", "SCENARIOS", "scenario"]
+
+
+@dataclass
+class Scenario:
+    """One registered scenario plus its exploration knobs."""
+
+    name: str
+    fn: Callable[[Ctx], Optional[Dict[str, Any]]]
+    invariants: Tuple[str, ...] = ()
+    budget: int = 200
+    bound: Optional[int] = 3
+    mode: str = "dfs"          # dfs | random
+    seed: int = 0
+    requires: Optional[str] = None  # "jax" gates on importability
+    doc: str = ""
+
+    def available(self) -> bool:
+        if self.requires == "jax":
+            try:
+                import jax  # noqa: F401
+                return True
+            except Exception:  # pragma: no cover — cpu image has jax
+                return False
+        return True
+
+
+SCENARIOS: Dict[str, Scenario] = {}
+
+
+def scenario(name: str, *, invariants: Tuple[str, ...] = (),
+             budget: int = 200, bound: Optional[int] = 3,
+             mode: str = "dfs", seed: int = 0,
+             requires: Optional[str] = None) -> Callable:
+    def wrap(fn: Callable[[Ctx], Optional[Dict[str, Any]]]) -> Callable:
+        SCENARIOS[name] = Scenario(
+            name=name, fn=fn, invariants=invariants, budget=budget,
+            bound=bound, mode=mode, seed=seed, requires=requires,
+            doc=(fn.__doc__ or "").strip().splitlines()[0] if fn.__doc__
+            else "")
+        return fn
+    return wrap
+
+
+def _tiny_batch() -> Tuple[np.ndarray, np.ndarray]:
+    acts = np.zeros((1, 4), dtype=np.float32)
+    labels = np.zeros((1,), dtype=np.int64)
+    return acts, labels
+
+
+# --------------------------------------------------------------------- #
+# ReplayCache: the exactly-once claim lifecycle
+# --------------------------------------------------------------------- #
+
+@scenario("replay_dup_storm", invariants=("exactly_once_claims",),
+          budget=400, bound=3)
+def replay_dup_storm(ctx: Ctx) -> Dict[str, Any]:
+    """Three duplicate deliveries of one step race begin(): exactly one
+    wins the claim and applies; losers block on the in-flight future and
+    are served the single materialized value."""
+    from split_learning_tpu.runtime.replay import ReplayCache
+    cache = ReplayCache(window=4)
+    key = (7, "split_step", 3)
+
+    def deliver(tag: str) -> None:
+        entry, owner = cache.begin(*key)
+        ctx.note("begin", key=key, owner=owner, who=tag)
+        if owner:
+            ctx.step("apply")  # the materialization the dup must not redo
+            ctx.note("apply", key=key)
+            cache.resolve(entry, "grad-v1")
+            ctx.note("resolve", key=key, value="grad-v1")
+        else:
+            value = cache.wait(entry, timeout=30.0)
+            ctx.note("wait_return", key=key, value=value)
+
+    workers = [ctx.spawn(deliver, t, name=f"dup-{t}") for t in "abc"]
+    for w in workers:
+        w.join()
+    assert cache.contains(*key)
+    return {"hits": cache.hits}
+
+
+@scenario("replay_fail_retry",
+          invariants=("exactly_once_claims", "reclaimable_429"),
+          budget=400, bound=3)
+def replay_fail_retry(ctx: Ctx) -> Dict[str, Any]:
+    """The claim winner is refused (admission 429) and fail()s its
+    entry; the released claim must be re-ownable so a retry — from
+    either thread — applies the step exactly once."""
+    from split_learning_tpu.runtime.replay import ReplayCache
+    cache = ReplayCache(window=4)
+    key = (9, "split_step", 1)
+    box = {"refused": False}
+
+    def deliver(tag: str) -> None:
+        for _ in range(3):
+            entry, owner = cache.begin(*key)
+            ctx.note("begin", key=key, owner=owner, who=tag)
+            if owner:
+                if not box["refused"]:
+                    box["refused"] = True
+                    ctx.note("backpressure", key=key)
+                    cache.fail(entry, RuntimeError("429: over quota"))
+                    ctx.step("retry")  # the advised-delay retry window
+                    continue
+                ctx.note("apply", key=key)
+                cache.resolve(entry, "grad-v1")
+                ctx.note("resolve", key=key, value="grad-v1")
+                return
+            try:
+                value = cache.wait(entry, timeout=30.0)
+            except RuntimeError:
+                ctx.step("retry")  # owner 429'd: retry to re-own
+                continue
+            ctx.note("wait_return", key=key, value=value)
+            return
+        raise AssertionError(f"{tag} exhausted retries without a reply")
+
+    workers = [ctx.spawn(deliver, t, name=f"retry-{t}") for t in "ab"]
+    for w in workers:
+        w.join()
+    return {"refused": box["refused"]}
+
+
+# --------------------------------------------------------------------- #
+# coalescer: condition handoff + EDF pickup
+# --------------------------------------------------------------------- #
+
+def _stub_dispatch(ctx: Ctx, record_pickup: bool = False
+                   ) -> Callable[[list, str], None]:
+    """A dispatch that resolves every request (the coalescer contract)
+    and notes pickups; runs on the flusher thread."""
+    def dispatch(group: list, reason: str) -> None:
+        if record_pickup:
+            ctx.note("pickup",
+                     group=[(r.deadline, r.seq) for r in group],
+                     reason=reason)
+        for r in group:
+            ctx.note("resolved", key=(r.client_id, r.step))
+            r.result = (r.acts, 0.5)
+            r.done.set()
+    return dispatch
+
+
+@scenario("coalesce_window_handoff", invariants=("all_resolved",),
+          budget=300, bound=2)
+def coalesce_window_handoff(ctx: Ctx) -> Dict[str, Any]:
+    """Two submitters race the window flusher's condition handoff
+    (submit's notify_all vs _collect_group's timed wait): every request
+    must come back resolved exactly once, through any interleaving of
+    arrivals, window expiry, and close()."""
+    from split_learning_tpu.runtime.coalesce import RequestCoalescer
+    co = RequestCoalescer(_stub_dispatch(ctx), max_group=2,
+                          window_s=0.05, mode="window")
+    acts, labels = _tiny_batch()
+
+    def submit(client_id: int) -> None:
+        ctx.note("enqueue", key=(client_id, 0))
+        co.submit(acts, labels, 0, client_id, timeout=60.0)
+
+    workers = [ctx.spawn(submit, c, name=f"sub-{c}") for c in (1, 2)]
+    for w in workers:
+        w.join()
+    co.close(timeout=30.0)
+    return dict(co.counters())
+
+
+@scenario("continuous_edf",
+          invariants=("edf_pickup_order", "all_resolved"),
+          budget=400, bound=2)
+def continuous_edf(ctx: Ctx) -> Dict[str, Any]:
+    """Three deadline-stamped submitters race the continuous batcher:
+    whatever subset is queued at each pickup must come out earliest-
+    deadline-first, equal deadlines in arrival (seq) order."""
+    from split_learning_tpu.runtime.coalesce import ContinuousBatcher
+    co = ContinuousBatcher(_stub_dispatch(ctx, record_pickup=True),
+                           max_group=2)
+    acts, labels = _tiny_batch()
+    base = ctx.clock.monotonic()
+
+    def submit(client_id: int, deadline_off: float) -> None:
+        ctx.note("enqueue", key=(client_id, 0))
+        co.submit(acts, labels, 0, client_id, timeout=60.0,
+                  deadline=base + deadline_off)
+
+    # two tight-SLO tenants tie at +2.0; the batch tenant's +5.0 must
+    # never overtake them
+    workers = [ctx.spawn(submit, 1, 5.0, name="batch"),
+               ctx.spawn(submit, 2, 2.0, name="tight-a"),
+               ctx.spawn(submit, 3, 2.0, name="tight-b")]
+    for w in workers:
+        w.join()
+    co.close(timeout=30.0)
+    return dict(co.counters())
+
+
+# --------------------------------------------------------------------- #
+# admission: token-bucket race
+# --------------------------------------------------------------------- #
+
+@scenario("admission_bucket_race", invariants=("admission_conservation",),
+          budget=300, bound=3)
+def admission_bucket_race(ctx: Ctx) -> Dict[str, Any]:
+    """Two clients of one tenant race a bucket holding exactly one
+    token: exactly one admits, the loser's Backpressure carries a
+    positive retry delay, and the in-flight depth drains to zero."""
+    from split_learning_tpu.runtime.admission import AdmissionController
+    from split_learning_tpu.transport.base import Backpressure
+    ac = AdmissionController(tenants=1, quota=1.0, burst=1.0,
+                             slo_ms=50.0, clock=ctx.clock.monotonic)
+    ctx.note("max_admits", tenant=0, n=1)
+
+    def step(client_id: int) -> None:
+        try:
+            deadline = ac.admit(client_id)
+        except Backpressure as exc:
+            assert exc.retry_after_s > 0.0
+            ctx.note("rejected", tenant=0)
+            return
+        ctx.note("admitted", tenant=0)
+        assert deadline is not None and deadline > ctx.clock.monotonic()
+        ctx.step("inflight")  # the dispatch the slot is charged for
+        ac.complete(client_id)
+        ctx.note("completed", tenant=0)
+
+    workers = [ctx.spawn(step, c, name=f"cl-{c}") for c in (0, 2)]
+    for w in workers:
+        w.join()
+    depth = ac.gauges()["admission_queue_depth_t0"]
+    ctx.note("final_depth", tenant=0, depth=int(depth))
+    return dict(ac.counters())
+
+
+# --------------------------------------------------------------------- #
+# breaker: open/probe/half-open handoff
+# --------------------------------------------------------------------- #
+
+@scenario("breaker_probe_race", budget=300, bound=2)
+def breaker_probe_race(ctx: Ctx) -> Dict[str, Any]:
+    """Two clients trip the breaker open, then race before_attempt()'s
+    probe loop while the server recovers: no schedule may deadlock or
+    strand a prober, and the breaker must end CLOSED after the
+    survivors' record_success."""
+    from split_learning_tpu.runtime.breaker import CircuitBreaker, CLOSED
+    from split_learning_tpu.transport.base import TransportError
+    server_up = {"ok": False}
+
+    def probe() -> None:
+        ctx.step("health")  # racy read of the server's health flag
+        if not server_up["ok"]:
+            raise TransportError("still down")
+
+    br = CircuitBreaker(probe, failure_threshold=2,
+                        probe_initial_s=0.5, probe_cap_s=1.0,
+                        probe_jitter=0.0, max_open_s=30.0,
+                        rng=random.Random(0), sleep=ctx.clock.sleep)
+
+    def client(tag: str) -> None:
+        br.record_failure()  # two of these open the breaker
+        br.before_attempt()  # probes until the server answers
+        br.record_success()
+
+    def recover() -> None:
+        ctx.sleep(1.0)
+        ctx.step("health")
+        server_up["ok"] = True
+
+    workers = [ctx.spawn(client, t, name=f"cl-{t}") for t in "ab"]
+    workers.append(ctx.spawn(recover, name="server"))
+    for w in workers:
+        w.join()
+    # which schedules open the breaker varies (a fast success resets
+    # the failure count), but every open must have reclosed by the end
+    assert br.state == CLOSED, f"breaker ended {br.state}"
+    assert (br.counters["breaker_reclosed"] ==
+            br.counters["breaker_opened"]), dict(br.counters)
+    return dict(br.counters)
+
+
+# --------------------------------------------------------------------- #
+# fleet: scheduler-heap condition handoff
+# --------------------------------------------------------------------- #
+
+class _StubTransport:
+    """A jax-free wire: split_step echoes the activations. `stats` is
+    the surface FleetHarness reads queue waits from."""
+
+    def __init__(self) -> None:
+        from split_learning_tpu.transport.base import TransportStats
+        self.stats = TransportStats()
+
+    def split_step(self, acts: Any, labels: Any, step: int,
+                   client_id: int) -> Tuple[Any, float]:
+        return acts, 0.25
+
+
+@scenario("fleet_handoff", budget=250, bound=2, mode="random", seed=11)
+def fleet_handoff(ctx: Ctx) -> Dict[str, Any]:
+    """A tiny fleet (2 clients x 2 steps, 2 workers) drives the event
+    heap's push/pop-due/done-one condition handoff: every scheduled step
+    must run exactly once and both workers must terminate — the drained
+    check (`not heap and inflight == 0`) must hold through every
+    interleaving of pops, pushes, and completions."""
+    from split_learning_tpu.runtime.fleet import FleetConfig, FleetHarness
+    cfg = FleetConfig(n_clients=2, tenants=1, steps_per_client=2,
+                      workers=2, batch=1, rate_hz=50.0, seed=3,
+                      trace=False)
+    harness = FleetHarness(cfg, lambda cid: _StubTransport())
+    result = harness.run()
+    steps = result.counters["fleet_steps_total"]
+    assert steps == 4.0, f"fleet ran {steps} steps, scheduled 4"
+    assert len(result.losses) == 4
+    return {"steps": steps}
+
+
+# --------------------------------------------------------------------- #
+# server: the real split_step claim/coalesce path (stub dispatch)
+# --------------------------------------------------------------------- #
+
+def _stub_server(ctx: Ctx, quota: Optional[float] = None) -> Any:
+    """A ServerRuntime shell: the real split_step coalescer path (replay
+    claims, admission, continuous batcher) over a dispatch stub that
+    resolves groups without touching jax. Built with __new__ so no model
+    or device is constructed."""
+    from split_learning_tpu.runtime.admission import AdmissionController
+    from split_learning_tpu.runtime.coalesce import ContinuousBatcher
+    from split_learning_tpu.runtime.replay import ReplayCache
+    from split_learning_tpu.runtime.server import ServerRuntime
+
+    srv = ServerRuntime.__new__(ServerRuntime)
+    srv.mode = "split"
+    srv.replay = ReplayCache(window=8)
+    srv._admission = (None if quota is None else AdmissionController(
+        tenants=1, quota=quota, burst=quota,
+        clock=ctx.clock.monotonic))
+
+    def dispatch(group: list, reason: str) -> None:
+        for r in group:
+            ctx.note("apply", key=(r.client_id, r.step))
+            ctx.note("resolved", key=(r.client_id, r.step))
+            r.result = (r.acts, 0.75)
+            r.done.set()
+
+    srv._coalescer = ContinuousBatcher(dispatch, max_group=2)
+    return srv
+
+
+@scenario("server_split_claims",
+          invariants=("exactly_once_claims", "all_resolved"),
+          budget=300, bound=2, requires="jax")
+def server_split_claims(ctx: Ctx) -> Dict[str, Any]:
+    """Duplicate deliveries race the REAL ServerRuntime.split_step
+    coalescer path: the retry that loses the replay claim must block on
+    the in-flight future and receive the one dispatched result — never
+    a second dispatch of the same (client, step)."""
+    srv = _stub_server(ctx)
+    acts, labels = _tiny_batch()
+
+    def deliver(client_id: int, step: int, tag: str) -> None:
+        if tag == "dup":
+            ctx.step("wire")  # the retransmit window
+        else:
+            ctx.note("enqueue", key=(client_id, step))
+        _, loss = srv.split_step(acts, labels, step, client_id)
+        ctx.note("got", key=(client_id, step), value=loss, who=tag)
+        assert loss == 0.75
+
+    workers = [ctx.spawn(deliver, 0, 1, "orig", name="orig"),
+               ctx.spawn(deliver, 0, 1, "dup", name="dup"),
+               ctx.spawn(deliver, 1, 1, "other", name="other")]
+    for w in workers:
+        w.join()
+    srv._coalescer.close(timeout=30.0)
+    applies = [f for k, f in ctx.sched.notes if k == "apply"
+               and f["key"] == (0, 1)]
+    assert len(applies) == 1, f"step (0,1) dispatched {len(applies)}x"
+    return {"hits": srv.replay.hits}
+
+
+@scenario("server_backpressure_reclaim",
+          invariants=("reclaimable_429", "exactly_once_claims"),
+          budget=300, bound=2, requires="jax")
+def server_backpressure_reclaim(ctx: Ctx) -> Dict[str, Any]:
+    """A 429'd step on the real split_step path must release its replay
+    claim (replay.fail in the except path) so the advised retry re-owns
+    and applies it exactly once — the claim must never wedge a refused
+    step forever."""
+    from split_learning_tpu.transport.base import Backpressure
+    srv = _stub_server(ctx, quota=1.0)  # bucket holds exactly 1 token
+    acts, labels = _tiny_batch()
+
+    def deliver(client_id: int, tag: str) -> None:
+        for _ in range(3):
+            try:
+                srv.split_step(acts, labels, 1, client_id)
+                return
+            except Backpressure as exc:
+                key = (client_id, 1)
+                ctx.note("backpressure", key=key)
+                ctx.clock.sleep(exc.retry_after_s + 0.01)
+        raise AssertionError(f"{tag}: retries exhausted")
+
+    # same tenant (tenant 0 is client_id % 1): two steps, one token —
+    # someone eats a 429 and must still land its step via the retry
+    workers = [ctx.spawn(deliver, 0, "a", name="cl-a"),
+               ctx.spawn(deliver, 2, "b", name="cl-b")]
+    for w in workers:
+        w.join()
+    srv._coalescer.close(timeout=30.0)
+    applied = {f["key"] for k, f in ctx.sched.notes if k == "apply"}
+    assert applied == {(0, 1), (2, 1)}, f"applied: {applied}"
+    return {"hits": srv.replay.hits}

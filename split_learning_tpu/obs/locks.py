@@ -236,6 +236,35 @@ class InstrumentedLock:
         self._tl.depth = max(d, 1)
 
 
+# --------------------------------------------------------------------- #
+# model-checker interposition (analysis/sched.py)
+#
+# slt-check installs a factory here for the duration of one explored
+# schedule; every primitive the runtime constructs through the seam
+# below becomes a cooperative, scheduler-controlled object, so each
+# acquire/release/wait/notify/set is a yield point the explorer can
+# branch on. With no factory installed the functions return the plain
+# ``threading`` primitives (or InstrumentedLock under SLT_LOCK_DEBUG=1)
+# — zero overhead on the production path, same off-path convention as
+# chaos and tracing.
+
+_checker: Optional[Any] = None
+
+
+def install_checker(factory: Optional[Any]) -> Optional[Any]:
+    """Install (or, with ``None``, remove) the cooperative-scheduler
+    primitive factory. Returns the previous factory so callers can
+    restore it; analysis/sched.py wraps this in a try/finally."""
+    global _checker
+    prev = _checker
+    _checker = factory
+    return prev
+
+
+def checker_installed() -> bool:
+    return _checker is not None
+
+
 def make_lock(name: str, *, reentrant: bool = True,
               registry: Optional[Any] = None,
               graph: Optional[LockGraph] = None) -> Any:
@@ -243,8 +272,47 @@ def make_lock(name: str, *, reentrant: bool = True,
     ``threading`` primitive when the watchdog is off (zero overhead —
     the wire and the numerics cannot change), an
     :class:`InstrumentedLock` reporting into ``graph`` (default: the
-    process-wide graph) when ``SLT_LOCK_DEBUG=1``."""
+    process-wide graph) when ``SLT_LOCK_DEBUG=1``, or the
+    model checker's cooperative lock while slt-check is exploring."""
+    if _checker is not None:
+        return _checker.lock(name, reentrant=reentrant)
     if not enabled():
         return threading.RLock() if reentrant else threading.Lock()
     return InstrumentedLock(name, reentrant=reentrant, registry=registry,
                             graph=graph)
+
+
+def make_event(name: str = "event") -> Any:
+    """Event twin of :func:`make_lock`: a plain ``threading.Event``
+    normally, the model checker's cooperative event while slt-check is
+    exploring. Events are future-completion latches (replay entries,
+    coalesce request ``done``), so they carry no ordering graph and the
+    SLT_LOCK_DEBUG watchdog leaves them plain."""
+    if _checker is not None:
+        return _checker.event(name)
+    return threading.Event()
+
+
+def make_condition(name: str, *, reentrant: bool = True,
+                   registry: Optional[Any] = None,
+                   graph: Optional[LockGraph] = None) -> Any:
+    """Condition twin of :func:`make_lock`: a ``threading.Condition``
+    over a :func:`make_lock` lock (so the watchdog instruments the
+    underlying mutex via the ``_release_save`` protocol), or the model
+    checker's cooperative condition while slt-check is exploring."""
+    if _checker is not None:
+        return _checker.condition(name, reentrant=reentrant)
+    return threading.Condition(
+        make_lock(name, reentrant=reentrant, registry=registry, graph=graph))
+
+
+def make_thread(target: Any, *, name: str, daemon: bool = True,
+                args: Tuple[Any, ...] = ()) -> Any:
+    """Thread twin of :func:`make_lock`: a plain ``threading.Thread``
+    normally, a scheduler-managed thread while slt-check is exploring
+    (spawn/join become yield points and the explorer serializes it with
+    every other managed thread)."""
+    if _checker is not None:
+        return _checker.thread(target, name=name, daemon=daemon, args=args)
+    return threading.Thread(target=target, name=name, daemon=daemon,
+                            args=args)

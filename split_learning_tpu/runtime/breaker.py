@@ -22,10 +22,10 @@ whether a step is retried, skipped, or fatal.
 from __future__ import annotations
 
 import random
-import threading
 import time
 from typing import Callable, Dict, Optional
 
+from split_learning_tpu.obs import locks as obs_locks
 from split_learning_tpu.transport.base import TransportError, backoff_delays
 
 CLOSED = "closed"
@@ -64,7 +64,7 @@ class CircuitBreaker:
         self._rng = rng if rng is not None else random.Random(
             0 if seed is None else seed)
         self._sleep = sleep  # injectable for tests: no real waiting
-        self._lock = threading.RLock()
+        self._lock = obs_locks.make_lock("CircuitBreaker._lock")
         self.state = CLOSED
         self._consecutive_failures = 0
         self.counters: Dict[str, int] = {

@@ -68,7 +68,10 @@ class CoalesceRequest:
     labels: np.ndarray
     step: int
     client_id: int
-    done: threading.Event = field(default_factory=threading.Event)
+    # via the obs.locks seam (late-bound factory, not the class object)
+    # so slt-check can substitute a cooperative event during exploration
+    done: threading.Event = field(
+        default_factory=lambda: obs_locks.make_event("CoalesceRequest.done"))
     # a value, or (async-dispatch servers) a zero-arg thunk submit()
     # redeems on the waiter thread — see ServerRuntime._GroupD2H
     result: Optional[Any] = None
@@ -83,6 +86,11 @@ class CoalesceRequest:
     # EDF priority (continuous mode): the monotonic-clock SLO deadline
     # the admission layer stamped, None = no SLO (sorts last, FIFO)
     deadline: Optional[float] = None
+    # arrival sequence, stamped under the queue lock at submit: the EDF
+    # tie-breaker. Queue position is NOT a substitute — the queue is
+    # rebuilt after every partial take, so index order only happens to
+    # equal arrival order; equal-deadline pickup must not depend on that
+    seq: int = 0
 
     def shape_key(self) -> tuple:
         """Requests coalesce only when everything but the batch row count
@@ -128,11 +136,11 @@ class RequestCoalescer:
         self.mode = mode
         self.stats = TransportStats()
         self._queue: List[CoalesceRequest] = []
-        self._cond = threading.Condition(
-            obs_locks.make_lock("RequestCoalescer._cond"))
+        self._arrivals = 0  # next CoalesceRequest.seq
+        self._cond = obs_locks.make_condition("RequestCoalescer._cond")
         self._closed = False
-        self._thread = threading.Thread(
-            target=self._run, name="slt-coalescer", daemon=True)
+        self._thread = obs_locks.make_thread(
+            self._run, name="slt-coalescer", daemon=True)
         self._thread.start()
 
     # ------------------------------------------------------------------ #
@@ -157,6 +165,8 @@ class RequestCoalescer:
         with self._cond:
             if self._closed:
                 raise RuntimeError("coalescer is closed")
+            req.seq = self._arrivals
+            self._arrivals += 1
             self._queue.append(req)
             self._cond.notify_all()
         if not req.done.wait(timeout=timeout):
@@ -197,15 +207,18 @@ class RequestCoalescer:
                 return None  # closed and drained
 
             if self.mode == "continuous":
-                # EDF: undeadlined requests sort last, arrival order
-                # breaks ties — a tight-SLO tenant's request becomes the
-                # head even behind a batch-tenant backlog
+                # EDF: undeadlined requests sort last, and the submit-
+                # stamped arrival sequence breaks ties — a tight-SLO
+                # tenant's request becomes the head even behind a
+                # batch-tenant backlog, and equal-deadline requests pick
+                # up in arrival order on every schedule (slt-check's
+                # edf_pickup_order invariant)
                 order = sorted(
                     range(len(self._queue)),
                     key=lambda i: (
                         self._queue[i].deadline
                         if self._queue[i].deadline is not None
-                        else float("inf"), i))
+                        else float("inf"), self._queue[i].seq))
                 key = self._queue[order[0]].shape_key()
                 group: List[CoalesceRequest] = []
                 taken = set()

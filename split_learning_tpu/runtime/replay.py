@@ -30,7 +30,6 @@ duplicates that lose the claim block on the entry's event
 
 from __future__ import annotations
 
-import threading
 from collections import OrderedDict
 from typing import Any, Dict, Optional, Tuple
 
@@ -52,7 +51,9 @@ class _Entry:
 
     def __init__(self, key: Key) -> None:
         self.key = key
-        self.event = threading.Event()
+        # via the obs.locks seam so slt-check (analysis/sched.py) can
+        # substitute a cooperative event and explore resolve/wait races
+        self.event = obs_locks.make_event("ReplayCache._Entry.event")
         self.done = False
         self.result: Any = None
         self.body: Optional[bytes] = None

@@ -19,7 +19,6 @@ silently desyncing after a client restart.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Any, Dict, Optional, Tuple
 
@@ -841,7 +840,7 @@ class _GroupD2H:
         self._g_dev = g_dev
         self._per_ex_dev = per_ex_dev
         self._tr = tr
-        self._lock = threading.Lock()
+        self._lock = obs_locks.make_lock("_GroupD2H._lock", reentrant=False)
         self.g: Optional[np.ndarray] = None
         self.per_ex: Optional[np.ndarray] = None
         self.t_h0 = 0.0
@@ -909,7 +908,7 @@ class FedAvgAggregator:
         # of pinning a window of full-model pytrees.
         self._results: Dict[int, list] = {}  # round -> [mean, reads_left]
         self._round = 0
-        self._cond = threading.Condition()
+        self._cond = obs_locks.make_condition("FedAvgAggregator._cond")
 
     def _read_result(self, round_id: int) -> Any:
         slot = self._results[round_id]

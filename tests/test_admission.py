@@ -230,6 +230,52 @@ def test_continuous_edf_order_and_adaptive_group():
         cb.close()
 
 
+def test_continuous_edf_equal_deadlines_pick_up_in_arrival_order():
+    """Equal deadlines must tie-break on arrival (submit) order, not on
+    whatever order the scheduler woke the submitters in — pins the
+    ``(deadline, seq)`` sort key so the group composition is
+    deterministic (slt-check's edf_pickup_order relies on it)."""
+    release = threading.Event()
+    groups = []
+
+    def dispatch(group, reason):
+        groups.append([r.client_id for r in group])
+        release.wait(5.0)
+        _resolve_all(group, reason)
+
+    cb = ContinuousBatcher(dispatch, max_group=4)
+    try:
+        acts = np.zeros((1, 2), np.float32)
+        labels = np.zeros((1,), np.int64)
+        first = threading.Thread(
+            target=cb.submit, args=(acts, labels, 0, 0),
+            kwargs={"deadline": None}, daemon=True)
+        first.start()
+        time.sleep(0.2)  # in flight, holding the flusher
+        threads = [first]
+        # all the same deadline: pickup must preserve 7, 5, 6 arrival order
+        for cid in (7, 5, 6):
+            th = threading.Thread(
+                target=cb.submit, args=(acts, labels, 0, cid),
+                kwargs={"deadline": 4.0}, daemon=True)
+            threads.append(th)
+            th.start()
+            th_seen = time.time() + 2.0
+            while time.time() < th_seen:   # wait until queued, keeps order
+                with cb._cond:
+                    queued = len(cb._queue)
+                if queued >= len(threads) - 1:
+                    break
+                time.sleep(0.005)
+        release.set()
+        for th in threads:
+            th.join(timeout=5.0)
+        assert groups[0] == [0]
+        assert groups[1] == [7, 5, 6]
+    finally:
+        cb.close()
+
+
 def test_coalescer_close_fails_queued_requests():
     """close() on a wedged flusher must fail still-queued requests with
     a terminal error, not leave their waiters hanging out the full

@@ -70,7 +70,8 @@ def test_slt001_negative_shapes(tmp_path):
                 return g
             def wait_ok(self):
                 with self._cond:
-                    self._cond.wait(timeout=1.0)    # the held cond itself
+                    while not self.ready:
+                        self._cond.wait(timeout=1.0)  # the held cond itself
         class _GroupD2H:
             def _materialize(self):
                 with self._lock:                    # the D2H latch
@@ -652,6 +653,85 @@ def test_slt010_waiver_file(tmp_path):
 
 
 # ---------------------------------------------------------------------- #
+# SLT011: condition wait() outside a while-predicate loop
+# ---------------------------------------------------------------------- #
+
+def test_slt011_bare_and_if_guarded_wait(tmp_path):
+    findings = _lint(tmp_path, "runtime/coalesce.py", """
+        class Coalescer:
+            def a(self):
+                with self._cond:
+                    self._cond.wait(timeout=1.0)      # bare: flagged
+            def b(self):
+                with self.cv:
+                    if not self.ready:
+                        self.cv.wait()                # if-guard: flagged
+    """)
+    assert _rules(findings) == ["SLT011", "SLT011"]
+    msgs = " ".join(f.message for f in findings)
+    assert "while" in msgs
+
+
+def test_slt011_while_wrapped_and_wait_for_are_clean(tmp_path):
+    findings = _lint(tmp_path, "runtime/coalesce.py", """
+        class Coalescer:
+            def a(self):
+                with self._cond:
+                    while not self.ready:
+                        self._cond.wait(timeout=1.0)
+            def b(self):
+                with self.cv:
+                    self.cv.wait_for(lambda: self.ready, timeout=1.0)
+            def c(self):
+                while True:
+                    with self._cond:
+                        self._cond.wait()   # enclosing while counts
+    """)
+    assert findings == []
+
+
+def test_slt011_nested_def_resets_loop_scope(tmp_path):
+    # the while loop belongs to the outer function; a wait() inside a
+    # nested def is NOT protected by it
+    findings = _lint(tmp_path, "runtime/fleet.py", """
+        class Fleet:
+            def run(self):
+                while self.alive:
+                    def poke():
+                        with self._cond:
+                            self._cond.wait()
+                    poke()
+    """)
+    assert _rules(findings) == ["SLT011"]
+
+
+def test_slt011_scoped_to_runtime_and_transport(tmp_path):
+    findings = _lint(tmp_path, "examples/demo.py", """
+        class Demo:
+            def f(self):
+                with self._cond:
+                    self._cond.wait()
+    """)
+    assert findings == []
+
+
+def test_slt011_waiver_file(tmp_path):
+    bad = tmp_path / "runtime" / "coalesce.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(textwrap.dedent("""
+        class C:
+            def f(self):
+                with self._cond:
+                    self._cond.wait()
+    """))
+    wf = tmp_path / "waivers"
+    wf.write_text("SLT011 runtime/coalesce.py single-waiter, "
+                  "timeout-bounded\n")
+    assert engine.main([str(tmp_path), "--waiver-file", str(wf)]) == 0
+    assert engine.main([str(tmp_path)]) == 1
+
+
+# ---------------------------------------------------------------------- #
 # engine: exit codes, waiver file, real tree
 # ---------------------------------------------------------------------- #
 
@@ -701,7 +781,11 @@ def test_list_rules(capsys):
     assert engine.main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rule in ("SLT001", "SLT002", "SLT003", "SLT004", "SLT005",
-                 "SLT006", "SLT007", "SLT008", "SLT009", "SLT010"):
+                 "SLT006", "SLT007", "SLT008", "SLT009", "SLT010",
+                 "SLT011",
+                 # slt-check dynamic-invariant pseudo-rules
+                 "SLT100", "SLT101", "SLT102", "SLT103", "SLT104",
+                 "SLT105", "SLT106", "SLT107"):
         assert rule in out
 
 
@@ -747,7 +831,12 @@ def test_analysis_package_is_stdlib_only():
     """The CI lint step must not need jax/numpy: the analysis package
     imports nothing outside the stdlib and itself."""
     import importlib
-    for mod in ("engine", "rules", "rules_jax", "cfg"):
+    # sched/invariants are pinned too: the model checker itself must
+    # run on the lint image (scenarios.py is the one module allowed to
+    # import numpy/the runtime, and the engine only loads it lazily
+    # under --check)
+    for mod in ("engine", "rules", "rules_jax", "cfg", "sched",
+                "invariants"):
         m = importlib.import_module(f"split_learning_tpu.analysis.{mod}")
         src = Path(m.__file__).read_text()
         tree = ast.parse(src)

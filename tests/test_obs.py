@@ -387,6 +387,46 @@ def test_trace_report_compile_summary(tmp_path):
     assert "xla compiles" not in report.render(rep0)
 
 
+def test_trace_report_schedules_section(tmp_path):
+    """--schedules summarizes an slt-check explorer report: per-scenario
+    schedules/pruned/pruning-ratio/max-preemption rows, skipped
+    scenarios marked, and each violation rendered with its replayable
+    schedule id."""
+    report = _load_trace_report()
+    check = {
+        "total_schedules": 110,
+        "scenarios": {
+            "replay_dup_storm": {
+                "schedules": 100, "pruned": 50, "pruning_ratio": 1 / 3,
+                "exhausted": False, "max_preemptions": 3,
+                "max_transitions": 80, "invariants": ["no_errors"],
+                "violations": [], "sample_fingerprints": {}},
+            "toy_broken": {
+                "schedules": 10, "pruned": 0, "pruning_ratio": 0.0,
+                "exhausted": True, "max_preemptions": 1,
+                "max_transitions": 9,
+                "invariants": ["exactly_once_claims"],
+                "violations": [{"invariant": "exactly_once_claims",
+                                "schedule_id": "toy_broken:3F",
+                                "message": "step 0 applied 2 times"}],
+                "sample_fingerprints": {}},
+            "needs_jax": {"skipped": "jax"},
+        },
+    }
+    p = tmp_path / "check.json"
+    p.write_text(json.dumps(check))
+    rep = report.summarize_schedules(str(p))
+    assert rep["totals"] == {"schedules": 110, "pruned": 50,
+                             "violations": 1, "skipped": 1}
+    text = report.render_schedules(rep)
+    assert "replay_dup_storm" in text and "exhausted" in text
+    assert "budget-capped" in text
+    assert "skipped (requires jax)" in text
+    assert "--schedule toy_broken:3F" in text
+    # CLI: --schedules alone is a valid invocation (no trace positional)
+    assert report.main(["--schedules", str(p)]) == 0
+
+
 # --------------------------------------------------------------------- #
 # runtime.metrics() snapshot (the in-process twin of GET /metrics)
 
