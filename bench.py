@@ -1488,6 +1488,251 @@ def measure_coalesced(quick: bool) -> dict:
     }
 
 
+def measure_reply_latency_2bp(quick: bool) -> dict:
+    """Decoupled backward / 2BP (PR 10): 4 concurrent clients over the
+    synthetic wire against a serialized (non-coalescing) server, coupled
+    vs ``--decouple-bwd --apply-lag 2``. The measured quantity is the
+    server-visible reply window — wall clock around the in-process
+    ``split_step`` hop, wire sleeps excluded — which is what the split
+    moves: the coupled server materializes the cut-layer gradient only
+    when the fused forward+both-grads+opt program finishes, while the
+    decoupled server materializes it after the reply program alone
+    (forward + grad-of-acts) and drains the weight updates into the
+    clients' wire windows (PiPar's idle-window accounting).
+
+    Workload: the split LM transformer with a wide-vocab server-held
+    head (the regime 2BP targets — the weight gradient + optimizer
+    apply over the vocab*d_model head dominates the fused step, while
+    the reply needs only fwd + the d_model-wide dX chain). The
+    reference CNN's conv top half is the opposite regime: its
+    transposed-conv dX is the expensive leg, so reply ~ 0.72x fused
+    there and decoupling buys little — that asymmetry is the point of
+    reporting this leg on the head-heavy shape. Gates (ISSUE 10):
+    decoupled reply p50 <= 0.7x coupled; lag=0 loss series
+    bit-identical to the coupled path; lag=2 parity within the stated
+    nats budget on a converging regime; steady-state recompiles == 0
+    across both decoupled programs."""
+    import statistics
+    import threading
+
+    import jax
+    import numpy as np
+
+    from split_learning_tpu.models import get_plan
+    from split_learning_tpu.runtime import ServerRuntime
+    from split_learning_tpu.runtime.client import SplitClientTrainer
+    from split_learning_tpu.transport.local import LocalTransport
+    from split_learning_tpu.utils import Config
+
+    n_clients = 4
+    per_client_batch = 4
+    seq_len = 16
+    vocab, d_model = 32768, 128
+    rounds = 10 if quick else 16
+    warm = 2
+    # heterogeneous one-way wires: free-running clients with distinct
+    # delays drift out of phase, so arrivals stagger instead of
+    # convoying in lockstep bursts — the regime a real fleet sits in.
+    # The wires are long enough to keep single-core utilization well
+    # under saturation: the deferred applies (and the clients' own
+    # backward/opt work — same core) drain inside the sleep windows,
+    # so the median decoupled reply is the clean fwd+grad-of-acts
+    # program rather than a queue behind earlier device work (device
+    # programs are FIFO)
+    delays = [0.4 * (1 + 0.4 * i) for i in range(n_clients)]
+    lag = 2
+    plan = get_plan(model="transformer", mode="split", vocab=vocab,
+                    d_model=d_model, num_heads=4, client_depth=1,
+                    server_depth=1, lm=True)
+    cfg = Config(mode="split", model="transformer",
+                 batch_size=per_client_batch, num_clients=n_clients)
+    rs = np.random.RandomState(0)
+    x = rs.randint(0, vocab, (rounds, n_clients, per_client_batch,
+                              seq_len)).astype(np.int32)
+    y = rs.randint(0, vocab, (rounds, n_clients, per_client_batch,
+                              seq_len)).astype(np.int32)
+
+    class _DelayedLocal:
+        """Synthetic wire around the in-process hop; times the hop
+        itself (the server-visible reply window) into ``sink``."""
+
+        def __init__(self, inner, delay_s, sink):
+            self.inner = inner
+            self.delay = delay_s
+            self.sink = sink
+            self.stats = inner.stats
+
+        def split_step(self, *a, **kw):
+            time.sleep(self.delay)          # activations down
+            t0 = time.perf_counter()
+            res = self.inner.split_step(*a, **kw)
+            self.sink.append(time.perf_counter() - t0)
+            time.sleep(self.delay)          # gradients back
+            return res
+
+        def health(self):
+            return self.inner.health()
+
+        def close(self):
+            self.inner.close()
+
+    from split_learning_tpu.obs import dispatch_debug
+    dd = dispatch_debug.tracker()
+
+    def run(decouple: bool):
+        sinks: list = [[] for _ in range(n_clients)]
+        dispatch_debug.force(True)
+        try:
+            server = ServerRuntime(
+                plan, cfg, jax.random.PRNGKey(0), x[0, 0],
+                decouple_bwd=decouple, apply_lag=lag if decouple else 0)
+            clients = [
+                SplitClientTrainer(
+                    plan, cfg, jax.random.PRNGKey(1 + i),
+                    _DelayedLocal(LocalTransport(server), delays[i],
+                                  sinks[i]),
+                    client_id=i)
+                for i in range(n_clients)]
+            errs: list = []
+
+            def worker(i: int) -> None:
+                try:
+                    for r in range(rounds):
+                        clients[i].train_step(x[r, i], y[r, i], r)
+                except Exception as e:  # surfaced after join
+                    errs.append(e)
+
+            try:
+                t0 = time.perf_counter()
+                threads = [threading.Thread(target=worker, args=(i,))
+                           for i in range(n_clients)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                dt = time.perf_counter() - t0
+                if errs:
+                    raise errs[0]
+                health = server.health()
+            finally:
+                server.close()
+        finally:
+            dispatch_debug.force(False)
+        timed = [s for sink in sinks for s in sink[warm:]]
+        sps = (rounds - warm) * n_clients / dt
+        return timed, sps, health
+
+    g0 = dd.gauges()
+    coupled_lats, sps_coupled, _ = run(False)
+    dec_lats, sps_dec, dec_health = run(True)
+    g1 = dd.gauges()
+    compile_count = {
+        "total": g1["compile_count"] - g0["compile_count"],
+        "steady_state": (g1["steady_state_recompiles"]
+                         - g0["steady_state_recompiles"])}
+    reply_p50_coupled = statistics.median(coupled_lats)
+    reply_p50_dec = statistics.median(dec_lats)
+    reply_ratio = reply_p50_dec / reply_p50_coupled
+
+    # --- numerics: lag=0 bit-identity + lag=2 staleness budget --------
+    # a converging regime (4 fixed batches cycled — the loss actually
+    # descends) rather than fresh noise every step: staleness on a
+    # never-repeating random stream just random-walks the comparison,
+    # while the budget below is a statement about trajectories that are
+    # going somewhere
+    parity_steps = 16
+    px = rs.randint(0, vocab, (4, per_client_batch, seq_len)
+                    ).astype(np.int32)
+    py = rs.randint(0, vocab, (4, per_client_batch, seq_len)
+                    ).astype(np.int32)
+    pcfg = Config(mode="split", model="transformer",
+                  batch_size=per_client_batch)
+
+    def loss_series(decouple: bool, apply_lag: int):
+        server = ServerRuntime(plan, pcfg, jax.random.PRNGKey(0), px[0],
+                               decouple_bwd=decouple, apply_lag=apply_lag)
+        client = SplitClientTrainer(plan, pcfg, jax.random.PRNGKey(1),
+                                    LocalTransport(server))
+        try:
+            return [client.train_step(px[i % 4], py[i % 4], i)
+                    for i in range(parity_steps)]
+        finally:
+            server.close()
+
+    coupled_series = loss_series(False, 0)
+    lag0_diff = float(np.max(np.abs(
+        np.asarray(coupled_series) - np.asarray(loss_series(True, 0)))))
+    lag2_series = loss_series(True, lag)
+    # the staleness budget is on where the trajectories END (mean of the
+    # last cycle), not the peak pointwise gap mid-descent
+    staleness_nats = abs(float(np.mean(lag2_series[-4:]))
+                         - float(np.mean(coupled_series[-4:])))
+    nats_budget = 0.35
+
+    invalid_reason = None
+    if len(dec_lats) != (rounds - warm) * n_clients:
+        invalid_reason = (
+            f"decoupled run recorded {len(dec_lats)} reply latencies, "
+            f"expected {(rounds - warm) * n_clients}")
+    elif reply_ratio > 0.7:
+        invalid_reason = (
+            f"decoupled reply p50 is {reply_ratio:.2f}x coupled "
+            f"(> 0.7): the reply program is not materially cheaper than "
+            "the fused step, the decoupling bought nothing")
+    elif lag0_diff != 0.0:
+        invalid_reason = (
+            f"lag=0 loss series differs from coupled by {lag0_diff} "
+            "(must be bit-identical: same math, same order)")
+    elif staleness_nats > nats_budget:
+        invalid_reason = (
+            f"lag={lag} end-of-run loss is {staleness_nats:.3f} nats "
+            f"from coupled (> budget {nats_budget}): staleness is "
+            "derailing the trajectory, not perturbing it")
+    elif compile_count["steady_state"]:
+        invalid_reason = (
+            f"steady_state_recompiles={compile_count['steady_state']:.0f}"
+            " != 0: reply_grad/deferred_apply retrace after step 2")
+    return {
+        "leg": "reply_latency_2bp",
+        "clients": n_clients,
+        "per_client_batch": per_client_batch,
+        "model": {"family": "transformer", "lm": True, "vocab": vocab,
+                  "d_model": d_model, "seq_len": seq_len,
+                  "server_depth": 1},
+        "platform": "cpu+local-loopback",
+        "host_cores": os.cpu_count(),
+        "one_way_latency_ms": [d * 1e3 for d in delays],
+        "apply_lag": lag,
+        "note": ("2BP reply-first decoupling: reply window = wall clock "
+                 "around the in-process split_step hop (wire sleeps "
+                 "excluded), 4 concurrent clients, serialized server. "
+                 "Coupled replies wait for the fused fwd+grads+opt "
+                 "program; decoupled replies wait for fwd+grad-of-acts "
+                 "only, the weight updates drain into the wire windows "
+                 "(<= apply_lag queued). Workload is the wide-vocab "
+                 "LM-head split (weight-update-dominant server half); "
+                 "the conv reference model is dX-dominant and would "
+                 "show reply ~ 0.72x fused. Staleness semantics: step "
+                 "t forwards on weights from step t-k, k <= apply_lag"),
+        "reply_p50_ms_coupled": reply_p50_coupled * 1e3,
+        "reply_p50_ms_decoupled": reply_p50_dec * 1e3,
+        "reply_p50_ratio": reply_ratio,
+        "reply_p90_ms_coupled": float(np.percentile(coupled_lats, 90))
+        * 1e3,
+        "reply_p90_ms_decoupled": float(np.percentile(dec_lats, 90)) * 1e3,
+        "steps_per_sec_coupled": sps_coupled,
+        "steps_per_sec_decoupled": sps_dec,
+        "decoupled_counters": dec_health.get("decoupled_bwd"),
+        "compile_count": compile_count,
+        "loss_lag0_max_abs_diff": lag0_diff,
+        "loss_lag2_staleness_nats": staleness_nats,
+        "nats_budget": nats_budget,
+        "parity_steps": parity_steps,
+        "valid": invalid_reason is None,
+        "invalid_reason": invalid_reason,
+    }
+
+
 def measure_flash_micro(quick: bool) -> dict:
     """Kernel-level flash block sweep: fwd and fwd+bwd timed SEPARATELY
     per block edge (VERDICT r4 #8 asked for exactly this split — the
@@ -1887,8 +2132,9 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--role",
                     choices=["baseline", "fused", "dp", "wire", "topk8",
-                             "pipelined", "coalesced", "chaos_soak",
-                             "fleet_soak", "decode", "flash_micro"],
+                             "pipelined", "coalesced", "reply_latency_2bp",
+                             "chaos_soak", "fleet_soak", "decode",
+                             "flash_micro"],
                     default=None)
     ap.add_argument("--quick", action="store_true")
     args = ap.parse_args()
@@ -1900,6 +2146,7 @@ def main() -> None:
               "topk8": measure_topk8,
               "pipelined": measure_pipelined,
               "coalesced": measure_coalesced,
+              "reply_latency_2bp": measure_reply_latency_2bp,
               "chaos_soak": measure_chaos_soak,
               "fleet_soak": measure_fleet_soak,
               "decode": measure_decode,
@@ -2080,6 +2327,12 @@ def main() -> None:
                                timeout=900)
         if coal is not None:
             detail["multi_client_coalesced"] = coal
+        # reply-first decoupled backward (2BP): reply p50 coupled vs
+        # decoupled at 4 concurrent clients over the synthetic wire
+        twobp = _run_subprocess("reply_latency_2bp", args.quick, CPU_ENV,
+                                timeout=900)
+        if twobp is not None:
+            detail["reply_latency_2bp"] = twobp
         # robustness soak: a seeded response-drop/dup/5xx schedule must
         # lose zero batches and match the fault-free run's loss
         soak = _run_subprocess("chaos_soak", args.quick, CPU_ENV,

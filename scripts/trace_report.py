@@ -44,11 +44,14 @@ from typing import Any, Dict, List
 # contract.
 try:
     from split_learning_tpu.obs.spans import (CLIENT_PHASES, COMPILE,
+                                              DEFERRED_APPLY, REPLY_GRAD,
                                               TRANSPORT_SUB)
 except ImportError:
     CLIENT_PHASES = ("client_fwd", "transport", "client_bwd", "opt_apply")
     TRANSPORT_SUB = ("encode", "wire", "queue_wait", "dispatch", "d2h")
     COMPILE = "xla_compile"
+    REPLY_GRAD = "reply_grad"
+    DEFERRED_APPLY = "deferred_apply"
 
 
 def load_events(path: str) -> List[Dict[str, Any]]:
@@ -182,6 +185,33 @@ def summarize(events: List[Dict[str, Any]],
         "steady_state_count": steady_compiles,
     }
 
+    # reply-latency vs step-latency breakdown (PR 10, --decouple-bwd):
+    # on a decoupled server the client-visible reply window is the
+    # reply_grad span; the deferred_apply spans are the weight updates
+    # that left the critical path. The "coupled-equivalent" step cost is
+    # reply p50 + the apply cost amortized per reply — what each reply
+    # WOULD have carried had the update stayed fused. Only emitted when
+    # reply_grad spans exist, so coupled traces render unchanged.
+    decoupled = None
+    reply_xs = sorted(by_phase.get(REPLY_GRAD, []))
+    if reply_xs:
+        apply_xs = sorted(by_phase.get(DEFERRED_APPLY, []))
+        apply_total = sum(apply_xs)
+        reply_p50 = _percentile(reply_xs, 50)
+        amortized = apply_total / len(reply_xs)
+        step_equiv = reply_p50 + amortized
+        decoupled = {
+            "replies": len(reply_xs),
+            "applies": len(apply_xs),
+            "reply_p50_ms": reply_p50 * 1e3,
+            "reply_p90_ms": _percentile(reply_xs, 90) * 1e3,
+            "apply_total_s": apply_total,
+            "apply_amortized_ms": amortized * 1e3,
+            "step_equivalent_p50_ms": step_equiv * 1e3,
+            "reply_over_step": (reply_p50 / step_equiv
+                                if step_equiv > 0 else 0.0),
+        }
+
     rep = {
         "events": len(events),
         "spans": len(spans),
@@ -191,6 +221,7 @@ def summarize(events: List[Dict[str, Any]],
         "transport_fraction": client_mix.get("transport", 0.0),
         "transport_decomposition_s": tsub,
         "compile": compile_summary,
+        "decoupled_bwd": decoupled,
         "span_sum_over_wall_clock": coverage,
     }
     if tenants > 0:
@@ -227,6 +258,23 @@ def render(rep: Dict[str, Any]) -> str:
             f"steady-state (step >= 2): {comp['steady_state_count']}"
             + ("  <-- recompile storm"
                if comp["steady_state_count"] else ""))
+    dec = rep.get("decoupled_bwd")
+    if dec:
+        lines.append("")
+        lines.append("decoupled backward (2BP) — reply vs step latency:")
+        lines.append(
+            f"  replies: {dec['replies']}  "
+            f"deferred applies: {dec['applies']}")
+        lines.append(
+            f"  reply p50: {dec['reply_p50_ms']:.3f}ms  "
+            f"p90: {dec['reply_p90_ms']:.3f}ms")
+        lines.append(
+            f"  apply amortized/reply: {dec['apply_amortized_ms']:.3f}ms "
+            f"({dec['apply_total_s']:.4f}s total off the critical path)")
+        lines.append(
+            f"  coupled-equivalent step p50: "
+            f"{dec['step_equivalent_p50_ms']:.3f}ms  "
+            f"-> reply/step ratio: {dec['reply_over_step']:.2f}")
     tqw = rep.get("tenant_queue_wait")
     if tqw:
         lines.append("")

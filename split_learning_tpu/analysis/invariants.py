@@ -230,6 +230,53 @@ def all_resolved(run: Any) -> None:
                 f"request {key} resolved {n} times (want exactly 1)")
 
 
+def deferred_apply_exactly_once(run: Any) -> None:
+    """Decoupled-backward queue discipline (PR 10): every weight update
+    the reply path enqueued is applied exactly once, applies happen in
+    enqueue order (the drain is FIFO — out-of-order application breaks
+    the delayed-gradient semantics the staleness bound is stated for),
+    and a drain that ran to completion (``final_depth``) left nothing
+    behind.
+
+    Notes read: ``da_enqueue(key)``, ``da_apply(key)``,
+    ``da_final_depth(depth)``."""
+    enq = [f["key"] for f in _notes(run, "da_enqueue")]
+    applied = [f["key"] for f in _notes(run, "da_apply")]
+    counts: Dict[Any, int] = {}
+    for key in applied:
+        counts[key] = counts.get(key, 0) + 1
+    for key, n in counts.items():
+        if n > 1:
+            raise Violation(
+                "deferred_apply_exactly_once", run.schedule_id,
+                f"deferred apply {key} ran {n} times — the weight "
+                f"update double-applied")
+        if key not in enq:
+            raise Violation(
+                "deferred_apply_exactly_once", run.schedule_id,
+                f"deferred apply {key} ran but was never enqueued")
+    for f in _notes(run, "da_final_depth"):
+        if f["depth"] != 0:
+            raise Violation(
+                "deferred_apply_exactly_once", run.schedule_id,
+                f"drain finished with {f['depth']} update(s) still "
+                f"queued (want 0: close()/flush must not strand applies "
+                f"whose replies already shipped)")
+        missing = [k for k in enq if counts.get(k, 0) != 1]
+        if missing:
+            raise Violation(
+                "deferred_apply_exactly_once", run.schedule_id,
+                f"enqueued update(s) {missing} never applied despite a "
+                f"completed drain")
+    # FIFO order: the applied sequence must be the enqueue sequence
+    # restricted to applied keys (prefix if the run ended mid-queue)
+    expect = [k for k in enq if k in counts]
+    if applied != expect:
+        raise Violation(
+            "deferred_apply_exactly_once", run.schedule_id,
+            f"applies ran out of enqueue order: {applied} vs {expect}")
+
+
 INVARIANTS: Dict[str, Callable[[Any], None]] = {
     "deadlock_free": deadlock_free,
     "no_lost_wakeup": no_lost_wakeup,
@@ -239,6 +286,7 @@ INVARIANTS: Dict[str, Callable[[Any], None]] = {
     "reclaimable_429": reclaimable_429,
     "admission_conservation": admission_conservation,
     "all_resolved": all_resolved,
+    "deferred_apply_exactly_once": deferred_apply_exactly_once,
 }
 
 # --check findings flow through slt-lint's waiver/exit-code machinery;
@@ -253,6 +301,7 @@ RULE_OF_INVARIANT: Dict[str, str] = {
     "reclaimable_429": "SLT105",
     "admission_conservation": "SLT106",
     "all_resolved": "SLT107",
+    "deferred_apply_exactly_once": "SLT108",
 }
 
 

@@ -18,6 +18,10 @@ SLT005    lock-order — the statically visible nested-acquisition graph
 SLT011    condition ``wait()`` must sit inside a ``while``-predicate
           loop (or use ``wait_for``) — the static twin of slt-check's
           lost-wakeup exploration
+SLT012    on a deferred-apply runtime (``--decouple-bwd``, PR 10) every
+          ``self.state.params`` read holds the apply lock or goes
+          through the flush barrier — an unlocked read can observe
+          params up to ``apply_lag`` updates stale
 ========  ==============================================================
 
 Rules are deliberately project-shaped: scopes are path suffixes inside
@@ -649,6 +653,100 @@ def check_slt011(src: Src) -> Iterator[Finding]:
 
 
 # ---------------------------------------------------------------------- #
+# SLT012: server params reads happen under the apply lock / flush barrier
+# ---------------------------------------------------------------------- #
+
+# the sanctioned readers: methods whose whole job is to drain the
+# deferred-apply queue and hand out post-flush state — they take the
+# lock themselves, and scoping the rule to everything else keeps the
+# finding message honest ("hold the lock or go through the barrier")
+_FLUSH_BARRIER_METHODS = frozenset({"export_state", "flush_deferred"})
+
+
+def _mentions_deferred(cls: ast.ClassDef) -> bool:
+    """Does this class own a deferred-apply queue (``self._deferred``)?
+    Classes without one have no stale-params hazard: ``self.state`` is
+    only ever advanced synchronously under the caller's own dispatch."""
+    return any(isinstance(n, ast.Attribute) and n.attr == "_deferred"
+               for n in ast.walk(cls))
+
+
+def _is_state_params_read(node: ast.Attribute) -> bool:
+    """Exactly the ``self.state.params`` chain (loads and deeper
+    subscripts both end at this Attribute)."""
+    if node.attr != "params":
+        return False
+    v = node.value
+    return (isinstance(v, ast.Attribute) and v.attr == "state"
+            and isinstance(v.value, ast.Name) and v.value.id == "self")
+
+
+class _Slt012Visitor(ast.NodeVisitor):
+    """Within a deferred-apply-owning class: flag ``self.state.params``
+    reads made with no self-lock held, outside the flush-barrier
+    methods. With ``--decouple-bwd`` the queue may hold up to
+    ``apply_lag`` pending weight updates, so such a read silently
+    observes stale params — and worse, races the drain's
+    ``self.state = ...`` writes."""
+
+    def __init__(self, src: Src) -> None:
+        self.src = src
+        self.findings: List[Finding] = []
+        self._held = 0
+        self._barrier = 0
+
+    def _visit_with(self, node: Any) -> None:
+        locks = [n for n in (_lock_expr_name(i.context_expr)
+                             for i in node.items) if n is not None]
+        self._held += len(locks)
+        self.generic_visit(node)
+        self._held -= len(locks)
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+    def _visit_def(self, node: Any) -> None:
+        # a def under a with-lock doesn't run there (same reasoning as
+        # SLT001); barrier status is keyed on the method's own name
+        barrier = getattr(node, "name", "") in _FLUSH_BARRIER_METHODS
+        held, self._held = self._held, 0
+        if barrier:
+            self._barrier += 1
+        self.generic_visit(node)
+        if barrier:
+            self._barrier -= 1
+        self._held = held
+
+    visit_FunctionDef = _visit_def
+    visit_AsyncFunctionDef = _visit_def
+    visit_Lambda = _visit_def
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if (_is_state_params_read(node) and not self._held
+                and not self._barrier):
+            self.findings.append(Finding(
+                "SLT012", self.src.path, node.lineno,
+                "self.state.params read without the apply lock on a "
+                "deferred-apply runtime — with --decouple-bwd up to "
+                "apply_lag weight updates may still be queued, so this "
+                "read observes stale params (and races the drain's "
+                "state writes); hold the lock, or read via "
+                "export_state()/flush_deferred()"))
+        self.generic_visit(node)
+
+
+def check_slt012(src: Src) -> Iterator[Finding]:
+    if not _in_dir(src, "runtime"):
+        return
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ClassDef) and _mentions_deferred(node):
+            v = _Slt012Visitor(src)
+            for item in node.body:
+                v.visit(item)
+            yield from v.findings
+
+
+# ---------------------------------------------------------------------- #
 
 RULES = {
     "SLT001": (check_slt001,
@@ -666,6 +764,9 @@ RULES = {
     "SLT011": (check_slt011,
                "condition wait() sits inside a while-predicate loop "
                "(or uses wait_for)"),
+    "SLT012": (check_slt012,
+               "self.state.params reads on a deferred-apply runtime "
+               "hold the apply lock or go through the flush barrier"),
 }
 
 

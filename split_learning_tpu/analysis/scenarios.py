@@ -368,6 +368,7 @@ def _stub_server(ctx: Ctx, quota: Optional[float] = None) -> Any:
 
     srv = ServerRuntime.__new__(ServerRuntime)
     srv.mode = "split"
+    srv._deferred = None  # coupled path: no deferred-apply queue
     srv.replay = ReplayCache(window=8)
     srv._admission = (None if quota is None else AdmissionController(
         tenants=1, quota=quota, burst=quota,
@@ -449,3 +450,67 @@ def server_backpressure_reclaim(ctx: Ctx) -> Dict[str, Any]:
     applied = {f["key"] for k, f in ctx.sched.notes if k == "apply"}
     assert applied == {(0, 1), (2, 1)}, f"applied: {applied}"
     return {"hits": srv.replay.hits}
+
+
+# --------------------------------------------------------------------- #
+# decoupled backward: the deferred-apply queue (PR 10, SLT108)
+# --------------------------------------------------------------------- #
+
+@scenario("deferred_apply_storm",
+          invariants=("deferred_apply_exactly_once",
+                      "exactly_once_claims"),
+          budget=400, bound=3)
+def deferred_apply_storm(ctx: Ctx) -> Dict[str, Any]:
+    """Replay-duplicate deliveries race the real _DeferredApply queue
+    (lag=1) and a mid-run close()-style flush: only the claim owner may
+    enqueue a step's weight update, every enqueued update applies
+    exactly once and in enqueue order, and the final drain leaves the
+    queue empty — through every interleaving of pushes, lag drains, the
+    racing flush, and the duplicate's wait."""
+    from split_learning_tpu.obs import locks as obs_locks
+    from split_learning_tpu.runtime.replay import ReplayCache
+    from split_learning_tpu.runtime.server import _DeferredApply
+
+    # the runtime hands _DeferredApply its own (reentrant) apply lock;
+    # mirror that shape so push/drain happen inside the lock-held
+    # window exactly as split_step does
+    lock = obs_locks.make_lock("ServerRuntime._lock")
+
+    def apply_fn(entry: Dict[str, Any]) -> None:
+        ctx.note("da_apply", key=entry["step"])
+
+    dq = _DeferredApply(apply_fn, 1, lock)
+    cache = ReplayCache(window=8)
+
+    def deliver(step: int, tag: str) -> None:
+        if tag == "dup":
+            ctx.step("wire")  # the retransmit window
+        entry, owner = cache.begin(0, "split_step", step)
+        ctx.note("begin", key=(0, step), owner=owner, who=tag)
+        if owner:
+            with lock:  # split_step's lock-held reply window
+                ctx.note("da_enqueue", key=step)
+                dq.push({"step": step})
+                dq.drain_over_lag()
+            ctx.note("apply", key=(0, step))
+            cache.resolve(entry, step)
+            ctx.note("resolve", key=(0, step), value=step)
+        else:
+            value = cache.wait(entry, timeout=30.0)
+            ctx.note("wait_return", key=(0, step), value=value)
+
+    def closer() -> None:
+        # a mid-run flush barrier (predict/checkpoint/close) racing the
+        # reply path: drained, never dropped
+        ctx.step("close")
+        dq.flush()
+
+    workers = [ctx.spawn(deliver, 1, "orig", name="s1"),
+               ctx.spawn(deliver, 1, "dup", name="s1-dup"),
+               ctx.spawn(deliver, 2, "orig", name="s2"),
+               ctx.spawn(closer, name="closer")]
+    for w in workers:
+        w.join()
+    dq.flush()  # end-of-run close(): everything must land
+    ctx.note("da_final_depth", depth=dq.depth())
+    return dict(dq.counters())

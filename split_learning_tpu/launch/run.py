@@ -408,6 +408,13 @@ def cmd_train(args) -> int:
               "MPMD local/http transports; fused/pipeline exchange "
               "in-XLA and have no wire to overlap)", file=sys.stderr)
 
+    if getattr(args, "decouple_bwd", False) \
+            and args.transport in ("fused", "pipeline"):
+        print(f"[warn] --decouple-bwd ignored on transport="
+              f"{args.transport!r} (2BP splits the server party's "
+              "reply from its weight update; the fused/pipeline paths "
+              "have no server party)", file=sys.stderr)
+
     if args.transport in ("fused", "pipeline"):
         from split_learning_tpu.parallel import global_mesh
         from split_learning_tpu.parallel.mesh import replicated
@@ -642,7 +649,11 @@ def cmd_train(args) -> int:
             server = ServerRuntime(plan, cfg, jax.random.PRNGKey(cfg.seed),
                                    sample, strict_steps=depth <= 1,
                                    overlap=not getattr(
-                                       args, "no_overlap", False))
+                                       args, "no_overlap", False),
+                                   decouple_bwd=getattr(
+                                       args, "decouple_bwd", False),
+                                   apply_lag=getattr(
+                                       args, "apply_lag", 0) or 0)
             # --compress plumbs here too (wire emulation through the real
             # codec) so compressed-path runs don't need sockets; None
             # keeps the legacy direct path bit-for-bit
@@ -717,7 +728,10 @@ def cmd_train(args) -> int:
             else:
                 tree["client"] = client.state
             if server is not None:
-                tree["server"] = server.state
+                # export_state, not .state: joint checkpoints must not
+                # capture a server half that is apply_lag updates behind
+                # the replies the client half already trained on
+                tree["server"] = server.export_state()
             return tree
 
         start_step = 0
@@ -792,11 +806,15 @@ def cmd_train(args) -> int:
         if cfg.mode == "federated":
             full_params = client.state.params
         elif server is not None:
+            # export_state: the eval composition must include any
+            # deferred applies still queued (--decouple-bwd)
             if cfg.mode == "u_split":
-                full_params = [client.state_a.params, server.state.params,
+                full_params = [client.state_a.params,
+                               server.export_state().params,
                                client.state_c.params]
             else:
-                full_params = [client.state.params, server.state.params]
+                full_params = [client.state.params,
+                               server.export_state().params]
 
     if phase_prof is not None and phase_prof.summary():
         print(f"[profile] {json.dumps(phase_prof.summary())}", file=sys.stderr)
@@ -897,7 +915,9 @@ def cmd_serve(args) -> int:
                                 batching=args.batching,
                                 tenants=args.tenants,
                                 quota=args.quota,
-                                slo_ms=args.slo_ms)
+                                slo_ms=args.slo_ms,
+                                decouple_bwd=args.decouple_bwd,
+                                apply_lag=args.apply_lag)
     except ValueError as e:  # e.g. --coalesce-max outside split mode
         print(f"[error] {e}", file=sys.stderr)
         return 2
@@ -1019,9 +1039,14 @@ def cmd_serve(args) -> int:
         def on_step(step: int) -> None:
             # save_once: no barriering latest_step() here — this hook runs
             # under the runtime lock, so a barrier would stall every client
-            # on the previous in-flight write
+            # on the previous in-flight write. export_state() (not
+            # .state) flushes any deferred applies first (--decouple-bwd:
+            # the live state may be up to apply_lag updates behind); the
+            # flush only dispatches async jitted calls, so it is safe
+            # under the lock this hook already holds.
             if (step + 1) % every == 0:
-                ckptr.save_once(step + 1, {"server": runtime.state})
+                ckptr.save_once(step + 1,
+                                {"server": runtime.export_state()})
 
         runtime.on_step = on_step
 
@@ -1360,6 +1385,22 @@ def main(argv: Optional[list] = None) -> int:
                          "materialize results while holding its device "
                          "lock (pre-async-dispatch behavior; escape hatch "
                          "— see README 'Async dispatch & prefetch')")
+    pt.add_argument("--decouple-bwd", dest="decouple_bwd",
+                    action="store_true",
+                    help="split mode, local transport: 2BP reply-first "
+                         "server — return the cut-layer gradient from a "
+                         "forward+grad-of-acts dispatch immediately and "
+                         "defer the weight update off the reply critical "
+                         "path (see README 'Decoupled backward (2BP)'); "
+                         "off = the fused legacy step, bit-identical")
+    pt.add_argument("--apply-lag", dest="apply_lag", type=int, default=0,
+                    help="with --decouple-bwd: let up to N weight "
+                         "updates queue before the reply path drains "
+                         "them — step t's forward may then use weights "
+                         "from step t-k, k <= N (bounded staleness). "
+                         "0 (default) = every update lands before the "
+                         "next step is admitted: the legacy loss "
+                         "trajectory, bit-for-bit")
     pt.add_argument("--chaos", default=None, metavar="SPEC",
                     help="deterministic fault injection on the client "
                          "wire: comma list of kind[=rate][:ms], kinds "
@@ -1444,6 +1485,21 @@ def main(argv: Optional[list] = None) -> int:
                          "async-dispatch overlap of step t's host copy "
                          "with step t+1's compute; escape hatch — see "
                          "README 'Async dispatch & prefetch')")
+    ps.add_argument("--decouple-bwd", dest="decouple_bwd",
+                    action="store_true",
+                    help="split mode: 2BP reply-first step — reply with "
+                         "the cut-layer gradient from a forward+grad-of-"
+                         "acts dispatch immediately, defer the weight "
+                         "update off the reply critical path (README "
+                         "'Decoupled backward (2BP)'); checkpoints, "
+                         "predict and shutdown flush the queue first")
+    ps.add_argument("--apply-lag", dest="apply_lag", type=int, default=0,
+                    help="with --decouple-bwd: bounded staleness — up "
+                         "to N deferred weight updates may queue, so a "
+                         "step's forward can use weights at most N "
+                         "updates old; 0 (default) applies each update "
+                         "before the next step is admitted (the legacy "
+                         "trajectory, bit-for-bit)")
     ps.add_argument("--compress", choices=["none", "int8", "topk8"],
                     default=None,
                     help="default wire compression for replies to clients "

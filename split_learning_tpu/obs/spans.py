@@ -47,6 +47,19 @@ ADMISSION_QUEUE_DEPTH = "admission_queue_depth"
 # histogram of the advised Retry-After delays handed to rejected callers
 ADMISSION_RETRY_AFTER = "admission_retry_after"
 
+# -- decoupled backward / 2BP (runtime/server.py, PR 10) --------------- #
+# reply_grad: the client-visible reply window on a decoupled server —
+# from dispatch of the reply program (forward + grad-of-activations
+# only) to the cut-layer gradient materialized on host. Recorded only
+# when --decouple-bwd is on; it is the numerator of the reply-latency
+# vs step-latency breakdown trace_report.py prints.
+REPLY_GRAD = "reply_grad"
+# deferred_apply: one flushed weight-update dispatch (grad-of-weights +
+# optimizer apply) running OFF the reply critical path. Like lock_hold
+# it must never tile a step's timeline next to ``dispatch`` — a lag=0
+# flush happens inside the same lock-held window.
+DEFERRED_APPLY = "deferred_apply"
+
 # XLA compile events surfaced by obs/dispatch_debug.py under
 # SLT_DISPATCH_DEBUG=1 — a recompile storm shows up on the timeline and
 # in trace_report.py's compile summary; deliberately NOT in SERVER_PHASES
@@ -67,4 +80,5 @@ SERVER_PHASES = (QUEUE_WAIT, DISPATCH, D2H)
 TRANSPORT_SUB = (ENCODE, WIRE, QUEUE_WAIT, DISPATCH, D2H)
 
 ALL_SPANS = (CLIENT_FWD, ENCODE, WIRE, TRANSPORT, CLIENT_BWD, OPT_APPLY,
-             STEP_TOTAL, QUEUE_WAIT, DISPATCH, D2H)
+             STEP_TOTAL, QUEUE_WAIT, DISPATCH, D2H, REPLY_GRAD,
+             DEFERRED_APPLY)

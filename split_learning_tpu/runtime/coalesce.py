@@ -36,6 +36,16 @@ Two flush policies share the queue (``mode`` ctor knob):
 This is the queue half; the batched math lives in
 :meth:`ServerRuntime._dispatch_group` (runtime/server.py), injected as
 ``dispatch`` so the coalescer stays free of jax and trivially testable.
+
+Decoupled backward (PR 10, ``--decouple-bwd``): the injected dispatch
+resolves every waiter's cut-layer gradient and fires their ``done``
+events BEFORE the group's single weight update enters the deferred-apply
+queue — replies leave on the reply program's dispatch, the apply rides
+the device FIFO behind them and may stay queued up to ``apply_lag``
+further groups (slt-check invariant SLT108 pins exactly-once, in-order
+application). The coalescer itself is unchanged: the contract lives
+entirely inside the injected ``dispatch``, which is why this module
+still has no idea the split exists.
 """
 
 from __future__ import annotations

@@ -149,6 +149,29 @@ class MultiClientSplitRunner:
             self._pool.shutdown(wait=True)
             self._pool = None
 
+    def _flush_server_halves(self) -> None:
+        """Flush any in-process server's deferred-apply queue
+        (ServerRuntime.flush_deferred, --decouple-bwd). sync_bottoms is
+        the fleet's consistency barrier — rounds after it are usually
+        checkpointed/evaluated as one unit, so the shared top half must
+        not stay up to apply_lag updates behind the bottoms being
+        averaged. Duck-typed through the transports (unwrapping chaos/
+        delay wrappers via ``.inner``): a LocalTransport exposes its
+        ``server``; HTTP transports don't, and a remote decoupled
+        server flushes at its own barriers (predict/checkpoint/close)."""
+        seen = set()
+        for c in self.clients:
+            t = getattr(c, "transport", None)
+            while t is not None:
+                srv = getattr(t, "server", None)
+                if srv is not None:
+                    flush = getattr(srv, "flush_deferred", None)
+                    if callable(flush) and id(srv) not in seen:
+                        seen.add(id(srv))
+                        flush()
+                    break
+                t = getattr(t, "inner", None)
+
     def sync_bottoms(self) -> None:
         """FedAvg the client bottom stages that have actually trained
         (optimizer state stays local). A client whose state is None or
@@ -158,6 +181,7 @@ class MultiClientSplitRunner:
         bottom toward initialization, and overwriting the dropout's
         params would hide that it never contributed."""
         from split_learning_tpu.runtime.state import fedavg_mean
+        self._flush_server_halves()
         ready = [c for c in self.clients
                  if c.state is not None and int(c.state.step) > 0]
         if len(ready) < 2:

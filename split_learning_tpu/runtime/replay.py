@@ -26,6 +26,14 @@ therefore futures, not just values: :meth:`begin` claims ownership of a
 duplicates that lose the claim block on the entry's event
 (:meth:`wait`) and are served the one materialized result — never a
 409, never a second apply, never a second D2H.
+
+Decoupled backward (PR 10): the same :meth:`begin` claim is what keeps
+a replayed reply from re-enqueuing a deferred weight update. The claim
+is taken before the owner dispatches anything, and only the claim owner
+reaches the code that pushes onto ``_DeferredApply`` — a duplicate is
+parked on the entry's event and served the cached cut-layer gradient,
+so per (client, op, step) there is at most one enqueue and hence (with
+SLT108's exactly-once drain) at most one apply, replay storms included.
 """
 
 from __future__ import annotations

@@ -20,6 +20,7 @@ silently desyncing after a client restart.
 from __future__ import annotations
 
 import time
+from collections import deque
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -70,7 +71,9 @@ class ServerRuntime:
                  batching: str = "window",
                  tenants: int = 1,
                  quota: Optional[Any] = None,
-                 slo_ms: Optional[Any] = None) -> None:
+                 slo_ms: Optional[Any] = None,
+                 decouple_bwd: bool = False,
+                 apply_lag: int = 0) -> None:
         """coalesce_max > 1 turns on request coalescing (classic split
         mode only): concurrent split_step calls that arrive within
         ``coalesce_window_ms`` of each other batch into one dispatch, up
@@ -111,7 +114,27 @@ class ServerRuntime:
 
         ``d2h_delay_s`` adds a synthetic pause to every host
         materialization — bench-only (CPU JAX has no real transfer cost
-        to overlap), honestly labeled wherever it is used."""
+        to overlap), honestly labeled wherever it is used.
+
+        ``decouple_bwd`` (2BP, arXiv:2405.18047) splits the split-mode
+        server step into two dispatches: a *reply* program (forward +
+        grad-of-activations only) whose result is materialized and
+        returned to the client immediately, and a *deferred apply*
+        program (grad-of-weights from the on-device residuals — the
+        activations/labels and the params snapshot the reply used — plus
+        the optimizer apply) queued in a :class:`_DeferredApply` and
+        drained off the reply critical path. ``apply_lag`` bounds the
+        queue depth N: step t's forward may use weights from step t−k
+        with k ≤ N (k = the queue depth at dispatch), and the over-lag
+        tail is drained under the lock right after each reply dispatch,
+        so the bound is an invariant, not a hint. ``apply_lag=0`` keeps
+        the queue empty across lock releases — every update lands before
+        the next step is admitted, which is exactly the legacy
+        application order. Flush barriers (``predict``,
+        ``export_state``/checkpointing, ``flush_deferred`` for
+        ``sync_bottoms``, ``close``) apply everything queued before
+        state is read. Default off: the fused legacy program is the only
+        thing built and the wire/loss stay bit-for-bit identical."""
         self.plan = plan
         self.cfg = cfg
         self.mode = cfg.mode
@@ -159,6 +182,22 @@ class ServerRuntime:
                 "continuous batching runs inside the coalescer — raise "
                 f"coalesce_max to >= 2 (got {coalesce_max})")
         self.batching = batching
+        self.decouple_bwd = bool(decouple_bwd)
+        self.apply_lag = int(apply_lag)
+        if self.apply_lag < 0:
+            raise ValueError(f"apply_lag must be >= 0 (got {apply_lag})")
+        if self.apply_lag > 0 and not self.decouple_bwd:
+            raise ValueError(
+                f"apply_lag={apply_lag} needs decouple_bwd=True (the "
+                "deferred-apply queue only exists on a decoupled server)")
+        if self.decouple_bwd and cfg.mode != "split":
+            raise ValueError(
+                "decouple_bwd is split-mode only (the reply/apply split "
+                "decouples the classic split step, where the server "
+                f"computes the loss); mode is {cfg.mode!r}")
+        # deferred-apply queue (2BP): built only on decoupled servers so
+        # the default path never pays for — or can even reach — it
+        self._deferred: Optional[_DeferredApply] = None
         # admission layer: built only when any knob is non-default, so
         # existing servers pay nothing (admit() is never called)
         self._admission: Optional[AdmissionController] = None
@@ -178,6 +217,9 @@ class ServerRuntime:
             self.state = make_state(all_params[self.server_stage], self._tx)
             self._agg = None
             self._build_jitted()
+            if self.decouple_bwd:
+                self._deferred = _DeferredApply(
+                    self._apply_deferred_entry, self.apply_lag, self._lock)
             if coalesce_max > 1:
                 # distinct padded group shapes compiled so far — the
                 # pow2 buckets bound this at O(log max_group_rows), and
@@ -244,6 +286,66 @@ class ServerRuntime:
 
             self._coalesced_step = jax.jit(group_step_fn,
                                            donate_argnums=(0,))
+
+            if self.decouple_bwd:
+                # 2BP reply program: forward + d(loss)/d(acts) ONLY —
+                # the weight-gradient matmuls and the optimizer apply
+                # leave the client's critical path. ``params`` is a
+                # plain (non-donated) argument: with apply_lag > 0 the
+                # same weights serve several replies before their
+                # deferred updates land, and queued entries hold them as
+                # the on-device residual snapshot.
+                def reply_fn(params, acts, labels):
+                    def fwd(acts):
+                        logits = stage.apply(params, acts)
+                        return cross_entropy(logits, labels)
+                    loss, g_acts = jax.value_and_grad(fwd)(acts)
+                    return g_acts, loss
+
+                self._reply_step = jax.jit(reply_fn)
+
+                # deferred apply: grad-of-weights recomputed from the
+                # entry's residuals (acts/labels + the params snapshot
+                # the reply used — delayed-gradient semantics: the
+                # update is exactly the gradient of the forward the
+                # client saw) + optimizer apply. No donation: at lag=0
+                # ``fwd_params`` aliases ``state.params``, and with
+                # lag > 0 other queued entries may still hold the same
+                # snapshot — donating would invalidate live buffers.
+                def deferred_apply_fn(state: TrainState, fwd_params,
+                                      acts, labels):
+                    def loss_fn(params, acts):
+                        logits = stage.apply(params, acts)
+                        return cross_entropy(logits, labels)
+                    g_params = jax.grad(loss_fn)(fwd_params, acts)
+                    return apply_grads(tx, state, g_params)
+
+                self._deferred_apply = jax.jit(deferred_apply_fn)
+
+                # coalesced-group twins of the pair above (group-mean
+                # objective, pow2-padded shapes — same bucketing as the
+                # fused group step, so compile counts stay bounded)
+                def group_reply_fn(params, acts, labels, weights):
+                    def fwd(acts):
+                        logits = stage.apply(params, acts)
+                        per_ex = per_example_cross_entropy(logits, labels)
+                        return jnp.sum(per_ex * weights), per_ex
+                    (_, per_ex), g_acts = jax.value_and_grad(
+                        fwd, has_aux=True)(acts)
+                    return g_acts, per_ex
+
+                self._group_reply_step = jax.jit(group_reply_fn)
+
+                def group_apply_fn(state: TrainState, fwd_params,
+                                   acts, labels, weights):
+                    def loss_fn(params, acts):
+                        logits = stage.apply(params, acts)
+                        per_ex = per_example_cross_entropy(logits, labels)
+                        return jnp.sum(per_ex * weights)
+                    g_params = jax.grad(loss_fn)(fwd_params, acts)
+                    return apply_grads(tx, state, g_params)
+
+                self._group_deferred_apply = jax.jit(group_apply_fn)
         else:
             # U-shaped trunk: forward produces features; backward receives
             # d(loss)/d(features) from the client head and returns
@@ -336,14 +438,41 @@ class ServerRuntime:
             with self._lock:
                 t_d0 = time.perf_counter() if tr is not None else 0.0
                 self._check_step(step, client_id)
-                with obs_dispatch.step_scope(
-                        self._dd, (self._ddtok, "split_step"),
-                        sig_fn=lambda: (activations.shape,
-                                        str(activations.dtype),
-                                        labels.shape, str(labels.dtype))):
-                    self.state, g_acts, loss = self._split_step(
-                        self.state, jnp.asarray(activations),
-                        jnp.asarray(labels))
+                if self._deferred is not None:
+                    # 2BP: dispatch the reply program on the current
+                    # (<= apply_lag steps stale) weights, queue the
+                    # weight update with its on-device residuals, and
+                    # drain only the over-lag tail. The drained applies
+                    # dispatch AFTER the reply, so the device runs the
+                    # client-visible work first; a replayed duplicate
+                    # never reaches here (the begin() claim above), so
+                    # it can never re-enqueue an apply.
+                    acts_dev = jnp.asarray(activations)
+                    labels_dev = jnp.asarray(labels)
+                    with obs_dispatch.step_scope(
+                            self._dd, (self._ddtok, "reply_grad"),
+                            sig_fn=lambda: (activations.shape,
+                                            str(activations.dtype),
+                                            labels.shape,
+                                            str(labels.dtype))):
+                        g_acts, loss = self._reply_step(
+                            self.state.params, acts_dev, labels_dev)
+                    self._deferred.push({
+                        "kind": "single", "step": step,
+                        "client_id": client_id,
+                        "fwd_params": self.state.params,
+                        "acts": acts_dev, "labels": labels_dev})
+                    self._deferred.drain_over_lag()
+                else:
+                    with obs_dispatch.step_scope(
+                            self._dd, (self._ddtok, "split_step"),
+                            sig_fn=lambda: (activations.shape,
+                                            str(activations.dtype),
+                                            labels.shape,
+                                            str(labels.dtype))):
+                        self.state, g_acts, loss = self._split_step(
+                            self.state, jnp.asarray(activations),
+                            jnp.asarray(labels))
                 if not self.overlap:
                     # legacy placement: the transfer rides inside the
                     # lock (and inside the dispatch span — the old span
@@ -367,6 +496,15 @@ class ServerRuntime:
                 self._sleep_d2h()
                 with obs_dispatch.expected_d2h(self._dd):
                     g_host, loss_f = np.asarray(g_acts), float(loss)
+            if tr is not None and self._deferred is not None:
+                # the client-visible reply window: reply dispatch ->
+                # cut-layer gradient on host (what the 2BP bench leg
+                # compares against the coupled dispatch+d2h)
+                rw = time.perf_counter() - t_d0
+                tr.record(spans.REPLY_GRAD, t_d0, rw,
+                          trace_id=obs_trace.CTX.trace_id,
+                          party="server", tid=client_id, step=step)
+                self._metrics.observe(spans.REPLY_GRAD, rw)
             res = (g_host, loss_f)
             if entry is not None:
                 self.replay.resolve(entry, res)
@@ -425,6 +563,64 @@ class ServerRuntime:
             srv_spans[spans.D2H] = hw
         self._metrics.incr("split_steps_total")
         obs_trace.CTX.server_spans = srv_spans
+
+    def _apply_deferred_entry(self, entry: Dict[str, Any]) -> None:
+        """Dispatch one queued weight update (called by _DeferredApply's
+        drain, under the runtime lock). Async dispatch only — nothing is
+        materialized here, so draining inside a lock-held window is
+        legal (SLT001) and cheap: the jitted call returns device futures
+        and the lock is released long before they resolve."""
+        tr = obs_trace.get_tracer()
+        t0 = time.perf_counter() if tr is not None else 0.0
+        if entry["kind"] == "group":
+            # freshness captured at reply time holds here too: entries
+            # drain FIFO, so the first apply of a padded signature is
+            # exactly the apply of the first reply that saw it
+            with obs_dispatch.step_scope(
+                    self._dd, (self._ddtok, "group_deferred_apply"),
+                    fresh=entry["fresh"]):
+                self.state = self._group_deferred_apply(
+                    self.state, entry["fwd_params"], entry["acts"],
+                    entry["labels"], entry["weights"])
+        else:
+            acts, labels = entry["acts"], entry["labels"]
+            with obs_dispatch.step_scope(
+                    self._dd, (self._ddtok, "deferred_apply"),
+                    sig_fn=lambda: (acts.shape, str(acts.dtype),
+                                    labels.shape, str(labels.dtype))):
+                self.state = self._deferred_apply(
+                    self.state, entry["fwd_params"], acts, labels)
+        if tr is not None:
+            dw = time.perf_counter() - t0
+            tr.record(spans.DEFERRED_APPLY, t0, dw,
+                      trace_id=obs_trace.CTX.trace_id, party="server",
+                      tid=entry["client_id"], step=entry["step"])
+            self._metrics.observe(spans.DEFERRED_APPLY, dw)
+
+    def flush_deferred(self) -> int:
+        """Flush barrier: apply every queued deferred update now, in
+        step order, and return how many were applied. No-op (0) on a
+        coupled server. Callers are anything about to READ the server
+        state as if training were caught up: ``predict``,
+        ``export_state`` (checkpoints), ``MultiClientSplitRunner.
+        sync_bottoms``, ``close``. Safe from any thread, and re-entrant
+        from under the runtime lock (the lock is reentrant and the
+        drain only dispatches — no D2H)."""
+        if self._deferred is None:
+            return 0
+        return self._deferred.flush()
+
+    def export_state(self) -> TrainState:
+        """The one sanctioned way to read ``state`` for checkpointing or
+        any other export: flushes the deferred-apply queue first (with
+        --decouple-bwd the live state may be up to apply_lag updates
+        behind the replies already delivered), then returns the
+        caught-up TrainState. On a coupled server this is exactly
+        ``self.state``."""
+        with self._lock:
+            if self._deferred is not None:
+                self._deferred.flush()
+            return self.state
 
     def _dispatch_group(self, group: "list[CoalesceRequest]",
                         reason: str) -> None:
@@ -486,11 +682,34 @@ class ServerRuntime:
             # the coalescer already tracks padded-shape signatures (the
             # compile_count counter above) — hand its freshness verdict
             # to the watchdog instead of double-tracking
-            with obs_dispatch.step_scope(
-                    self._dd, (self._ddtok, "coalesced_step"), fresh=fresh):
-                self.state, g_acts, per_ex = self._coalesced_step(
-                    self.state, jnp.asarray(acts), jnp.asarray(labels),
-                    jnp.asarray(weights))
+            deferred_entry = None
+            if self._deferred is not None:
+                # 2BP group dispatch: reply program first (on the
+                # current weights), the group's single weight update
+                # queued and drained only after every member below holds
+                # its reply — replies before apply, by construction
+                acts_dev = jnp.asarray(acts)
+                labels_dev = jnp.asarray(labels)
+                w_dev = jnp.asarray(weights)
+                with obs_dispatch.step_scope(
+                        self._dd, (self._ddtok, "group_reply"),
+                        fresh=fresh):
+                    g_acts, per_ex = self._group_reply_step(
+                        self.state.params, acts_dev, labels_dev, w_dev)
+                deferred_entry = {
+                    "kind": "group",
+                    "step": max(r.step for r in admitted),
+                    "client_id": -1,
+                    "fwd_params": self.state.params,
+                    "acts": acts_dev, "labels": labels_dev,
+                    "weights": w_dev, "fresh": fresh}
+            else:
+                with obs_dispatch.step_scope(
+                        self._dd, (self._ddtok, "coalesced_step"),
+                        fresh=fresh):
+                    self.state, g_acts, per_ex = self._coalesced_step(
+                        self.state, jnp.asarray(acts), jnp.asarray(labels),
+                        jnp.asarray(weights))
             if not self.overlap:
                 # legacy placement: the whole group's transfer inside
                 # the lock (dispatch span = jit + materialization)
@@ -537,6 +756,13 @@ class ServerRuntime:
                     self._metrics.observe(spans.DISPATCH, dw)
                     self._metrics.incr("split_steps_total")
                 r.done.set()
+            if deferred_entry is not None:
+                # every member above already holds its result (or D2H
+                # thunk) and its done event is set; only now does the
+                # group's weight update enter the queue, and only the
+                # over-lag tail dispatches behind the replies
+                self._deferred.push(deferred_entry)
+                self._deferred.drain_over_lag()
             if tr is not None:
                 self._metrics.observe(
                     spans.LOCK_HOLD, time.perf_counter() - t_lk0)
@@ -552,6 +778,12 @@ class ServerRuntime:
                 "predict called in mode 'federated' (the client holds "
                 "the full model; evaluate locally)", status=400)
         with self._lock:
+            if self._deferred is not None:
+                # flush barrier: inference must see every update whose
+                # reply has already been delivered, or a predict racing
+                # a lagged trainer reads weights the loss series has
+                # already moved past
+                self._deferred.flush()
             params = self.state.params
         with obs_dispatch.step_scope(
                 self._dd, (self._ddtok, "predict"),
@@ -700,6 +932,13 @@ class ServerRuntime:
         next client step must be ``step`` or later (checkpoint/resume
         protocol — SURVEY.md §5)."""
         with self._lock:
+            if self._deferred is not None:
+                # DROP (not flush) pending applies: they are gradients
+                # of the pre-restore lineage — applying them to the
+                # restored state would graft stale updates onto a
+                # checkpoint that, via export_state, was already flushed
+                # when it was taken
+                self._deferred.clear()
             self.state = state
             self._last_step = {}
             self._step_floor = step - 1  # applies to every client_id
@@ -760,6 +999,10 @@ class ServerRuntime:
                 **self._admission.config(),
                 **self._admission.counters(),
                 **self._admission.gauges()}
+        if self._deferred is not None:
+            info["decoupled_bwd"] = {
+                "apply_lag": self.apply_lag,
+                **self._deferred.counters()}
         return info
 
     def metrics(self) -> Dict[str, Any]:
@@ -785,6 +1028,12 @@ class ServerRuntime:
             snap["gauges"]["replay_cache_size"] = float(
                 rc.pop("replay_cache_size"))
             for k, v in rc.items():
+                snap["counters"][f"{k}_total"] = float(v)
+        if self._deferred is not None:
+            dc = self._deferred.counters()
+            snap["gauges"]["deferred_apply_depth"] = float(
+                dc.pop("deferred_apply_depth"))
+            for k, v in dc.items():
                 snap["counters"][f"{k}_total"] = float(v)
         if self._dd is not None:
             # watchdog gauges fold in at scrape time (the replay-counter
@@ -816,9 +1065,97 @@ class ServerRuntime:
             self.replay.attach_body(client_id, op, step, body)
 
     def close(self) -> None:
-        """Flush and join the coalescer (no-op on serialized servers)."""
+        """Flush and join the coalescer (no-op on serialized servers),
+        then drain the deferred-apply queue — in that order, because the
+        coalescer's final groups enqueue applies of their own. Drained,
+        not dropped: the replies for these steps already went out, so a
+        clean shutdown must land their updates (the mid-run close()
+        drain SLT108 pins)."""
         if self._coalescer is not None:
             self._coalescer.close()
+        if self._deferred is not None:
+            self._deferred.flush()
+
+
+class _DeferredApply:
+    """Step-ordered queue of pending server weight updates (2BP).
+
+    The reply path pushes one entry per dispatch (a single step or a
+    whole coalesced group) in lock order — which IS step-application
+    order — and entries drain strictly FIFO, each through ``apply_fn``
+    (the runtime's jitted deferred-apply dispatch). Every method takes
+    the OWNING RUNTIME'S lock (reentrant), so: on the step path, where
+    the lock is already held, re-entry is free and push/drain are
+    atomic with the dispatch that produced them; from barrier callers
+    (predict, export_state, sync_bottoms, close) on other threads,
+    ``flush`` serializes against in-flight steps. Exactly-once by
+    construction — an entry leaves the deque exactly when it is
+    applied — and the slt-check scenario ``deferred_apply_storm``
+    explores exactly this object's interleavings (invariant SLT108).
+
+    ``lag`` is the staleness bound: ``drain_over_lag`` (called after
+    every reply dispatch, still under the lock) applies the oldest
+    entries until depth <= lag, so a forward at step t can run on
+    weights at most ``lag`` updates old."""
+
+    def __init__(self, apply_fn: Any, lag: int, lock: Any) -> None:
+        self._apply = apply_fn
+        self.lag = int(lag)
+        self._lock = lock
+        self._q: "deque[Dict[str, Any]]" = deque()
+        self._enqueued = 0
+        self._applied = 0
+        self._flushes = 0
+
+    def push(self, entry: Dict[str, Any]) -> None:
+        with self._lock:
+            self._q.append(entry)
+            self._enqueued += 1
+
+    def drain_over_lag(self) -> int:
+        """Apply oldest entries until depth <= lag (the staleness
+        invariant); 0 applied when the queue is within bounds."""
+        return self._drain(limit_to_lag=True)
+
+    def flush(self) -> int:
+        """Apply everything queued (the state-export barrier)."""
+        return self._drain(limit_to_lag=False)
+
+    def _drain(self, limit_to_lag: bool) -> int:
+        n = 0
+        with self._lock:
+            floor = self.lag if limit_to_lag else 0
+            while len(self._q) > floor:
+                # pop BEFORE apply: if the apply dispatch raises, the
+                # entry must not be retried (its reply already shipped;
+                # a second apply would double-count the step)
+                entry = self._q.popleft()
+                self._apply(entry)
+                self._applied += 1
+                n += 1
+            if n:
+                self._flushes += 1
+        return n
+
+    def clear(self) -> int:
+        """Drop everything queued WITHOUT applying (resume_from only:
+        pre-restore-lineage gradients are meaningless against the
+        restored state). Returns how many were dropped."""
+        with self._lock:
+            n = len(self._q)
+            self._q.clear()
+            return n
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return {"deferred_apply_depth": len(self._q),
+                    "deferred_enqueued": self._enqueued,
+                    "deferred_applied": self._applied,
+                    "deferred_flushes": self._flushes}
 
 
 class _GroupD2H:

@@ -732,6 +732,80 @@ def test_slt011_waiver_file(tmp_path):
 
 
 # ---------------------------------------------------------------------- #
+# SLT012: state.params reads on a deferred-apply runtime need the lock
+# ---------------------------------------------------------------------- #
+
+def test_slt012_unlocked_params_read_flagged(tmp_path):
+    findings = _lint(tmp_path, "runtime/server.py", """
+        class ServerRuntime:
+            def __init__(self):
+                self._deferred = object()
+            def peek(self):
+                return self.state.params          # unlocked: flagged
+            def hook(self):
+                def cb():
+                    return self.state.params      # nested def: flagged
+                return cb
+    """)
+    assert _rules(findings) == ["SLT012", "SLT012"]
+    msgs = " ".join(f.message for f in findings)
+    assert "apply lock" in msgs and "export_state" in msgs
+
+
+def test_slt012_locked_and_barrier_reads_are_clean(tmp_path):
+    findings = _lint(tmp_path, "runtime/server.py", """
+        class ServerRuntime:
+            def __init__(self):
+                self._deferred = object()
+            def locked(self):
+                with self._lock:
+                    return self.state.params
+            def export_state(self):
+                self._deferred.flush()
+                return self.state.params          # the flush barrier
+            def flush_deferred(self):
+                return self.state.params
+    """)
+    assert findings == []
+
+
+def test_slt012_scoped_to_deferred_owning_classes(tmp_path):
+    # a runtime class WITHOUT a deferred queue has no stale-params
+    # hazard — its unlocked reads stay legal (the client trainer shape)
+    findings = _lint(tmp_path, "runtime/client.py", """
+        class SplitClientTrainer:
+            def loss_params(self):
+                return self.state.params
+    """)
+    assert findings == []
+    # ...and files outside runtime/ are out of scope entirely
+    findings = _lint(tmp_path, "launch/run.py", """
+        class Driver:
+            def __init__(self):
+                self._deferred = object()
+            def peek(self):
+                return self.state.params
+    """)
+    assert findings == []
+
+
+def test_slt012_waiver_file(tmp_path):
+    bad = tmp_path / "runtime" / "server.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(textwrap.dedent("""
+        class ServerRuntime:
+            def __init__(self):
+                self._deferred = object()
+            def peek(self):
+                return self.state.params
+    """))
+    wf = tmp_path / "waivers"
+    wf.write_text("SLT012 runtime/server.py read-only debug probe\n")
+    assert engine.main([str(tmp_path), "--waiver-file", str(wf)]) == 0
+    assert engine.main([str(tmp_path)]) == 1
+
+
+# ---------------------------------------------------------------------- #
 # engine: exit codes, waiver file, real tree
 # ---------------------------------------------------------------------- #
 
@@ -782,10 +856,10 @@ def test_list_rules(capsys):
     out = capsys.readouterr().out
     for rule in ("SLT001", "SLT002", "SLT003", "SLT004", "SLT005",
                  "SLT006", "SLT007", "SLT008", "SLT009", "SLT010",
-                 "SLT011",
+                 "SLT011", "SLT012",
                  # slt-check dynamic-invariant pseudo-rules
                  "SLT100", "SLT101", "SLT102", "SLT103", "SLT104",
-                 "SLT105", "SLT106", "SLT107"):
+                 "SLT105", "SLT106", "SLT107", "SLT108"):
         assert rule in out
 
 
@@ -825,6 +899,8 @@ def test_trace_report_fallback_matches_registry():
     assert fallback["CLIENT_PHASES"] == spans.CLIENT_PHASES
     assert fallback["TRANSPORT_SUB"] == spans.TRANSPORT_SUB
     assert fallback["COMPILE"] == spans.COMPILE
+    assert fallback["REPLY_GRAD"] == spans.REPLY_GRAD
+    assert fallback["DEFERRED_APPLY"] == spans.DEFERRED_APPLY
 
 
 def test_analysis_package_is_stdlib_only():
