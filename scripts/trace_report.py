@@ -44,14 +44,15 @@ from typing import Any, Dict, List
 # contract.
 try:
     from split_learning_tpu.obs.spans import (CLIENT_PHASES, COMPILE,
-                                              DEFERRED_APPLY, REPLY_GRAD,
-                                              TRANSPORT_SUB)
+                                              DEFERRED_APPLY, MESH_META,
+                                              REPLY_GRAD, TRANSPORT_SUB)
 except ImportError:
     CLIENT_PHASES = ("client_fwd", "transport", "client_bwd", "opt_apply")
     TRANSPORT_SUB = ("encode", "wire", "queue_wait", "dispatch", "d2h")
     COMPILE = "xla_compile"
     REPLY_GRAD = "reply_grad"
     DEFERRED_APPLY = "deferred_apply"
+    MESH_META = "mesh_meta"
 
 
 def load_events(path: str) -> List[Dict[str, Any]]:
@@ -212,6 +213,19 @@ def summarize(events: List[Dict[str, Any]],
                                 if step_equiv > 0 else 0.0),
         }
 
+    # mesh/MFU sidecar (PR 11, sharded server): export_chrome(metadata=
+    # ServerRuntime.trace_metadata()) rides as one ph:"M" event named
+    # MESH_META. Absent on unsharded/old traces -> section not rendered
+    # (the decoupled_bwd conditional-section contract). Tolerant: a
+    # malformed args payload (not a dict) is treated as absent.
+    mesh_meta = None
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == MESH_META:
+            args_d = e.get("args")
+            if isinstance(args_d, dict):
+                mesh_meta = args_d
+            break
+
     rep = {
         "events": len(events),
         "spans": len(spans),
@@ -222,6 +236,7 @@ def summarize(events: List[Dict[str, Any]],
         "transport_decomposition_s": tsub,
         "compile": compile_summary,
         "decoupled_bwd": decoupled,
+        "mesh": mesh_meta,
         "span_sum_over_wall_clock": coverage,
     }
     if tenants > 0:
@@ -275,6 +290,34 @@ def render(rep: Dict[str, Any]) -> str:
             f"  coupled-equivalent step p50: "
             f"{dec['step_equivalent_p50_ms']:.3f}ms  "
             f"-> reply/step ratio: {dec['reply_over_step']:.2f}")
+    mesh = rep.get("mesh")
+    if mesh:
+        lines.append("")
+        info = mesh.get("mesh") or {}
+        shape = ", ".join(f"{k}={v}" for k, v in info.items())
+        lines.append(f"sharded server (pjit) — mesh: {shape or '?'}")
+        gb = mesh.get("gather_bytes")
+        if gb is not None:
+            lines.append(f"  sharded-gather D2H bytes: {int(gb)}")
+        peak = mesh.get("peak_flops_per_device")
+        lines.append(
+            "  peak flops/device: " +
+            (f"{peak:.3e}" if peak
+             else "unknown (CPU backend) — MFU not computable"))
+        progs = mesh.get("programs") or {}
+        if progs:
+            lines.append(f"  {'program':<16} {'calls':>6} {'gflops':>9} "
+                         f"{'disp_s':>8} {'gflop/s':>9} {'mfu':>7}")
+            for name, row in sorted(progs.items()):
+                rate = row.get("model_flops_per_sec")
+                m = row.get("mfu")
+                rate_col = f"{rate / 1e9:>9.3f}" if rate else f"{'-':>9}"
+                mfu_col = f"{m:>7.1%}" if m is not None else f"{'-':>7}"
+                lines.append(
+                    f"  {name:<16} {int(row.get('calls', 0)):>6d} "
+                    f"{float(row.get('model_flops', 0.0)) / 1e9:>9.3f} "
+                    f"{float(row.get('dispatch_s', 0.0)):>8.4f} "
+                    f"{rate_col} {mfu_col}")
     tqw = rep.get("tenant_queue_wait")
     if tqw:
         lines.append("")

@@ -22,6 +22,11 @@ SLT012    on a deferred-apply runtime (``--decouple-bwd``, PR 10) every
           ``self.state.params`` read holds the apply lock or goes
           through the flush barrier — an unlocked read can observe
           params up to ``apply_lag`` updates stale
+SLT013    on a mesh-aware runtime (``--mesh-data/-model``, PR 11) the
+          program-output D2H sites (``expected_d2h`` blocks) use the
+          sanctioned per-shard gather — a raw ``np.asarray``/
+          ``jax.device_get`` drags every shard (padding included)
+          to host on the hot path
 ========  ==============================================================
 
 Rules are deliberately project-shaped: scopes are path suffixes inside
@@ -747,6 +752,102 @@ def check_slt012(src: Src) -> Iterator[Finding]:
 
 
 # ---------------------------------------------------------------------- #
+# SLT013: mesh-sharded program outputs cross D2H through the sanctioned
+# gather helper, never a raw np.asarray / jax.device_get
+# ---------------------------------------------------------------------- #
+
+def _mentions_mesh(cls: ast.ClassDef) -> bool:
+    """Does this class run on a (possibly) mesh-sharded runtime? Keyed
+    on the attributes the sharded server actually grows (``self._mesh``,
+    or a ``_host_gather`` routing method/call) — single-device classes
+    (the client half, the fused trainer) have no sharded outputs and
+    stay out of scope."""
+    return any(isinstance(n, ast.Attribute)
+               and n.attr in ("_mesh", "_host_gather")
+               for n in ast.walk(cls))
+
+
+def _is_expected_d2h_cm(expr: ast.expr) -> bool:
+    """``obs_dispatch.expected_d2h(...)``-shaped context expr — the
+    watchdog marker that brackets exactly the program-output D2H sites."""
+    return (isinstance(expr, ast.Call)
+            and isinstance(expr.func, ast.Attribute)
+            and expr.func.attr == "expected_d2h")
+
+
+def _slt013_raw_gather(node: ast.Call) -> Optional[str]:
+    """The offending call's rendering, or None."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        root = _call_root(f)
+        if root == "np" and f.attr in ("asarray", "array"):
+            return f"np.{f.attr}(...)"
+        if root == "jax" and f.attr == "device_get":
+            return "jax.device_get(...)"
+    return None
+
+
+class _Slt013Visitor(ast.NodeVisitor):
+    """Within a mesh-aware runtime class: flag raw full-value transfers
+    inside ``expected_d2h`` blocks. On a sharded server those values are
+    mesh-sharded program outputs, and ``np.asarray`` on one gathers EVERY
+    replica/shard — including a padded group's zero-weight tail — onto
+    the host on the hot path. The sanctioned seam
+    (``self._host_gather`` -> ``parallel.mesh.host_gather``) copies per
+    addressable shard, only the rows the caller needs."""
+
+    def __init__(self, src: Src) -> None:
+        self.src = src
+        self.findings: List[Finding] = []
+        self._d2h_depth = 0
+
+    def _visit_with(self, node: Any) -> None:
+        marked = sum(1 for i in node.items
+                     if _is_expected_d2h_cm(i.context_expr))
+        self._d2h_depth += marked
+        self.generic_visit(node)
+        self._d2h_depth -= marked
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+    def _visit_def(self, node: Any) -> None:
+        # nested defs execute later, outside this with-block (the SLT001
+        # scoping argument)
+        depth, self._d2h_depth = self._d2h_depth, 0
+        self.generic_visit(node)
+        self._d2h_depth = depth
+
+    visit_FunctionDef = _visit_def
+    visit_AsyncFunctionDef = _visit_def
+    visit_Lambda = _visit_def
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._d2h_depth:
+            what = _slt013_raw_gather(node)
+            if what is not None:
+                self.findings.append(Finding(
+                    "SLT013", self.src.path, node.lineno,
+                    f"{what} on a mesh-sharded program output — a raw "
+                    "transfer gathers every shard (padding included) to "
+                    "host on the hot path; route it through the "
+                    "sanctioned per-shard gather "
+                    "(self._host_gather / parallel.mesh.host_gather)"))
+        self.generic_visit(node)
+
+
+def check_slt013(src: Src) -> Iterator[Finding]:
+    if not _in_dir(src, "runtime"):
+        return
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ClassDef) and _mentions_mesh(node):
+            v = _Slt013Visitor(src)
+            for item in node.body:
+                v.visit(item)
+            yield from v.findings
+
+
+# ---------------------------------------------------------------------- #
 
 RULES = {
     "SLT001": (check_slt001,
@@ -767,6 +868,10 @@ RULES = {
     "SLT012": (check_slt012,
                "self.state.params reads on a deferred-apply runtime "
                "hold the apply lock or go through the flush barrier"),
+    "SLT013": (check_slt013,
+               "mesh-sharded program outputs cross D2H through the "
+               "sanctioned per-shard gather, never raw "
+               "np.asarray/jax.device_get"),
 }
 
 

@@ -255,6 +255,24 @@ def _assemble_full_params(layout: str, raw: Dict[str, Any]):
         "or fused to checkpoint the joint state)")
 
 
+def _server_mesh(args):
+    """Build the sharded-server mesh from ``--mesh-data``/``--mesh-model``
+    (train in-process server + serve). 1x1 — the default — returns None:
+    the ServerRuntime keeps the legacy single-device programs byte-for-
+    byte. Raises ValueError (the CLI config-error type both callers
+    already map to exit 2) when the backend has too few devices, with
+    the host-platform remedy in the message."""
+    data = int(getattr(args, "mesh_data", 1) or 1)
+    model = int(getattr(args, "mesh_model", 1) or 1)
+    if data * model <= 1:
+        return None
+    from split_learning_tpu.parallel.mesh import make_host_mesh
+    try:
+        return make_host_mesh(data=data, model=model)
+    except RuntimeError as e:
+        raise ValueError(str(e)) from e
+
+
 def cmd_train(args) -> int:
     # must run before any JAX backend initializes (DCN multi-host, no-op
     # for single-process runs)
@@ -653,7 +671,8 @@ def cmd_train(args) -> int:
                                    decouple_bwd=getattr(
                                        args, "decouple_bwd", False),
                                    apply_lag=getattr(
-                                       args, "apply_lag", 0) or 0)
+                                       args, "apply_lag", 0) or 0,
+                                   mesh=_server_mesh(args))
             # --compress plumbs here too (wire emulation through the real
             # codec) so compressed-path runs don't need sockets; None
             # keeps the legacy direct path bit-for-bit
@@ -827,7 +846,9 @@ def cmd_train(args) -> int:
               "(view in TensorBoard/Perfetto)", file=sys.stderr)
     if step_tracer is not None:
         obs.disable()
-        out_path = step_tracer.export_chrome(trace_path)
+        out_path = step_tracer.export_chrome(
+            trace_path,
+            metadata=server.trace_metadata() if server is not None else None)
         print(f"[trace] {len(step_tracer.spans())} spans -> {out_path} "
               "(Perfetto-loadable; summarize with scripts/trace_report.py)",
               file=sys.stderr)
@@ -917,7 +938,8 @@ def cmd_serve(args) -> int:
                                 quota=args.quota,
                                 slo_ms=args.slo_ms,
                                 decouple_bwd=args.decouple_bwd,
-                                apply_lag=args.apply_lag)
+                                apply_lag=args.apply_lag,
+                                mesh=_server_mesh(args))
     except ValueError as e:  # e.g. --coalesce-max outside split mode
         print(f"[error] {e}", file=sys.stderr)
         return 2
@@ -1082,7 +1104,8 @@ def cmd_serve(args) -> int:
         if step_tracer is not None:
             from split_learning_tpu import obs
             obs.disable()
-            step_tracer.export_chrome(trace_path)
+            step_tracer.export_chrome(trace_path,
+                                      metadata=runtime.trace_metadata())
             print(f"[trace] Chrome trace written to {trace_path}",
                   file=sys.stderr)
         if ckptr is not None:
@@ -1337,6 +1360,15 @@ def main(argv: Optional[list] = None) -> int:
                     help="context-parallel shards (mesh 'seq' axis; fused "
                          "transport, transformer family — ring/Ulysses "
                          "attention over ICI)")
+    pt.add_argument("--mesh-data", dest="mesh_data", type=int, default=1,
+                    help="sharded in-process server (local transport): "
+                         "'data' axis size — batch dims and coalesced "
+                         "groups shard across it. 1 = legacy single-"
+                         "device server, bit-for-bit")
+    pt.add_argument("--mesh-model", dest="mesh_model", type=int, default=1,
+                    help="sharded in-process server: 'model' axis size — "
+                         "heavy weight matrices shard across it "
+                         "(parallel/distributed.py SpecLayout rule)")
     pt.add_argument("--attn",
                     choices=["full", "flash", "auto", "ring", "ring_flash",
                              "ulysses"],
@@ -1500,6 +1532,17 @@ def main(argv: Optional[list] = None) -> int:
                          "updates old; 0 (default) applies each update "
                          "before the next step is admitted (the legacy "
                          "trajectory, bit-for-bit)")
+    ps.add_argument("--mesh-data", dest="mesh_data", type=int, default=1,
+                    help="sharded server (pjit): 'data' axis size — "
+                         "batch dims shard across it and coalesced "
+                         "groups round to a multiple of it (zero-weight "
+                         "padding). 1 = legacy single-device server, "
+                         "bit-for-bit (README 'Sharded server (pjit)')")
+    ps.add_argument("--mesh-model", dest="mesh_model", type=int, default=1,
+                    help="sharded server (pjit): 'model' axis size — "
+                         "heavy weight matrices (and their optimizer "
+                         "mirrors) shard across it via the SpecLayout "
+                         "column-then-row rule")
     ps.add_argument("--compress", choices=["none", "int8", "topk8"],
                     default=None,
                     help="default wire compression for replies to clients "

@@ -54,6 +54,15 @@ def _decode_attention(q, ck, cv, pos, scale):
                       cv.astype(jnp.float32)).astype(cv.dtype)
 
 
+# The heavy matmul weights of the transformer server half — the leaves the
+# SpecLayout column/row rule shards along the mesh ``model`` axis whenever
+# d_model (or vocab/num_classes for the heads) divides the axis size.
+# Contract pinned by tests/test_sharded_server.py: if a rename here drops a
+# leaf out of the sharded set, the layout test fails rather than silently
+# replicating the biggest matrices.
+TP_HEAVY_PARAMS = ("q", "k", "v", "out", "up", "down", "fc", "lm_head")
+
+
 class MultiHeadAttention(nn.Module):
     """Projections + attention; the attention math itself is selectable
     between dense and the two sequence-parallel forms.

@@ -33,7 +33,13 @@ import jax.numpy as jnp
 
 from split_learning_tpu.core.stage import SplitPlan, from_flax
 from split_learning_tpu.models.transformer import (
-    _ATTN_IMPLS, Block, HeadStage, TrunkAndHead, TrunkStage)
+    _ATTN_IMPLS, TP_HEAVY_PARAMS as _TRANSFORMER_TP, Block, HeadStage,
+    TrunkAndHead, TrunkStage)
+
+# ViT server halves reuse the transformer trunk/head kernels; the patch
+# stem's conv kernel [ph, pw, C, d_model] is heavy too and shards its
+# output-feature dim under the same SpecLayout rule.
+TP_HEAVY_PARAMS = _TRANSFORMER_TP + ("patch",)
 
 
 class PatchEmbedStage(nn.Module):

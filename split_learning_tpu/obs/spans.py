@@ -60,6 +60,18 @@ REPLY_GRAD = "reply_grad"
 # flush happens inside the same lock-held window.
 DEFERRED_APPLY = "deferred_apply"
 
+# -- sharded server / pjit (runtime/server.py, PR 11) ------------------ #
+# metrics-only counter (the admission_* precedent — never a trace span):
+# cumulative bytes moved D2H by the sanctioned sharded-gather helper
+# (ServerRuntime._host_gather -> parallel.mesh.host_gather, slt-lint
+# SLT013). Incremented only on mesh-sharded servers.
+GATHER_BYTES = "gather_bytes"
+# chrome-trace metadata event name (ph:"M", not a span): the mesh shape
+# + per-program MFU sidecar Tracer.export_chrome(metadata=...) emits and
+# trace_report.py's MFU/mesh section reads. NOT in the phase tuples —
+# metadata events have no duration to tile a timeline with.
+MESH_META = "mesh_meta"
+
 # XLA compile events surfaced by obs/dispatch_debug.py under
 # SLT_DISPATCH_DEBUG=1 — a recompile storm shows up on the timeline and
 # in trace_report.py's compile summary; deliberately NOT in SERVER_PHASES

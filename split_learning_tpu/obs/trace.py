@@ -138,14 +138,24 @@ class Tracer:
         return totals.get(name, 0.0) / denom if denom > 0 else 0.0
 
     # -------------------------------------------------------------- #
-    def chrome_events(self) -> List[Dict[str, Any]]:
+    def chrome_events(self, metadata: Optional[Dict[str, Any]] = None
+                      ) -> List[Dict[str, Any]]:
         """Chrome trace event objects (``ph: "X"`` complete events, µs
-        timestamps relative to tracer start, one pid per party)."""
+        timestamps relative to tracer start, one pid per party).
+
+        ``metadata`` (e.g. ``ServerRuntime.trace_metadata()`` — mesh
+        shape + per-program MFU) is emitted as one extra ``ph: "M"``
+        event named ``spans.MESH_META`` so viewers ignore it and
+        ``scripts/trace_report.py`` can pick it up without a schema
+        change to the span lines."""
         events: List[Dict[str, Any]] = [
             {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
              "args": {"name": f"slt-{party}"}}
             for party, pid in sorted(PARTY_PIDS.items())
         ]
+        if metadata is not None:
+            events.append({"name": spans.MESH_META, "ph": "M",
+                           "pid": 0, "tid": 0, "args": metadata})
         for sp in self.spans():
             events.append({
                 "name": sp["name"], "cat": sp["party"], "ph": "X",
@@ -156,13 +166,15 @@ class Tracer:
             })
         return events
 
-    def export_chrome(self, path: str) -> str:
+    def export_chrome(self, path: str,
+                      metadata: Optional[Dict[str, Any]] = None) -> str:
         """Write the Chrome-trace JSON array, one event per line (valid
         JSON and line-parseable; Perfetto/chrome://tracing load it
-        directly). Returns ``path``."""
+        directly). ``metadata`` rides as a ``ph:"M"`` event (see
+        :meth:`chrome_events`). Returns ``path``."""
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
-        events = self.chrome_events()
+        events = self.chrome_events(metadata=metadata)
         with open(path, "w") as f:
             f.write("[\n")
             for i, ev in enumerate(events):

@@ -35,12 +35,15 @@ each process; each host materializes only its addressable shard.
 
 from __future__ import annotations
 
+import dataclasses
 import os
-from typing import Callable, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from split_learning_tpu.parallel.mesh import DATA_AXIS, PIPE_AXIS, make_mesh
+from split_learning_tpu.parallel.mesh import (
+    DATA_AXIS, MODEL_AXIS, PIPE_AXIS, batch_sharding, make_mesh, replicated,
+    tp_leaf_sharding)
 
 _ENV_COORDINATOR = "SLT_COORDINATOR"      # host:port of process 0
 _ENV_NUM_PROCESSES = "SLT_NUM_PROCESSES"
@@ -172,6 +175,58 @@ def global_mesh(num_clients: int = 1, num_stages: int = 1,
             f"--num-clients must be {len(rows)} (got {num_clients})")
     grid = np.asarray(rows, dtype=object)
     return Mesh(grid, (DATA_AXIS, PIPE_AXIS))
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecLayout:
+    """Sharding rule table for one party's jitted programs on a named mesh
+    (the SNIPPETS.md SpecLayout pattern): batch dims ride ``data``, weight
+    matrices follow the column-then-row ``model`` rule
+    (``parallel.mesh.tp_leaf_sharding``), scalars and odd shapes replicate.
+
+    One layout object per runtime; ``ServerRuntime`` builds its
+    ``in_shardings``/``out_shardings`` for all six server programs from
+    this table, so the placement policy lives in exactly one place.
+    """
+
+    mesh: Any
+
+    @property
+    def data(self) -> int:
+        return int(self.mesh.shape.get(DATA_AXIS, 1))
+
+    @property
+    def model(self) -> int:
+        return int(self.mesh.shape.get(MODEL_AXIS, 1))
+
+    def batch(self):
+        return batch_sharding(self.mesh)
+
+    def replicated(self):
+        return replicated(self.mesh)
+
+    def param(self, leaf: Any):
+        return tp_leaf_sharding(self.mesh, leaf)
+
+    def state(self, state: Any) -> Any:
+        """Sharding pytree for a ``TrainState`` (params, opt_state, step):
+        every leaf through the param rule — optimizer traces mirror weight
+        shapes so they shard with their weights, step counters replicate."""
+        import jax
+        return jax.tree_util.tree_map(self.param, state)
+
+    def describe(self, params: Any) -> Dict[str, str]:
+        """leaf path -> partition spec, for layout introspection/tests."""
+        import jax
+        leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+        return {jax.tree_util.keystr(path): str(self.param(leaf).spec)
+                for path, leaf in leaves}
+
+
+def server_state_layout(mesh) -> SpecLayout:
+    """The server half's layout table (today the one policy; the K-stage
+    pipeline item will hand each stage its own)."""
+    return SpecLayout(mesh=mesh)
 
 
 def process_count() -> int:

@@ -16,6 +16,7 @@ Axes:
 
 from __future__ import annotations
 
+import os
 from typing import Any, Optional, Sequence
 
 import jax
@@ -26,6 +27,10 @@ DATA_AXIS = "data"
 PIPE_AXIS = "pipe"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
+
+# Env knob consumed by ensure_host_device_count(): how many virtual CPU
+# devices to force when building host-platform test meshes.
+HOST_DEVICES_ENV = "SLT_HOST_DEVICES"
 
 
 def make_mesh(num_clients: int = 1, num_stages: int = 1,
@@ -75,22 +80,27 @@ def tp_param_sharding(mesh: Mesh, params: Any) -> Any:
     This is the whole TP implementation — XLA partitions the ops and
     chooses the collectives from these specs alone.
     """
+    return jax.tree_util.tree_map(
+        lambda leaf: tp_leaf_sharding(mesh, leaf), params)
+
+
+def tp_leaf_sharding(mesh: Mesh, leaf: Any) -> NamedSharding:
+    """The per-leaf rule behind :func:`tp_param_sharding`, exposed so
+    sharding-layout tables (``parallel/distributed.SpecLayout``) can apply
+    it to arbitrary state trees (params *and* their optimizer mirrors —
+    momentum traces share the weight shapes, so they shard identically)."""
     if MODEL_AXIS not in mesh.axis_names:
-        return jax.tree_util.tree_map(lambda _: replicated(mesh), params)
-    n_model = mesh.shape[MODEL_AXIS]
-
-    def leaf_sharding(leaf):
-        nd = getattr(leaf, "ndim", 0)
-        if nd >= 2:
-            if leaf.shape[-1] % n_model == 0:
-                spec = (None,) * (nd - 1) + (MODEL_AXIS,)
-                return NamedSharding(mesh, P(*spec))
-            if leaf.shape[-2] % n_model == 0:
-                spec = (None,) * (nd - 2) + (MODEL_AXIS, None)
-                return NamedSharding(mesh, P(*spec))
         return replicated(mesh)
-
-    return jax.tree_util.tree_map(leaf_sharding, params)
+    n_model = mesh.shape[MODEL_AXIS]
+    nd = getattr(leaf, "ndim", 0)
+    if nd >= 2:
+        if leaf.shape[-1] % n_model == 0:
+            spec = (None,) * (nd - 1) + (MODEL_AXIS,)
+            return NamedSharding(mesh, P(*spec))
+        if leaf.shape[-2] % n_model == 0:
+            spec = (None,) * (nd - 2) + (MODEL_AXIS, None)
+            return NamedSharding(mesh, P(*spec))
+    return replicated(mesh)
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
@@ -106,3 +116,95 @@ def host_device_count_flags(n: int = 8) -> str:
     """The XLA flag that simulates an n-device host (the framework's
     k3d-equivalent fake cluster, SURVEY.md §4)."""
     return f"--xla_force_host_platform_device_count={n}"
+
+
+def ensure_host_device_count(n: Optional[int] = None) -> int:
+    """Append ``--xla_force_host_platform_device_count=n`` to ``XLA_FLAGS``
+    (defaulting ``n`` from ``SLT_HOST_DEVICES``, else 8) so CPU runs can
+    build >1-device meshes without copy-pasting the flag.
+
+    Must run before the JAX backend initializes — the flag is read once at
+    backend creation, so setting it after ``jax.devices()`` has been called
+    is a silent no-op. :func:`make_host_mesh` detects that case and raises
+    with the remedy. Idempotent: an existing device-count flag (however it
+    got into ``XLA_FLAGS``) is left alone.
+    """
+    if n is None:
+        n = int(os.environ.get(HOST_DEVICES_ENV) or 8)
+    current = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in current:
+        os.environ["XLA_FLAGS"] = (
+            current + " " + host_device_count_flags(n)).strip()
+    return n
+
+
+def make_host_mesh(data: int = 1, model: int = 1) -> Mesh:
+    """A (data × 1[, model]) mesh over forced host-platform CPU devices —
+    the validated path for CPU CI and local testing of the sharded server.
+
+    Unlike :func:`make_mesh`'s generic "not enough devices" error, this
+    diagnoses the usual cause (the forcing flag was absent or set too
+    late) and names the fix.
+    """
+    need = data * model
+    devices = jax.devices()
+    if len(devices) < need:
+        raise RuntimeError(
+            f"host mesh needs {need} devices but the backend exposes "
+            f"{len(devices)}. Set XLA_FLAGS="
+            f"{host_device_count_flags(max(need, 8))} (or {HOST_DEVICES_ENV}="
+            f"{max(need, 8)} + parallel.mesh.ensure_host_device_count()) "
+            "BEFORE the first jax call — the flag is read once at backend "
+            "initialization")
+    return make_mesh(num_clients=data, model_parallel=model, devices=devices)
+
+
+def host_gather(x: Any, rows: Optional[int] = None) -> np.ndarray:
+    """Sanctioned D2H for jitted-program outputs (slt-lint SLT013).
+
+    Plain host arrays and unsharded (≤1 addressable shard) device values
+    degrade to ``np.asarray`` plus a leading-dim trim — bit-identical to
+    the legacy transfer. Mesh-sharded values are gathered per addressable
+    shard into a preallocated host buffer, copying only shards that
+    overlap ``[0, rows)``: the coalesced dispatch path asks for just the
+    ``total`` real rows of a padded group, so padding rows sharded onto
+    other devices never cross D2H, and replicated shards (same dim-0
+    range on several devices) are copied once.
+
+    ``rows=None`` gathers everything. Values sharded along a non-leading
+    dim fall back to a full ``np.asarray`` gather — correctness first.
+    """
+    if rows is not None:
+        rows = int(rows)
+    if isinstance(x, np.ndarray):
+        if rows is not None and x.ndim >= 1 and rows < x.shape[0]:
+            return x[:rows]
+        return x
+    nd = getattr(x, "ndim", 0)
+    shards = getattr(x, "addressable_shards", None)
+    if shards is None or nd == 0 or len(shards) <= 1:
+        out = np.asarray(x)
+        if rows is not None and nd >= 1 and rows < out.shape[0]:
+            out = out[:rows]
+        return out
+    # Shards must tile dim 0 only (batch sharding along ``data``); anything
+    # fancier gets the safe full gather.
+    for s in shards:
+        for d, sl in enumerate(s.index[1:], start=1):
+            if (sl.start not in (None, 0)) or (
+                    sl.stop is not None and sl.stop != x.shape[d]):
+                out = np.asarray(x)
+                return out[:rows] if rows is not None else out
+    n = x.shape[0] if rows is None else min(rows, x.shape[0])
+    out = np.empty((n,) + tuple(x.shape[1:]), dtype=np.dtype(x.dtype))
+    seen: set = set()
+    for s in shards:
+        sl = s.index[0] if s.index else slice(None)
+        start = 0 if sl.start is None else int(sl.start)
+        stop = x.shape[0] if sl.stop is None else int(sl.stop)
+        if start >= n or (start, stop) in seen:
+            continue
+        seen.add((start, stop))
+        take = min(stop, n) - start
+        out[start:start + take] = np.asarray(s.data)[:take]
+    return out

@@ -35,6 +35,8 @@ from split_learning_tpu.obs import locks as obs_locks
 from split_learning_tpu.obs import spans
 from split_learning_tpu.obs import trace as obs_trace
 from split_learning_tpu.obs.metrics import Registry
+from split_learning_tpu.parallel.distributed import server_state_layout
+from split_learning_tpu.parallel.mesh import host_gather
 from split_learning_tpu.runtime.admission import AdmissionController
 from split_learning_tpu.runtime.coalesce import (
     CoalesceRequest, RequestCoalescer, pow2_bucket)
@@ -68,12 +70,14 @@ class ServerRuntime:
                  replay_window: int = 8,
                  overlap: bool = True,
                  d2h_delay_s: float = 0.0,
+                 d2h_single_channel: bool = False,
                  batching: str = "window",
                  tenants: int = 1,
                  quota: Optional[Any] = None,
                  slo_ms: Optional[Any] = None,
                  decouple_bwd: bool = False,
-                 apply_lag: int = 0) -> None:
+                 apply_lag: int = 0,
+                 mesh: Optional[Any] = None) -> None:
         """coalesce_max > 1 turns on request coalescing (classic split
         mode only): concurrent split_step calls that arrive within
         ``coalesce_window_ms`` of each other batch into one dispatch, up
@@ -115,6 +119,16 @@ class ServerRuntime:
         ``d2h_delay_s`` adds a synthetic pause to every host
         materialization — bench-only (CPU JAX has no real transfer cost
         to overlap), honestly labeled wherever it is used.
+        ``d2h_single_channel`` picks the contention model for that
+        synthetic pause: ``False`` (default) lets concurrent
+        materializations overlap their sleeps — the regime the
+        async-dispatch (overlap) benches claim, where a transfer runs
+        on the waiter's thread while other steps proceed; ``True``
+        queues them FIFO on one simulated host DMA channel, so N
+        dispatches always cost N transfer windows of wall clock — the
+        regime the coalescing-amortization benches claim, which would
+        otherwise measure thread phasing (whether two groups' sleeps
+        happen to overlap) instead of dispatch-count amortization.
 
         ``decouple_bwd`` (2BP, arXiv:2405.18047) splits the split-mode
         server step into two dispatches: a *reply* program (forward +
@@ -134,13 +148,48 @@ class ServerRuntime:
         ``export_state``/checkpointing, ``flush_deferred`` for
         ``sync_bottoms``, ``close``) apply everything queued before
         state is read. Default off: the fused legacy program is the only
-        thing built and the wire/loss stay bit-for-bit identical."""
+        thing built and the wire/loss stay bit-for-bit identical.
+
+        ``mesh`` (a ``parallel.mesh.make_mesh``/``make_host_mesh`` Mesh)
+        shards the server half: the TrainState lives as a sharded pytree
+        under the ``parallel.distributed.SpecLayout`` rule (batch dims
+        along ``data``, heavy weight matrices along ``model``), all six
+        jitted programs compile with explicit NamedSharding in/out specs,
+        and coalesced groups round to a multiple of the ``data`` axis
+        (padding rows carry zero weight, so the math is unchanged). A
+        mesh of one device — or None, the default — degenerates to the
+        legacy single-device programs byte-for-byte, which is what makes
+        the mesh=1 bit-identity gate structural rather than numerical."""
         self.plan = plan
         self.cfg = cfg
         self.mode = cfg.mode
         self.strict_steps = strict_steps
         self.overlap = bool(overlap)
         self._d2h_delay_s = float(d2h_delay_s)
+        # single-channel contention model (see __init__ docstring):
+        # reservations bookkeep under this leaf lock (never wraps
+        # another acquire); the wait itself runs unlocked
+        self._d2h_single = bool(d2h_single_channel)
+        self._d2h_chan_lock = obs_locks.make_lock(
+            "ServerRuntime._d2h_chan", reentrant=False)
+        self._d2h_chan_free_at = 0.0
+        # sharded server (pjit): a 1-device mesh IS the legacy layout, so
+        # normalize it to None and never branch again on the hot path
+        if mesh is not None and mesh.size <= 1:
+            mesh = None
+        if mesh is not None and cfg.mode == "federated":
+            raise ValueError(
+                "mesh sharding applies to the jitted split/u_split server "
+                "stage; the federated server holds plain param trees")
+        self._mesh = mesh
+        self._layout = None
+        self._mesh_data = 1
+        # per-program MFU accounting (traced-only, under the lock):
+        # program name -> [matmul flops total, dispatch seconds, calls];
+        # the flops of a (program, arg-shapes) pair are traced once and
+        # cached — never on an untraced step path
+        self._prog_stats: Dict[str, list] = {}
+        self._flops_cache: Dict[Any, float] = {}
         # optional hook fired (under the lock) after every completed op
         # with the acknowledged client step — the serve CLI hangs periodic
         # checkpointing off it
@@ -216,6 +265,18 @@ class ServerRuntime:
             self.server_stage = server_idx[0]
             self.state = make_state(all_params[self.server_stage], self._tx)
             self._agg = None
+            if self._mesh is not None:
+                # install the sharded layout BEFORE compiling: the state
+                # tree moves onto the mesh (weights along ``model``,
+                # optimizer mirrors with their weights, scalars
+                # replicated) and _build_jitted reads these shardings
+                # into every program's in/out specs
+                self._layout = server_state_layout(self._mesh)
+                self._mesh_data = self._layout.data
+                self._state_sharding = self._layout.state(self.state)
+                self._params_sharding = self._state_sharding.params
+                self._batch_sharding = self._layout.batch()
+                self.state = jax.device_put(self.state, self._state_sharding)
             self._build_jitted()
             if self.decouple_bwd:
                 self._deferred = _DeferredApply(
@@ -253,6 +314,26 @@ class ServerRuntime:
         tx = self._tx
         is_last = self.server_stage == self.plan.num_stages - 1
 
+        # On a mesh, every program compiles with explicit NamedSharding
+        # in/out specs: the state/params trees keep the SpecLayout
+        # placement across steps (donation aliases shard-for-shard),
+        # batch-shaped values ride the ``data`` axis, scalars replicate.
+        # Without a mesh, _jit is jax.jit verbatim — the legacy programs.
+        if self._mesh is not None:
+            batch = self._batch_sharding
+            state_sh = self._state_sharding
+            params_sh = self._params_sharding
+            repl = self._layout.replicated()
+
+            def _jit(fn, in_sh, out_sh, donate=()):
+                return jax.jit(fn, in_shardings=in_sh, out_shardings=out_sh,
+                               donate_argnums=donate)
+        else:
+            batch = state_sh = params_sh = repl = None
+
+            def _jit(fn, in_sh, out_sh, donate=()):
+                return jax.jit(fn, donate_argnums=donate)
+
         if is_last:
             # classic split: server half computes the loss (ref
             # src/server_part.py:45-52) and returns d(loss)/d(acts).
@@ -265,7 +346,9 @@ class ServerRuntime:
                 new_state = apply_grads(tx, state, g_params)
                 return new_state, g_acts, loss
 
-            self._split_step = jax.jit(step_fn, donate_argnums=(0,))
+            self._split_step = _jit(
+                step_fn, (state_sh, batch, batch), (state_sh, batch, repl),
+                donate=(0,))
 
             # coalesced group step: one dispatch over a concatenated
             # (pow2-padded) group. ``weights`` is 1/num_real on real rows
@@ -284,8 +367,9 @@ class ServerRuntime:
                 new_state = apply_grads(tx, state, g_params)
                 return new_state, g_acts, per_ex
 
-            self._coalesced_step = jax.jit(group_step_fn,
-                                           donate_argnums=(0,))
+            self._coalesced_step = _jit(
+                group_step_fn, (state_sh, batch, batch, batch),
+                (state_sh, batch, batch), donate=(0,))
 
             if self.decouple_bwd:
                 # 2BP reply program: forward + d(loss)/d(acts) ONLY —
@@ -302,7 +386,8 @@ class ServerRuntime:
                     loss, g_acts = jax.value_and_grad(fwd)(acts)
                     return g_acts, loss
 
-                self._reply_step = jax.jit(reply_fn)
+                self._reply_step = _jit(
+                    reply_fn, (params_sh, batch, batch), (batch, repl))
 
                 # deferred apply: grad-of-weights recomputed from the
                 # entry's residuals (acts/labels + the params snapshot
@@ -320,7 +405,9 @@ class ServerRuntime:
                     g_params = jax.grad(loss_fn)(fwd_params, acts)
                     return apply_grads(tx, state, g_params)
 
-                self._deferred_apply = jax.jit(deferred_apply_fn)
+                self._deferred_apply = _jit(
+                    deferred_apply_fn, (state_sh, params_sh, batch, batch),
+                    state_sh)
 
                 # coalesced-group twins of the pair above (group-mean
                 # objective, pow2-padded shapes — same bucketing as the
@@ -334,7 +421,9 @@ class ServerRuntime:
                         fwd, has_aux=True)(acts)
                     return g_acts, per_ex
 
-                self._group_reply_step = jax.jit(group_reply_fn)
+                self._group_reply_step = _jit(
+                    group_reply_fn, (params_sh, batch, batch, batch),
+                    (batch, batch))
 
                 def group_apply_fn(state: TrainState, fwd_params,
                                    acts, labels, weights):
@@ -345,7 +434,9 @@ class ServerRuntime:
                     g_params = jax.grad(loss_fn)(fwd_params, acts)
                     return apply_grads(tx, state, g_params)
 
-                self._group_deferred_apply = jax.jit(group_apply_fn)
+                self._group_deferred_apply = _jit(
+                    group_apply_fn,
+                    (state_sh, params_sh, batch, batch, batch), state_sh)
         else:
             # U-shaped trunk: forward produces features; backward receives
             # d(loss)/d(features) from the client head and returns
@@ -361,13 +452,14 @@ class ServerRuntime:
                 new_state = apply_grads(tx, state, g_params)
                 return new_state, g_acts
 
-            self._u_fwd = jax.jit(fwd_fn)
-            self._u_bwd = jax.jit(bwd_fn, donate_argnums=(0,))
+            self._u_fwd = _jit(fwd_fn, (params_sh, batch), batch)
+            self._u_bwd = _jit(bwd_fn, (state_sh, batch, batch),
+                               (state_sh, batch), donate=(0,))
 
         # inference: the server-owned forward with no loss, no optimizer
         # and no residuals — the serving half of split-party prediction
         # (runtime/evaluate.py evaluate_remote)
-        self._predict = jax.jit(stage.apply)
+        self._predict = _jit(stage.apply, (params_sh, batch), batch)
 
     # ------------------------------------------------------------------ #
     def _check_step(self, step: int, client_id: int = 0) -> None:
@@ -380,8 +472,121 @@ class ServerRuntime:
 
     def _sleep_d2h(self) -> None:
         # synthetic transfer cost (bench-only; see __init__)
-        if self._d2h_delay_s > 0.0:
+        if self._d2h_delay_s <= 0.0:
+            return
+        if not self._d2h_single:
             time.sleep(self._d2h_delay_s)
+            return
+        # single-channel model: reserve the next free window, then
+        # sleep out the reservation off-lock. monotonic so a wall-clock
+        # step can never hand out a negative wait.
+        with self._d2h_chan_lock:
+            start = max(time.monotonic(), self._d2h_chan_free_at)
+            end = start + self._d2h_delay_s
+            self._d2h_chan_free_at = end
+        while True:
+            remaining = end - time.monotonic()
+            if remaining <= 0.0:
+                return
+            time.sleep(remaining)
+
+    def _to_dev(self, x: Any) -> jax.Array:
+        """Host batch -> device. On a sharded server this is the H2D
+        scatter onto the ``data``-sharded layout (explicit, so the jitted
+        call never implicitly reshards a committed input); without a mesh
+        it is exactly the legacy ``jnp.asarray``."""
+        arr = jnp.asarray(x)
+        if self._mesh is not None:
+            arr = jax.device_put(arr, self._batch_sharding)
+        return arr
+
+    def _check_batch_rows(self, rows: int) -> None:
+        """Serialized ops on a mesh need the batch to tile the ``data``
+        axis exactly (the coalesced path pads its groups instead)."""
+        if self._mesh is not None and rows % self._mesh_data != 0:
+            raise ProtocolError(
+                f"batch of {rows} rows cannot shard over the mesh 'data' "
+                f"axis of size {self._mesh_data}; send a multiple of "
+                f"{self._mesh_data} (coalesced groups pad automatically)",
+                status=400)
+
+    def _host_gather(self, x: Any, rows: Optional[int] = None) -> np.ndarray:
+        """The sanctioned D2H for jitted-program outputs (slt-lint
+        SLT013): per-addressable-shard gather on a mesh — ``rows`` bounds
+        the transfer to the rows the caller actually needs, so a padded
+        group's padding never crosses D2H — and a plain ``np.asarray``
+        (bit-identical to the legacy transfer) otherwise."""
+        out = host_gather(x, rows=rows)
+        if self._mesh is not None:
+            # gather-byte accounting is mesh-only so the legacy hot path
+            # does not grow even a counter update
+            self._metrics.incr(spans.GATHER_BYTES, float(out.nbytes))
+        return out
+
+    def _note_flops(self, name: str, fn: Any, args: Tuple[Any, ...],
+                    dispatch_s: float) -> None:
+        """Fold one traced dispatch into the per-program MFU accounting
+        (trace_metadata). Called only while tracing is enabled, with the
+        runtime lock held (reentrant — every call site already owns it).
+        The matmul-flops trace of a (program, arg shapes) pair runs once
+        and is cached; abstract tracing only, so donated jitted fns are
+        safe to pass."""
+        key = (name,) + tuple(
+            (tuple(a.shape), str(a.dtype)) for a in args
+            if hasattr(a, "shape") and hasattr(a, "dtype"))
+        with self._lock:
+            flops = self._flops_cache.get(key)
+            if flops is None:
+                try:
+                    from split_learning_tpu.utils.flops import (
+                        jaxpr_matmul_flops)
+                    flops = float(jaxpr_matmul_flops(fn, *args))  # slt-lint: disable=SLT001 (abstract jaxpr trace yields a Python int — no device value, no D2H)
+                except Exception:
+                    flops = 0.0
+                self._flops_cache[key] = flops
+            st = self._prog_stats.setdefault(name, [0.0, 0.0, 0])
+            st[0] += flops
+            st[1] += dispatch_s
+            st[2] += 1
+
+    def trace_metadata(self) -> Dict[str, Any]:
+        """Mesh/MFU sidecar for ``Tracer.export_chrome(metadata=...)``:
+        the mesh shape, per-program matmul-flops rates over their
+        dispatch windows (collected only while tracing), cumulative
+        sharded-gather bytes, and MFU where the device peak is known —
+        ``None`` on CPU (utils/flops.device_peak_flops), which is the
+        honest answer, not a zero."""
+        from split_learning_tpu.utils.flops import device_peak_flops, mfu
+        try:
+            peak = device_peak_flops(jax.devices()[0])
+        except Exception:
+            peak = None
+        with self._lock:
+            stats = {k: tuple(v) for k, v in self._prog_stats.items()}
+            gather = self._metrics.snapshot()["counters"].get(
+                spans.GATHER_BYTES, 0.0)
+        if self._mesh is not None:
+            mesh_info = {"devices": int(self._mesh.size),
+                         **{str(k): int(v)
+                            for k, v in dict(self._mesh.shape).items()}}
+        else:
+            mesh_info = {"devices": 1, "data": 1}
+        n_dev = mesh_info["devices"]
+        programs = {}
+        for name, (fl, secs, calls) in stats.items():
+            rate = (fl / secs) if secs > 0 else None
+            programs[name] = {
+                "calls": calls,
+                "model_flops": fl,
+                "dispatch_s": secs,
+                "model_flops_per_sec": rate,
+                "mfu": (mfu(rate, peak * n_dev)
+                        if (peak and rate) else None),
+            }
+        return {"mesh": mesh_info,
+                "gather_bytes": int(gather),
+                "peak_flops_per_device": peak,
+                "programs": programs}
 
     def split_step(self, activations: np.ndarray, labels: np.ndarray,
                    step: int, client_id: int = 0) -> Tuple[np.ndarray, float]:
@@ -438,6 +643,7 @@ class ServerRuntime:
             with self._lock:
                 t_d0 = time.perf_counter() if tr is not None else 0.0
                 self._check_step(step, client_id)
+                self._check_batch_rows(int(np.shape(activations)[0]))
                 if self._deferred is not None:
                     # 2BP: dispatch the reply program on the current
                     # (<= apply_lag steps stale) weights, queue the
@@ -447,8 +653,8 @@ class ServerRuntime:
                     # client-visible work first; a replayed duplicate
                     # never reaches here (the begin() claim above), so
                     # it can never re-enqueue an apply.
-                    acts_dev = jnp.asarray(activations)
-                    labels_dev = jnp.asarray(labels)
+                    acts_dev = self._to_dev(activations)
+                    labels_dev = self._to_dev(labels)
                     with obs_dispatch.step_scope(
                             self._dd, (self._ddtok, "reply_grad"),
                             sig_fn=lambda: (activations.shape,
@@ -463,7 +669,14 @@ class ServerRuntime:
                         "fwd_params": self.state.params,
                         "acts": acts_dev, "labels": labels_dev})
                     self._deferred.drain_over_lag()
+                    if tr is not None:
+                        self._note_flops(
+                            "reply_grad", self._reply_step,
+                            (self.state.params, acts_dev, labels_dev),
+                            time.perf_counter() - t_d0)
                 else:
+                    acts_dev = self._to_dev(activations)
+                    labels_dev = self._to_dev(labels)
                     with obs_dispatch.step_scope(
                             self._dd, (self._ddtok, "split_step"),
                             sig_fn=lambda: (activations.shape,
@@ -471,15 +684,20 @@ class ServerRuntime:
                                             labels.shape,
                                             str(labels.dtype))):
                         self.state, g_acts, loss = self._split_step(
-                            self.state, jnp.asarray(activations),
-                            jnp.asarray(labels))
+                            self.state, acts_dev, labels_dev)
+                    if tr is not None:
+                        self._note_flops(
+                            "split_step", self._split_step,
+                            (self.state, acts_dev, labels_dev),
+                            time.perf_counter() - t_d0)
                 if not self.overlap:
                     # legacy placement: the transfer rides inside the
                     # lock (and inside the dispatch span — the old span
                     # taxonomy, where dispatch = jit + materialization)
                     self._sleep_d2h()
                     with obs_dispatch.expected_d2h(self._dd):
-                        g_host, loss_f = np.asarray(g_acts), float(loss)
+                        g_host = self._host_gather(g_acts)
+                        loss_f = float(loss)
                 # max(): with strict_steps off (pipelined clients) steps
                 # can arrive out of order, and the acknowledged step —
                 # what /health reports and checkpoints are labeled with —
@@ -495,7 +713,8 @@ class ServerRuntime:
                 # lets step t's D2H overlap step t+1's device compute
                 self._sleep_d2h()
                 with obs_dispatch.expected_d2h(self._dd):
-                    g_host, loss_f = np.asarray(g_acts), float(loss)
+                    g_host = self._host_gather(g_acts)
+                    loss_f = float(loss)
             if tr is not None and self._deferred is not None:
                 # the client-visible reply window: reply dispatch ->
                 # cut-layer gradient on host (what the 2BP bench leg
@@ -662,6 +881,14 @@ class ServerRuntime:
             sizes = [int(r.acts.shape[0]) for r in admitted]
             total = sum(sizes)
             padded = pow2_bucket(total)
+            if self._mesh_data > 1:
+                # mesh-aware group sizing: the padded group must tile the
+                # ``data`` axis exactly. pow2 buckets are already
+                # multiples when data is a power of two >= the bucket;
+                # the ceil covers small buckets and non-pow2 axes. Padded
+                # rows keep weight 0, so the objective is untouched.
+                padded = -(-max(padded, self._mesh_data)
+                           // self._mesh_data) * self._mesh_data
             acts = np.concatenate([r.acts for r in admitted], axis=0)
             labels = np.concatenate([r.labels for r in admitted], axis=0)
             if padded > total:
@@ -683,14 +910,14 @@ class ServerRuntime:
             # compile_count counter above) — hand its freshness verdict
             # to the watchdog instead of double-tracking
             deferred_entry = None
+            acts_dev = self._to_dev(acts)
+            labels_dev = self._to_dev(labels)
+            w_dev = self._to_dev(weights)
             if self._deferred is not None:
                 # 2BP group dispatch: reply program first (on the
                 # current weights), the group's single weight update
                 # queued and drained only after every member below holds
                 # its reply — replies before apply, by construction
-                acts_dev = jnp.asarray(acts)
-                labels_dev = jnp.asarray(labels)
-                w_dev = jnp.asarray(weights)
                 with obs_dispatch.step_scope(
                         self._dd, (self._ddtok, "group_reply"),
                         fresh=fresh):
@@ -703,22 +930,34 @@ class ServerRuntime:
                     "fwd_params": self.state.params,
                     "acts": acts_dev, "labels": labels_dev,
                     "weights": w_dev, "fresh": fresh}
+                if tr is not None:
+                    self._note_flops(
+                        "group_reply", self._group_reply_step,
+                        (self.state.params, acts_dev, labels_dev, w_dev),
+                        time.perf_counter() - t_d0)
             else:
                 with obs_dispatch.step_scope(
                         self._dd, (self._ddtok, "coalesced_step"),
                         fresh=fresh):
                     self.state, g_acts, per_ex = self._coalesced_step(
-                        self.state, jnp.asarray(acts), jnp.asarray(labels),
-                        jnp.asarray(weights))
+                        self.state, acts_dev, labels_dev, w_dev)
+                if tr is not None:
+                    self._note_flops(
+                        "coalesced_step", self._coalesced_step,
+                        (self.state, acts_dev, labels_dev, w_dev),
+                        time.perf_counter() - t_d0)
             if not self.overlap:
                 # legacy placement: the whole group's transfer inside
-                # the lock (dispatch span = jit + materialization)
+                # the lock (dispatch span = jit + materialization).
+                # ``rows=total`` gathers only the real rows — the padded
+                # tail (zero-weight, possibly on other devices) never
+                # crosses D2H, and the segment loop below never reads it.
                 self._sleep_d2h()
                 with obs_dispatch.expected_d2h(self._dd):
-                    g_acts = np.asarray(g_acts)
-                    per_ex = np.asarray(per_ex)
+                    g_acts = self._host_gather(g_acts, rows=total)
+                    per_ex = self._host_gather(per_ex, rows=total)
             dw = time.perf_counter() - t_d0 if tr is not None else 0.0
-            pg = (_GroupD2H(self, g_acts, per_ex, tr)
+            pg = (_GroupD2H(self, g_acts, per_ex, tr, rows=total)
                   if self.overlap else None)
             off = 0
             for r, b in zip(admitted, sizes):
@@ -785,13 +1024,21 @@ class ServerRuntime:
                 # already moved past
                 self._deferred.flush()
             params = self.state.params
+        x = jnp.asarray(activations)
+        n = int(x.shape[0])
+        pad = (-n) % self._mesh_data
+        if pad:
+            # forward-only, so padding is exact: pad rows to tile the
+            # ``data`` axis, gather back only the real ones below
+            x = jnp.concatenate(
+                [x, jnp.zeros((pad,) + tuple(x.shape[1:]), x.dtype)])
+        x = self._to_dev(x)
         with obs_dispatch.step_scope(
                 self._dd, (self._ddtok, "predict"),
-                sig_fn=lambda: (np.asarray(activations).shape,
-                                str(np.asarray(activations).dtype))):
-            out = self._predict(params, jnp.asarray(activations))
+                sig_fn=lambda: (x.shape, str(x.dtype))):
+            out = self._predict(params, x)
         with obs_dispatch.expected_d2h(self._dd):
-            return np.asarray(out)
+            return self._host_gather(out, rows=n)
 
     # bounds on residuals awaiting their hop-2 u_backward. Per-client FIFO
     # cap: one client's backlog can never evict another's live residual.
@@ -817,7 +1064,8 @@ class ServerRuntime:
         try:
             with self._lock:
                 self._check_step(step, client_id)
-                acts = jnp.asarray(activations)
+                self._check_batch_rows(int(np.shape(activations)[0]))
+                acts = self._to_dev(activations)
                 with obs_dispatch.step_scope(
                         self._dd, (self._ddtok, "u_fwd"),
                         sig_fn=lambda: (acts.shape, str(acts.dtype))):
@@ -837,12 +1085,12 @@ class ServerRuntime:
                 if not self.overlap:
                     self._sleep_d2h()
                     with obs_dispatch.expected_d2h(self._dd):
-                        feats_host = np.asarray(feats)
+                        feats_host = self._host_gather(feats)
             if self.overlap:
                 # off the lock: async dispatch returned device futures
                 self._sleep_d2h()
                 with obs_dispatch.expected_d2h(self._dd):
-                    feats_host = np.asarray(feats)
+                    feats_host = self._host_gather(feats)
             if entry is not None:
                 self.replay.resolve(entry, feats_host)
             return feats_host
@@ -877,11 +1125,11 @@ class ServerRuntime:
                                         feat_grads.shape,
                                         str(feat_grads.dtype))):
                     self.state, g_acts = self._u_bwd(
-                        self.state, acts, jnp.asarray(feat_grads))
+                        self.state, acts, self._to_dev(feat_grads))
                 if not self.overlap:
                     self._sleep_d2h()
                     with obs_dispatch.expected_d2h(self._dd):
-                        g_host = np.asarray(g_acts)
+                        g_host = self._host_gather(g_acts)
                 # max(): with strict_steps off (pipelined clients) steps
                 # can arrive out of order, and the acknowledged step —
                 # what /health reports and checkpoints are labeled with —
@@ -894,7 +1142,7 @@ class ServerRuntime:
                 # off the lock: async dispatch returned device futures
                 self._sleep_d2h()
                 with obs_dispatch.expected_d2h(self._dd):
-                    g_host = np.asarray(g_acts)
+                    g_host = self._host_gather(g_acts)
             if entry is not None:
                 self.replay.resolve(entry, g_host)
             return g_host
@@ -939,6 +1187,10 @@ class ServerRuntime:
                 # checkpoint that, via export_state, was already flushed
                 # when it was taken
                 self._deferred.clear()
+            if self._mesh is not None:
+                # restored trees arrive as host/single-device values;
+                # re-install the mesh layout before stepping on them
+                state = jax.device_put(state, self._state_sharding)
             self.state = state
             self._last_step = {}
             self._step_floor = step - 1  # applies to every client_id
@@ -1003,6 +1255,11 @@ class ServerRuntime:
             info["decoupled_bwd"] = {
                 "apply_lag": self.apply_lag,
                 **self._deferred.counters()}
+        if self._mesh is not None:
+            info["mesh"] = {
+                "devices": int(self._mesh.size),
+                **{str(k): int(v)
+                   for k, v in dict(self._mesh.shape).items()}}
         return info
 
     def metrics(self) -> Dict[str, Any]:
@@ -1039,6 +1296,9 @@ class ServerRuntime:
             # watchdog gauges fold in at scrape time (the replay-counter
             # pattern); render_prometheus prefixes them slt_
             snap["gauges"].update(self._dd.gauges())
+        if self._mesh is not None:
+            for k, v in h.get("mesh", {}).items():
+                snap["gauges"][f"mesh_{k}"] = float(v)
         return snap
 
     # -- wire-server replay hooks (transport/http.py) -------------------- #
@@ -1168,15 +1428,18 @@ class _GroupD2H:
     else reads the cached host arrays. The device references are dropped
     after the transfer so the group's buffers are not pinned past it."""
 
-    __slots__ = ("_runtime", "_g_dev", "_per_ex_dev", "_tr", "_lock",
-                 "g", "per_ex", "t_h0", "hw")
+    __slots__ = ("_runtime", "_g_dev", "_per_ex_dev", "_tr", "_rows",
+                 "_lock", "g", "per_ex", "t_h0", "hw")
 
     def __init__(self, runtime: "ServerRuntime", g_dev, per_ex_dev,
-                 tr) -> None:
+                 tr, rows: Optional[int] = None) -> None:
         self._runtime = runtime
         self._g_dev = g_dev
         self._per_ex_dev = per_ex_dev
         self._tr = tr
+        # only the group's real rows cross D2H; the padded tail (zero
+        # weight, possibly resident on other mesh devices) stays put
+        self._rows = rows
         self._lock = obs_locks.make_lock("_GroupD2H._lock", reentrant=False)
         self.g: Optional[np.ndarray] = None
         self.per_ex: Optional[np.ndarray] = None
@@ -1189,8 +1452,10 @@ class _GroupD2H:
                 t_h0 = time.perf_counter() if self._tr is not None else 0.0
                 self._runtime._sleep_d2h()
                 with obs_dispatch.expected_d2h(self._runtime._dd):
-                    g = np.asarray(self._g_dev)
-                    per_ex = np.asarray(self._per_ex_dev)
+                    g = self._runtime._host_gather(
+                        self._g_dev, rows=self._rows)
+                    per_ex = self._runtime._host_gather(
+                        self._per_ex_dev, rows=self._rows)
                 if self._tr is not None:
                     self.t_h0 = t_h0
                     self.hw = time.perf_counter() - t_h0

@@ -806,6 +806,87 @@ def test_slt012_waiver_file(tmp_path):
 
 
 # ---------------------------------------------------------------------- #
+# SLT013: sharded outputs cross D2H via the sanctioned per-shard gather
+# ---------------------------------------------------------------------- #
+
+def test_slt013_raw_gather_in_expected_d2h_flagged(tmp_path):
+    findings = _lint(tmp_path, "runtime/server.py", """
+        import numpy as np
+        import jax
+        class ServerRuntime:
+            def __init__(self):
+                self._mesh = object()
+            def step(self, tag):
+                with obs_dispatch.expected_d2h(tag):
+                    g = np.asarray(self.g_dev)       # raw shard gather
+                    e = np.array(self.e_dev)         # same, via np.array
+                    h = jax.device_get(self.h_dev)   # same, via jax
+                return g, e, h
+    """)
+    assert _rules(findings) == ["SLT013", "SLT013", "SLT013"]
+    msgs = " ".join(f.message for f in findings)
+    assert "_host_gather" in msgs and "per-shard" in msgs
+
+
+def test_slt013_sanctioned_gather_and_off_path_reads_clean(tmp_path):
+    findings = _lint(tmp_path, "runtime/server.py", """
+        import numpy as np
+        class ServerRuntime:
+            def __init__(self):
+                self._mesh = object()
+            def step(self, tag):
+                with obs_dispatch.expected_d2h(tag):
+                    g = self._host_gather(self.g_dev)   # the seam
+                    cb = lambda: np.asarray(self.x)     # runs later
+                n = np.asarray(self.host_buf)           # outside the block
+                return g, cb, n
+    """)
+    assert findings == []
+
+
+def test_slt013_scoped_to_mesh_aware_runtime_classes(tmp_path):
+    # a runtime class with NO mesh attributes has single-device outputs
+    # — np.asarray on them is the normal (and correct) materialization
+    findings = _lint(tmp_path, "runtime/client.py", """
+        import numpy as np
+        class SplitClientTrainer:
+            def step(self, tag):
+                with obs_dispatch.expected_d2h(tag):
+                    return np.asarray(self.g_dev)
+    """)
+    assert findings == []
+    # ...and files outside runtime/ are out of scope entirely
+    findings = _lint(tmp_path, "launch/run.py", """
+        import numpy as np
+        class Driver:
+            def __init__(self):
+                self._mesh = object()
+            def step(self, tag):
+                with obs_dispatch.expected_d2h(tag):
+                    return np.asarray(self.g_dev)
+    """)
+    assert findings == []
+
+
+def test_slt013_waiver_file(tmp_path):
+    bad = tmp_path / "runtime" / "server.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(textwrap.dedent("""
+        import numpy as np
+        class ServerRuntime:
+            def __init__(self):
+                self._mesh = object()
+            def step(self, tag):
+                with obs_dispatch.expected_d2h(tag):
+                    return np.asarray(self.g_dev)
+    """))
+    wf = tmp_path / "waivers"
+    wf.write_text("SLT013 runtime/server.py replicated-only debug path\n")
+    assert engine.main([str(tmp_path), "--waiver-file", str(wf)]) == 0
+    assert engine.main([str(tmp_path)]) == 1
+
+
+# ---------------------------------------------------------------------- #
 # engine: exit codes, waiver file, real tree
 # ---------------------------------------------------------------------- #
 
@@ -901,6 +982,7 @@ def test_trace_report_fallback_matches_registry():
     assert fallback["COMPILE"] == spans.COMPILE
     assert fallback["REPLY_GRAD"] == spans.REPLY_GRAD
     assert fallback["DEFERRED_APPLY"] == spans.DEFERRED_APPLY
+    assert fallback["MESH_META"] == spans.MESH_META
 
 
 def test_analysis_package_is_stdlib_only():

@@ -205,6 +205,37 @@ def test_bench_reply_latency_2bp_role_quick():
     assert rl["valid"] is True, rl["invalid_reason"]
 
 
+@pytest.mark.slow
+def test_bench_sharded_server_role_quick():
+    """The sharded_server leg's contract fields (pjit PR): 8 concurrent
+    clients against data=1 vs data=2 coalescing servers at the same
+    per-device row ceiling, on the conftest-forced 8-device topology.
+    Gates carried by the leg itself: mesh=1 bit-identity, data=2 float
+    parity, data=2 strictly-higher throughput at strictly-higher group
+    occupancy, zero steady-state recompiles, and the mesh/MFU metadata
+    present with MFU honestly None on the CPU backend."""
+    sys.path.insert(0, REPO)
+    from bench import measure_sharded_server
+
+    sh = measure_sharded_server(quick=True)
+    assert sh["leg"] == "sharded_server"
+    assert sh["valid"] is True, sh["invalid_reason"]
+    assert sh["batch_ceiling_relative"] is True
+    assert "ceiling" in sh["note"]  # the honesty caveat ships with the leg
+    assert sh["mesh"]["devices"] == 2 and sh["mesh"]["data"] == 2
+    assert sh["coalesce_max"]["data2"] == 2 * sh["coalesce_max"]["data1"]
+    assert sh["steps_per_sec_data2"] > sh["steps_per_sec_data1"] > 0
+    assert sh["mean_occupancy_data2"] > sh["mean_occupancy_data1"]
+    assert sh["loss_mesh1_max_abs_diff"] == 0.0
+    assert sh["loss_data2_max_abs_diff"] <= sh["parity_tol"]
+    assert sh["compile_count"]["steady_state"] == 0
+    assert sh["gather_bytes"] > 0
+    assert sh["peak_flops_per_device"] is None  # CPU: unknown, never 0
+    progs = sh["programs"]
+    assert progs and all(p["calls"] >= 1 and p["mfu"] is None
+                         for p in progs.values())
+
+
 def test_degraded_headline_is_self_describing(monkeypatch, capsys):
     """VERDICT r3 weak #1: when the intended TPU backend is unavailable
     the parsed headline must never be a bare CPU number — it replays the
