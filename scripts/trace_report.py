@@ -361,6 +361,11 @@ def summarize_schedules(path: str) -> Dict[str, Any]:
             "exhausted": bool(e.get("exhausted", False)),
             "violations": list(e.get("violations", ())),
         }
+        if e.get("crash"):
+            # slt-crash (PR 12) entries: interleavings x crash points
+            row["crash"] = True
+            row["bases"] = int(e.get("bases", 0))
+            row["crash_schedules"] = int(e.get("crash_schedules", 0))
         table[name] = row
         totals["schedules"] += row["schedules"]
         totals["pruned"] += row["pruned"]
@@ -384,6 +389,19 @@ def render_schedules(rep: Dict[str, Any]) -> str:
             f"{name:<26} {row['schedules']:>7d} {row['pruned']:>7d} "
             f"{row['pruning_ratio']:>7.1%} {row['max_preemptions']:>7d}"
             f"  {note}")
+    crash_rows = {name: row for name, row in rep["scenarios"].items()
+                  if row.get("crash")}
+    if crash_rows:
+        lines.append("")
+        lines.append("crash-restart schedules (interleavings x crash "
+                     "points, recovery re-run from durable state):")
+        lines.append(f"  {'scenario':<26} {'bases':>6} {'crash':>6} "
+                     f"{'scheds':>7} {'prune%':>7}")
+        for name, row in crash_rows.items():
+            lines.append(
+                f"  {name:<26} {row['bases']:>6d} "
+                f"{row['crash_schedules']:>6d} {row['schedules']:>7d} "
+                f"{row['pruning_ratio']:>7.1%}")
     t = rep["totals"]
     lines.append("")
     lines.append(

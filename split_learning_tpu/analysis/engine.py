@@ -217,7 +217,18 @@ def run_check(args: "argparse.Namespace") -> int:
     from split_learning_tpu.analysis.invariants import check_run
     from split_learning_tpu.analysis.sched import explore
 
-    scenarios = _check_scenarios(args.scenario)
+    crash_scenarios: Dict[str, Any] = {}
+    if getattr(args, "crash", False):
+        from split_learning_tpu.analysis.scenarios import CRASH_SCENARIOS
+        if args.scenario is not None and args.scenario in CRASH_SCENARIOS:
+            scenarios = {}
+            crash_scenarios = {args.scenario: CRASH_SCENARIOS[args.scenario]}
+        else:
+            scenarios = _check_scenarios(args.scenario)
+            if args.scenario is None:
+                crash_scenarios = dict(sorted(CRASH_SCENARIOS.items()))
+    else:
+        scenarios = _check_scenarios(args.scenario)
     file_waivers, problems = ([], [])
     waiver_file = args.waiver_file
     if waiver_file is None and os.path.exists(_DEFAULT_WAIVER_FILE):
@@ -279,6 +290,61 @@ def run_check(args: "argparse.Namespace") -> int:
                         f"scenario://{name}", 1, msg)
             findings.append(_waive(f, {}, file_waivers, f.path))
 
+    if crash_scenarios:
+        from split_learning_tpu.analysis.sched import explore_crashes
+        report["crash"] = True
+    for name, sc in crash_scenarios.items():
+        if not sc.available():
+            print(f"slt-crash: {name}: SKIPPED (requires {sc.requires})")
+            report["scenarios"][name] = {"skipped": sc.requires,
+                                         "crash": True}
+            continue
+        # --budget overrides the crash-point budget (the dominant knob);
+        # the base-interleaving budget stays the scenario's own
+        crash_budget = (args.budget if args.budget is not None
+                        else sc.crash_budget)
+        bound = (args.max_preemptions if args.max_preemptions is not None
+                 else sc.bound)
+        violations = []
+        res = explore_crashes(
+            name, sc.workload, sc.recover, budget=sc.budget, bound=bound,
+            crash_budget=crash_budget,
+            on_run=lambda run, _inv=sc.invariants:
+                violations.extend(check_run(run, _inv)))
+        entry = res.summary()
+        entry["crash"] = True
+        entry["invariants"] = sorted(
+            {"deadlock_free", "no_lost_wakeup", "no_errors"}
+            | set(sc.invariants))
+        entry["violations"] = [
+            {"invariant": v.invariant, "schedule_id": v.schedule_id,
+             "message": v.message} for v in violations]
+        entry["sample_fingerprints"] = dict(res.sample)
+        report["scenarios"][name] = entry
+        report["total_schedules"] += res.schedules
+        status = (f"{res.schedules} schedules ({res.bases} bases, "
+                  f"{res.crash_schedules} crash points), "
+                  f"{res.pruned} pruned"
+                  + (", exhausted" if res.exhausted else ""))
+        if violations:
+            status += f", {len(violations)} VIOLATION(S)"
+        print(f"slt-crash: {name}: {status}")
+        first = {}
+        extra = {}
+        for v in violations:
+            if v.invariant in first:
+                extra[v.invariant] = extra.get(v.invariant, 0) + 1
+            else:
+                first[v.invariant] = v
+        for inv_name, v in first.items():
+            more = extra.get(inv_name, 0)
+            msg = (f"[{name}] {v.message} — replay: "
+                   f"--schedule {v.schedule_id}"
+                   + (f" (+{more} more schedule(s))" if more else ""))
+            f = Finding(RULE_OF_INVARIANT[inv_name],
+                        f"scenario://{name}", 1, msg)
+            findings.append(_waive(f, {}, file_waivers, f.path))
+
     if args.report:
         with open(args.report, "w", encoding="utf-8") as fh:
             json.dump(report, fh, indent=2, sort_keys=True)
@@ -295,6 +361,36 @@ def run_check(args: "argparse.Namespace") -> int:
     return 1 if unwaived else 0
 
 
+def _replay_crash_schedule(sc: Any, name: str, choices_text: str,
+                           crash_at: Optional[int]) -> int:
+    """Re-execute one crash–restart schedule bit-for-bit: the base
+    interleaving to the crash point, the process kill, the recovery —
+    and re-assert the scenario's invariants over the combined run."""
+    from split_learning_tpu.analysis.invariants import check_run
+    from split_learning_tpu.analysis.sched import (decode_choices,
+                                                   run_crash_schedule)
+    if not sc.available():
+        raise SystemExit(f"slt-check: scenario {name} requires "
+                         f"{sc.requires}, which is unavailable")
+    run = run_crash_schedule(name, sc.workload, sc.recover,
+                             forced=decode_choices(choices_text),
+                             bound=sc.bound, crash_at=crash_at)
+    kind = (f"crashed at transition {crash_at}" if run.crashed
+            else "clean restart")
+    print(f"slt-crash: replayed {run.schedule_id} ({kind}, "
+          f"{run.transitions} transitions, fingerprint "
+          f"{run.trace_fingerprint()})")
+    for tid, op, obj in run.trace:
+        print(f"  t{tid} {op:<14} {obj}")
+    violations = check_run(run, sc.invariants)
+    for v in violations:
+        print(f"VIOLATION {RULE_OF_INVARIANT[v.invariant]} "
+              f"[{v.invariant}] {v.message}")
+    if not violations:
+        print("slt-check: no invariant violated on this schedule")
+    return 1 if violations else 0
+
+
 def replay_schedule(schedule_id: str) -> int:
     """Re-execute one schedule bit-for-bit and re-assert its scenario's
     invariants — how a counterexample becomes a regression check."""
@@ -304,8 +400,24 @@ def replay_schedule(schedule_id: str) -> int:
     if ":" not in schedule_id:
         raise SystemExit(
             f"slt-check: bad schedule id {schedule_id!r} "
-            f"(want '<scenario>:<choices>')")
-    name, choices_text = schedule_id.split(":", 1)
+            f"(want '<scenario>:<choices>[@crash:<point>]')")
+    crash_at: Optional[int] = None
+    base_id = schedule_id
+    if "@crash:" in schedule_id:
+        base_id, crash_text = schedule_id.rsplit("@crash:", 1)
+        try:
+            crash_at = int(crash_text)
+        except ValueError:
+            raise SystemExit(f"slt-check: bad crash point {crash_text!r} "
+                             f"in {schedule_id!r}")
+    name, choices_text = base_id.split(":", 1)
+    from split_learning_tpu.analysis.scenarios import CRASH_SCENARIOS
+    if name in CRASH_SCENARIOS:
+        return _replay_crash_schedule(CRASH_SCENARIOS[name], name,
+                                      choices_text, crash_at)
+    if crash_at is not None:
+        raise SystemExit(f"slt-check: scenario {name} is not a crash "
+                         f"scenario, @crash: suffix invalid")
     scenarios = _check_scenarios(name)
     sc = scenarios[name]
     if not sc.available():
@@ -343,6 +455,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     check.add_argument("--check", action="store_true",
                        help="explore scenario schedules and assert the "
                             "SLT1xx invariants instead of linting")
+    check.add_argument("--crash", action="store_true",
+                       help="with --check: also explore the crash–restart "
+                            "scenarios (interleavings x crash points over "
+                            "the durable-store abstraction, SLT109-112)")
     check.add_argument("--budget", type=int, default=None,
                        help="per-scenario schedule budget override "
                             "(default: each scenario's own)")

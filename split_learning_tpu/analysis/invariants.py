@@ -277,6 +277,157 @@ def deferred_apply_exactly_once(run: Any) -> None:
             f"applies ran out of enqueue order: {applied} vs {expect}")
 
 
+# --------------------------------------------------------------------- #
+# crash–restart invariants (slt-crash) — read the ("crash", ...) marker
+# a CrashRun inserts between the killed workload and the recovery phase
+# --------------------------------------------------------------------- #
+
+def _split_crash(run: Any) -> Tuple[List[Tuple[str, Dict[str, Any]]],
+                                    List[Tuple[str, Dict[str, Any]]],
+                                    Dict[str, Any]]:
+    """Split ``run.notes`` at the first ``("crash", ...)`` marker into
+    (pre-crash notes, post-restart notes, marker fields). A run without
+    the marker (a plain interleaving) is all-pre."""
+    for i, (kind, fields) in enumerate(run.notes):
+        if kind == "crash":
+            return list(run.notes[:i]), list(run.notes[i + 1:]), dict(fields)
+    return list(run.notes), [], {}
+
+
+def _kinds(notes: List[Tuple[str, Dict[str, Any]]],
+           kind: str) -> List[Dict[str, Any]]:
+    return [fields for k, fields in notes if k == kind]
+
+
+def _key(f: Dict[str, Any]) -> Any:
+    k = f["key"]
+    return tuple(k) if isinstance(k, list) else k
+
+
+def durable_exactly_once(run: Any) -> None:
+    """No acked step is lost and none double-applied across a crash:
+    for every step the client sent, the update lands in the durable
+    timeline exactly once — either captured by the checkpoint the
+    recovery restored, or re-applied exactly once after restart (the
+    client replays steps past the restore point and retries its
+    in-flight step; a captured step's retry must be served from the
+    restored replay cache, not re-applied).
+
+    Notes read: pre ``c_sent(key)``; post ``c_apply(key)``; post
+    ``c_restore(step, lineage)``; pre ``c_commit(step, lineage,
+    captured=[keys...])``."""
+    pre, post, _ = _split_crash(run)
+    sent = {_key(f) for f in _kinds(pre, "c_sent")}
+    restores = _kinds(post, "c_restore")
+    restored = restores[-1] if restores else None
+    surviving: set = set()
+    if restored is not None and restored.get("step") is not None:
+        want = (restored["step"], restored.get("lineage"))
+        for f in _kinds(pre, "c_commit"):
+            if (f["step"], f.get("lineage")) == want:
+                surviving = {tuple(k) if isinstance(k, list) else k
+                             for k in f.get("captured", ())}
+    post_applies: Dict[Any, int] = {}
+    for f in _kinds(post, "c_apply"):
+        post_applies[_key(f)] = post_applies.get(_key(f), 0) + 1
+    for key, n in post_applies.items():
+        if n > 1:
+            raise Violation(
+                "durable_exactly_once", run.schedule_id,
+                f"step {key} applied {n} times after restart — the "
+                f"update double-applied")
+    for key in sorted(sent):
+        landed = (1 if key in surviving else 0) + post_applies.get(key, 0)
+        if landed == 0:
+            raise Violation(
+                "durable_exactly_once", run.schedule_id,
+                f"step {key} was sent but its update is in neither the "
+                f"restored checkpoint nor the post-restart applies — "
+                f"lost across the crash")
+        if landed > 1:
+            raise Violation(
+                "durable_exactly_once", run.schedule_id,
+                f"step {key} survived in the checkpoint AND re-applied "
+                f"after restart — double-applied "
+                f"(captured={key in surviving}, "
+                f"post={post_applies.get(key, 0)})")
+
+
+def checkpoint_atomicity(run: Any) -> None:
+    """A restore observes a committed checkpoint or nothing: never a
+    torn file, never a lineage that regressed, and exactly the newest
+    commit whose rename completed before the crash (commit notes are
+    emitted in the same scheduler slice as the rename, so the noted set
+    IS the durable set).
+
+    Notes read: pre ``c_commit(step, lineage)``; post
+    ``c_restore(step, lineage, torn)``."""
+    pre, post, _ = _split_crash(run)
+    commits = [(f["step"], f.get("lineage"))
+               for f in _kinds(pre, "c_commit")]
+    for a, b in zip(commits, commits[1:]):
+        if b <= a:
+            raise Violation(
+                "checkpoint_atomicity", run.schedule_id,
+                f"checkpoint lineage not strictly increasing: "
+                f"{a} then {b}")
+    for f in _kinds(post, "c_restore"):
+        if f.get("torn"):
+            raise Violation(
+                "checkpoint_atomicity", run.schedule_id,
+                f"recovery accepted a torn checkpoint at step "
+                f"{f.get('step')} — checksum/rename discipline broken")
+        got = (f.get("step"), f.get("lineage"))
+        want = max(commits) if commits else (None, None)
+        if got != want:
+            raise Violation(
+                "checkpoint_atomicity", run.schedule_id,
+                f"restore observed checkpoint {got}, newest durable "
+                f"commit was {want}")
+
+
+def replay_recovery_bit_identical(run: Any) -> None:
+    """A duplicate of an already-replied step, retried after restart,
+    is served the byte-identical reply from the restored replay cache —
+    never recomputed into a different value, never a miss for a step
+    the restored checkpoint captured.
+
+    Notes read: pre ``c_reply(key, value)``; post
+    ``c_replay_reply(key, value)``."""
+    pre, post, _ = _split_crash(run)
+    first: Dict[Any, Any] = {}
+    for f in _kinds(pre, "c_reply"):
+        first.setdefault(_key(f), f.get("value"))
+    for f in _kinds(post, "c_replay_reply"):
+        key = _key(f)
+        if key not in first:
+            raise Violation(
+                "replay_recovery_bit_identical", run.schedule_id,
+                f"restored replay cache served step {key} that was "
+                f"never replied before the crash")
+        if f.get("value") != first[key]:
+            raise Violation(
+                "replay_recovery_bit_identical", run.schedule_id,
+                f"step {key} replayed as {f.get('value')!r} after "
+                f"restart, original reply was {first[key]!r} — not "
+                f"bit-identical")
+
+
+def flush_before_save(run: Any) -> None:
+    """Checkpoint capture happens only after the deferred-apply queue
+    drained: a snapshot taken with updates still queued persists params
+    that are missing replies the server already shipped.
+
+    Notes read: ``c_save_capture(step, depth)`` (either phase)."""
+    for f in _notes(run, "c_save_capture"):
+        if f.get("depth", 0) != 0:
+            raise Violation(
+                "flush_before_save", run.schedule_id,
+                f"checkpoint at step {f.get('step')} captured with "
+                f"{f['depth']} deferred update(s) still queued — "
+                f"flush-before-save broken")
+
+
 INVARIANTS: Dict[str, Callable[[Any], None]] = {
     "deadlock_free": deadlock_free,
     "no_lost_wakeup": no_lost_wakeup,
@@ -287,6 +438,10 @@ INVARIANTS: Dict[str, Callable[[Any], None]] = {
     "admission_conservation": admission_conservation,
     "all_resolved": all_resolved,
     "deferred_apply_exactly_once": deferred_apply_exactly_once,
+    "durable_exactly_once": durable_exactly_once,
+    "checkpoint_atomicity": checkpoint_atomicity,
+    "replay_recovery_bit_identical": replay_recovery_bit_identical,
+    "flush_before_save": flush_before_save,
 }
 
 # --check findings flow through slt-lint's waiver/exit-code machinery;
@@ -302,6 +457,10 @@ RULE_OF_INVARIANT: Dict[str, str] = {
     "admission_conservation": "SLT106",
     "all_resolved": "SLT107",
     "deferred_apply_exactly_once": "SLT108",
+    "durable_exactly_once": "SLT109",
+    "checkpoint_atomicity": "SLT110",
+    "replay_recovery_bit_identical": "SLT111",
+    "flush_before_save": "SLT112",
 }
 
 

@@ -848,6 +848,142 @@ def check_slt013(src: Src) -> Iterator[Finding]:
 
 
 # ---------------------------------------------------------------------- #
+# SLT014: persistence discipline — runtime/ writes are crash-atomic
+# (Orbax or tmp-write+rename), and every exporter-written field has a
+# restorer that consumes it
+# ---------------------------------------------------------------------- #
+
+def _open_write_mode(node: ast.Call) -> Optional[str]:
+    """The mode string of a write-mode builtin ``open()`` call, else
+    None (read modes and non-constant modes pass)."""
+    if not (isinstance(node.func, ast.Name) and node.func.id == "open"):
+        return None
+    mode: Optional[str] = None
+    if (len(node.args) >= 2 and isinstance(node.args[1], ast.Constant)
+            and isinstance(node.args[1].value, str)):
+        mode = node.args[1].value
+    for kw in node.keywords:
+        if (kw.arg == "mode" and isinstance(kw.value, ast.Constant)
+                and isinstance(kw.value.value, str)):
+            mode = kw.value.value
+    if mode is not None and any(c in mode for c in "wax+"):
+        return mode
+    return None
+
+
+def _scope_renames(node: ast.AST) -> bool:
+    """Does this function/class body contain an ``os.replace``-style
+    atomic publish? Its presence marks the tmp-write+rename idiom."""
+    for n in ast.walk(node):
+        if (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+                and n.func.attr in ("replace", "rename")):
+            return True
+    return False
+
+
+class _Slt014Visitor(ast.NodeVisitor):
+    """Flags in-place durable writes inside runtime/: a bare write-mode
+    ``open()`` whose enclosing function or class never renames (a crash
+    mid-write leaves a torn file under the FINAL name — the exact bug
+    class slt-crash's DurableStore models worst-case), and the
+    path-taking serializers (np.save/pickle.dump) that cannot be made
+    atomic at the call site at all. Checkpoint state goes through Orbax
+    or the tmp-write+fsync+rename sidecar writer."""
+
+    def __init__(self, src: Src) -> None:
+        self.src = src
+        self.findings: List[Finding] = []
+        self._scopes: List[ast.AST] = []
+
+    def _visit_scope(self, node: Any) -> None:
+        self._scopes.append(node)
+        self.generic_visit(node)
+        self._scopes.pop()
+
+    visit_ClassDef = _visit_scope
+    visit_FunctionDef = _visit_scope
+    visit_AsyncFunctionDef = _visit_scope
+
+    def visit_Call(self, node: ast.Call) -> None:
+        mode = _open_write_mode(node)
+        if mode is not None and not any(_scope_renames(s)
+                                        for s in self._scopes):
+            self.findings.append(Finding(
+                "SLT014", self.src.path, node.lineno,
+                f"open(..., {mode!r}) writes a durable file in place — "
+                f"a crash mid-write leaves a torn file under the final "
+                f"name; write to a .tmp sibling and os.replace() it "
+                f"(or go through the Orbax checkpointer)"))
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            root = _call_root(f)
+            if ((root in ("np", "numpy")
+                 and f.attr in ("save", "savez", "savez_compressed"))
+                    or (root == "pickle" and f.attr == "dump")):
+                self.findings.append(Finding(
+                    "SLT014", self.src.path, node.lineno,
+                    f"{root}.{f.attr}() serializes straight onto its "
+                    f"target path — not crash-atomic; stage through a "
+                    f".tmp + os.replace() or the Orbax checkpointer"))
+        self.generic_visit(node)
+
+
+def check_slt014(src: Src) -> Iterator[Finding]:
+    if not _in_dir(src, "runtime"):
+        return
+    v = _Slt014Visitor(src)
+    v.visit(src.tree)
+    yield from v.findings
+
+
+def check_slt014_pairing(srcs) -> Iterator[Finding]:
+    """Cross-file half (PROJECT_RULES, like SLT010): every literal field
+    an exporter writes (``export_*``/``build_extras``/
+    ``finalize_extras`` in runtime/ + transport/) must be consumed by
+    some restore-side function (``*restore*``/``*resume*``/
+    ``*extras*``), and every field a restorer REQUIRES (subscript read)
+    must be written by some exporter — an unconsumed field is dead
+    checkpoint bytes, an unwritten required field is a KeyError on the
+    first real recovery."""
+    from split_learning_tpu.analysis import rules_jax as rj
+    writes: Dict[str, Tuple[str, int]] = {}
+    reads: Set[str] = set()
+    hard_reads: Dict[str, Tuple[str, int]] = {}
+    for src in srcs:
+        if not _in_dir(src, "runtime", "transport"):
+            continue
+        consts = rj._module_str_consts(src.tree)
+        for fn in ast.walk(src.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            exporter = (fn.name.startswith("export")
+                        or fn.name in ("build_extras", "finalize_extras"))
+            restorer = any(tok in fn.name
+                           for tok in ("restore", "resume", "extras"))
+            if exporter:
+                for k in rj._fn_writes(fn, consts):
+                    writes.setdefault(k, (src.path, fn.lineno))
+            if restorer:
+                reads |= rj._key_reads(fn, consts)
+                for k in rj._key_reads(fn, consts, hard_only=True):
+                    hard_reads.setdefault(k, (src.path, fn.lineno))
+    for k, (path, line) in sorted(writes.items()):
+        if k not in reads:
+            yield Finding(
+                "SLT014", path, line,
+                f"checkpoint field {k!r} is written by an exporter but "
+                f"consumed by no restore path — dead bytes in every "
+                f"checkpoint, or a restore that silently drops state")
+    for k, (path, line) in sorted(hard_reads.items()):
+        if k not in writes:
+            yield Finding(
+                "SLT014", path, line,
+                f"checkpoint field {k!r} is required (subscript read) "
+                f"by a restore path but written by no exporter — "
+                f"KeyError on the first real recovery")
+
+
+# ---------------------------------------------------------------------- #
 
 RULES = {
     "SLT001": (check_slt001,
@@ -872,6 +1008,9 @@ RULES = {
                "mesh-sharded program outputs cross D2H through the "
                "sanctioned per-shard gather, never raw "
                "np.asarray/jax.device_get"),
+    "SLT014": (check_slt014,
+               "runtime/ persistence is crash-atomic: Orbax or "
+               "tmp-write+rename, never in-place writes"),
 }
 
 
@@ -889,8 +1028,13 @@ from split_learning_tpu.analysis import rules_jax as _rules_jax  # noqa: E402
 RULES.update(_rules_jax.RULES)
 
 # Project rules see every parsed file at once (cross-file pairing);
-# the engine runs them after the per-file loop.
+# the engine runs them after the per-file loop. SLT014's cross-file
+# half (exporter/restorer field pairing) rides beside SLT010 here.
 PROJECT_RULES = dict(_rules_jax.PROJECT_RULES)
+PROJECT_RULES["SLT014"] = (
+    check_slt014_pairing,
+    "persistence contract: exporter-written checkpoint fields pair "
+    "with restore-side consumers across runtime/ + transport/")
 
 
 def run_project_rules(srcs) -> List[Finding]:

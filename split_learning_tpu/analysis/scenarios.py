@@ -36,7 +36,8 @@ import numpy as np
 
 from split_learning_tpu.analysis.sched import Ctx
 
-__all__ = ["Scenario", "SCENARIOS", "scenario"]
+__all__ = ["Scenario", "SCENARIOS", "scenario",
+           "CrashScenario", "CRASH_SCENARIOS", "crash_scenario"]
 
 
 @dataclass
@@ -514,3 +515,267 @@ def deferred_apply_storm(ctx: Ctx) -> Dict[str, Any]:
     dq.flush()  # end-of-run close(): everything must land
     ctx.note("da_final_depth", depth=dq.depth())
     return dict(dq.counters())
+
+# --------------------------------------------------------------------- #
+# crash–restart scenarios (slt-crash, SLT109–112)
+# --------------------------------------------------------------------- #
+
+@dataclass
+class CrashScenario:
+    """One registered crash–restart scenario: a workload
+    ``fn(ctx, store)`` the explorer kills at every sampled transition,
+    and a ``recover(ctx, store, pre_run)`` that rebuilds a server from
+    the DurableStore survivors and replays the client's uncertain
+    window. Explored by ``explore_crashes`` (budget = base
+    interleavings, crash_budget = killed replays of those bases)."""
+
+    name: str
+    workload: Callable[..., Optional[Dict[str, Any]]]
+    recover: Callable[..., Optional[Dict[str, Any]]]
+    invariants: Tuple[str, ...] = ()
+    budget: int = 12
+    crash_budget: int = 170
+    bound: Optional[int] = 2
+    requires: Optional[str] = None
+    doc: str = ""
+
+    def available(self) -> bool:
+        if self.requires == "jax":
+            try:
+                import jax  # noqa: F401
+                return True
+            except Exception:  # pragma: no cover — cpu image has jax
+                return False
+        return True
+
+
+CRASH_SCENARIOS: Dict[str, CrashScenario] = {}
+
+
+def crash_scenario(name: str, *, recover: Callable,
+                   invariants: Tuple[str, ...] = (),
+                   budget: int = 12, crash_budget: int = 170,
+                   bound: Optional[int] = 2,
+                   requires: Optional[str] = None) -> Callable:
+    def wrap(fn: Callable) -> Callable:
+        CRASH_SCENARIOS[name] = CrashScenario(
+            name=name, workload=fn, recover=recover,
+            invariants=invariants, budget=budget,
+            crash_budget=crash_budget, bound=bound, requires=requires,
+            doc=(fn.__doc__ or "").strip().splitlines()[0] if fn.__doc__
+            else "")
+        return fn
+    return wrap
+
+
+_CKPT_DIR = "ckpt"
+
+
+class _CrashRig:
+    """The server half the crash scenarios drive: a real ReplayCache,
+    an ``applied`` list standing in for the params, a monotonic
+    checkpoint lineage, and (optionally) a real _DeferredApply queue —
+    all synchronized by one runtime lock so the checkpoint capture is a
+    consistent cut: any step whose reply was resolved into the cache is
+    also in ``applied`` at capture time (apply/push and resolve happen
+    in the same lock hold; deferred queues are flushed under the lock
+    before the snapshot). That cut is what makes serving a post-restart
+    duplicate from the restored cache sound."""
+
+    def __init__(self, ctx: Ctx, deferred_lag: Optional[int] = None
+                 ) -> None:
+        from split_learning_tpu.obs import locks as obs_locks
+        from split_learning_tpu.runtime.replay import ReplayCache
+        self.ctx = ctx
+        self.lock = obs_locks.make_lock("CrashRig._lock")
+        self.cache = ReplayCache(window=16, max_total=128)
+        self.applied: list = []
+        self.lineage = 0
+        self.dq = None
+        if deferred_lag is not None:
+            from split_learning_tpu.runtime.server import _DeferredApply
+
+            def apply_fn(entry: Dict[str, Any]) -> None:
+                ctx.note("c_apply", key=entry["key"])
+                self.applied.append(entry["key"])
+
+            self.dq = _DeferredApply(apply_fn, deferred_lag, self.lock)
+
+    def handle(self, cid: int, op: str, step: int) -> Any:
+        """One delivery of one step: claim, apply (direct or via the
+        deferred queue), resolve — duplicates wait on the in-flight
+        future or hit the done entry."""
+        key = (cid, op, step)
+        entry, owner = self.cache.begin(*key)
+        if owner:
+            body = f"r:{cid}:{op}:{step}".encode("utf-8")
+            with self.lock:
+                if self.dq is not None:
+                    # reply-first: the update queues, the reply ships
+                    self.dq.push({"key": key})
+                    self.dq.drain_over_lag()
+                else:
+                    self.ctx.step("apply")
+                    self.ctx.note("c_apply", key=key)
+                    self.applied.append(key)
+                # resolve inside the same hold as the apply/push: the
+                # checkpoint capture must never see a resolved reply
+                # whose update it did not also capture
+                self.cache.resolve(entry, f"r:{cid}:{op}:{step}")
+                self.cache.attach_body(cid, op, step, body)
+            return entry.result
+        return self.cache.wait(entry, timeout=30.0)
+
+    def client(self, cid: int, steps: Tuple[int, ...],
+               op: str = "split_step") -> None:
+        """The client protocol: send, receive, ack — with a wire yield
+        between reply and ack so a crash can strand a replied-but-
+        unacked step."""
+        for step in steps:
+            key = (cid, op, step)
+            self.ctx.note("c_sent", key=key)
+            value = self.handle(cid, op, step)
+            self.ctx.note("c_reply", key=key, value=value)
+            self.ctx.step("wire")
+            self.ctx.note("c_ack", key=key)
+
+    def checkpoint(self, store: Any, step: int) -> None:
+        """Flush-deferred-then-capture under the lock, publish via the
+        real tmp+fsync+rename writer outside it, note the commit in the
+        same slice as the rename (no yield between — the noted commit
+        set IS the durable set)."""
+        from split_learning_tpu.runtime.checkpoint import (
+            EXTRAS_VERSION, encode_obj, finalize_extras, write_extras)
+        with self.lock:
+            if self.dq is not None:
+                self.dq.flush()
+            depth = self.dq.depth() if self.dq is not None else 0
+            self.ctx.note("c_save_capture", step=step, depth=depth)
+            self.lineage += 1
+            lineage = self.lineage
+            captured = list(self.applied)
+            payload = finalize_extras({
+                "version": EXTRAS_VERSION, "step": int(step),
+                "lineage": lineage,
+                "replay": encode_obj(self.cache.export_state()),
+                "state": encode_obj(captured)})
+        write_extras(_CKPT_DIR, payload, fs=store)
+        self.ctx.note("c_commit", step=step, lineage=lineage,
+                      captured=captured)
+
+    def flush(self) -> None:
+        if self.dq is not None:
+            with self.lock:
+                self.dq.flush()
+
+
+def _crash_recover(deferred_lag: Optional[int] = None) -> Callable:
+    """Build the shared recovery protocol: restore the newest durable
+    checkpoint (replay cache + captured set), then replay the client's
+    uncertain window — every sent step not in the captured set is
+    retried (it must re-apply exactly once); every captured step is
+    retried too and must be absorbed by the restored replay cache, its
+    reply bit-identical for steps the client already acked."""
+    def recover(ctx: Ctx, store: Any, pre: Any) -> Dict[str, Any]:
+        from split_learning_tpu.runtime.checkpoint import (
+            decode_obj, read_latest_extras)
+        payload = read_latest_extras(_CKPT_DIR, fs=store)
+        rig = _CrashRig(ctx, deferred_lag=deferred_lag)
+        captured: set = set()
+        if payload is None:
+            ctx.note("c_restore", step=None, lineage=None, torn=False)
+        else:
+            ctx.note("c_restore", step=payload["step"],
+                     lineage=payload["lineage"], torn=False)
+            rig.cache.restore_state(decode_obj(payload["replay"]))
+            captured = set(decode_obj(payload["state"]))
+            rig.lineage = payload["lineage"]
+        sent: list = []
+        acked: set = set()
+        for kind, f in pre.notes:
+            if kind == "c_sent":
+                sent.append(tuple(f["key"]))
+            elif kind == "c_ack":
+                acked.add(tuple(f["key"]))
+        for key in sent:
+            value = rig.handle(*key)
+            if key in captured and key in acked:
+                ctx.note("c_replay_reply", key=key, value=value)
+        rig.flush()
+        return {"restored_step": payload["step"] if payload else None,
+                "replayed": len(sent)}
+    return recover
+
+
+@crash_scenario("crash_replay_dup_storm",
+                recover=_crash_recover(),
+                invariants=("durable_exactly_once",
+                            "checkpoint_atomicity",
+                            "replay_recovery_bit_identical"),
+                budget=12, crash_budget=170, bound=2, requires="jax")
+def crash_replay_dup_storm(ctx: Ctx, store: Any) -> Dict[str, Any]:
+    """Two clients and a duplicate delivery race one mid-run checkpoint;
+    a crash at any transition must lose no acked step, double-apply
+    none, and serve post-restart duplicates the byte-identical reply."""
+    rig = _CrashRig(ctx)
+
+    def dup() -> None:
+        ctx.step("wire")  # the retransmit window
+        rig.handle(0, "split_step", 1)
+
+    workers = [ctx.spawn(rig.client, 0, (1, 2), name="cl-0"),
+               ctx.spawn(rig.client, 1, (1,), name="cl-1"),
+               ctx.spawn(dup, name="dup"),
+               ctx.spawn(rig.checkpoint, store, 1, name="ckptr")]
+    for w in workers:
+        w.join()
+    rig.checkpoint(store, 2)
+    return {"applied": len(rig.applied)}
+
+
+@crash_scenario("crash_deferred_queue",
+                recover=_crash_recover(deferred_lag=1),
+                invariants=("durable_exactly_once",
+                            "checkpoint_atomicity",
+                            "replay_recovery_bit_identical",
+                            "flush_before_save"),
+                budget=12, crash_budget=170, bound=2, requires="jax")
+def crash_deferred_queue(ctx: Ctx, store: Any) -> Dict[str, Any]:
+    """Reply-first decoupled backward under crashes: replies ship while
+    weight updates sit in the deferred queue (lag=1), a checkpoint
+    races the stream — the capture must flush the queue first, and a
+    crash that vaporizes queued updates must be healed by the client's
+    replay, never by a double-apply."""
+    rig = _CrashRig(ctx, deferred_lag=1)
+
+    workers = [ctx.spawn(rig.client, 0, (1, 2, 3), name="cl-0"),
+               ctx.spawn(rig.checkpoint, store, 1, name="ckptr")]
+    for w in workers:
+        w.join()
+    rig.flush()
+    rig.checkpoint(store, 3)
+    return {"applied": len(rig.applied)}
+
+
+@crash_scenario("crash_ckpt_race",
+                recover=_crash_recover(),
+                invariants=("durable_exactly_once",
+                            "checkpoint_atomicity",
+                            "replay_recovery_bit_identical"),
+                budget=12, crash_budget=170, bound=2, requires="jax")
+def crash_ckpt_race(ctx: Ctx, store: Any) -> Dict[str, Any]:
+    """Back-to-back checkpoints race a two-step client: crash points
+    inside the tmp-write/fsync/rename sequence must leave either the
+    old or the new sidecar fully intact (never a torn one accepted),
+    with the restore observing exactly the newest committed lineage."""
+    rig = _CrashRig(ctx)
+
+    def ckptr() -> None:
+        rig.checkpoint(store, 1)
+        rig.checkpoint(store, 2)
+
+    workers = [ctx.spawn(rig.client, 0, (1, 2), name="cl-0"),
+               ctx.spawn(ckptr, name="ckptr")]
+    for w in workers:
+        w.join()
+    return {"applied": len(rig.applied)}

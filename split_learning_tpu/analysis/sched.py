@@ -48,6 +48,8 @@ __all__ = [
     "SchedAbort", "ScheduleError", "Scheduler", "Ctx", "Run",
     "run_schedule", "explore", "ExploreResult",
     "encode_choices", "decode_choices", "install",
+    "DurableStore", "CrashRun", "run_crash_schedule", "explore_crashes",
+    "CrashExploreResult",
 ]
 
 # scheduling decisions -> trace-id characters; thread ids index into
@@ -336,12 +338,18 @@ class Scheduler:
                  sleep_plan: Tuple[FrozenSet[int], ...] = (),
                  bound: Optional[int] = None,
                  rand: Any = None,
-                 max_transitions: int = 50_000) -> None:
+                 max_transitions: int = 50_000,
+                 crash_at: Optional[int] = None) -> None:
         self.forced = tuple(forced)
         self.sleep_plan = tuple(sleep_plan)
         self.bound = bound
         self.rand = rand
         self.max_transitions = max_transitions
+        # crash injection (slt-crash): kill the simulated process once
+        # this many transitions have executed — every thread dies at its
+        # next yield point, nothing else of the run survives
+        self.crash_at = crash_at
+        self.crashed = False
 
         self.clock = VirtualClock(self)
         self.factory = _Factory(self)
@@ -652,6 +660,13 @@ class Scheduler:
                 if any(t.state == "running" for t in self.threads):
                     continue  # someone still mid-slice; wait again
                 if root.state == "finished":
+                    return
+                if (self.crash_at is not None and not self.crashed
+                        and self.transitions >= self.crash_at):
+                    # the crash point: stop granting slices and let the
+                    # finally-teardown abort every thread — in-memory
+                    # state is gone, only DurableStore survivors remain
+                    self.crashed = True
                     return
                 if self.transitions >= self.max_transitions:
                     raise ScheduleError(
@@ -1070,4 +1085,364 @@ def explore(scenario_name: str,
                               tuple(child_plan)))
                 newly.append(alt)
     res.exhausted = True
+    return res
+
+
+# --------------------------------------------------------------------- #
+# crash–restart model checking (slt-crash)
+# --------------------------------------------------------------------- #
+
+class DurableStore:
+    """The checkpoint-directory abstraction that survives a crash.
+
+    Duck-types the fs seam ``runtime/checkpoint.py``'s extras writer
+    takes (``put``/``fsync``/``rename``/``listdir``/``read``), so the
+    REAL tmp-write + fsync + rename code path runs under the explorer.
+    Every mutating op is a yield point (same-path ops share a step
+    token, so sleep sets see their dependence), and ``put`` is two
+    transitions — a crash between them models a half-written file.
+
+    Crash semantics are the deterministic worst case: content that was
+    fsynced (and not overwritten since) survives intact; anything else
+    survives TORN — a prefix of the in-flight bytes, the adversarial
+    "some of it hit the disk" outcome. ``rename`` is atomic (journaled
+    metadata), but renaming an un-fsynced file carries the torn risk
+    with it — exactly the missing-fsync bug class."""
+
+    def __init__(self) -> None:
+        # path -> {"content": live bytes-as-str, "durable": last fsynced}
+        self._files: Dict[str, Dict[str, Optional[str]]] = {}
+        self._sched: Optional[Scheduler] = None
+
+    def bind(self, sched: Optional[Scheduler]) -> None:
+        """Attach to the scheduler driving the current phase (the store
+        itself outlives schedulers — that is the point)."""
+        self._sched = sched
+
+    def _yield(self, kind: str, path: str) -> None:
+        s = self._sched
+        if s is None:
+            return
+        ts = s.current()
+        if ts is None:
+            return
+        oid = s.step_token(f"fs:{path}")
+        s._park(ts, (kind, oid))
+        s._perform(ts, kind, oid)
+
+    # -- mutating ops (each a crash-point-eligible transition) ---------- #
+    def put(self, path: str, text: str) -> None:
+        self._yield("fs_put_begin", path)
+        f = self._files.setdefault(path, {"content": None, "durable": None})
+        f["content"] = text[: max(1, len(text) // 2)]  # torn window
+        self._yield("fs_put_commit", path)
+        f["content"] = text
+
+    def fsync(self, path: str) -> None:
+        self._yield("fs_fsync", path)
+        f = self._files.get(path)
+        if f is None:
+            raise OSError(f"fsync of missing file: {path}")
+        f["durable"] = f["content"]
+
+    def rename(self, src: str, dst: str) -> None:
+        self._yield("fs_rename", src)
+        f = self._files.pop(src, None)
+        if f is None:
+            raise OSError(f"rename of missing file: {src}")
+        self._files[dst] = f
+
+    # -- read surface (free, like clock reads) -------------------------- #
+    def listdir(self, directory: str) -> List[str]:
+        prefix = directory.rstrip("/") + "/"
+        return sorted({p[len(prefix):] for p in self._files
+                       if p.startswith(prefix)
+                       and "/" not in p[len(prefix):]})
+
+    def read(self, path: str) -> str:
+        f = self._files.get(path)
+        if f is None or f["content"] is None:
+            raise OSError(f"no such durable file: {path}")
+        return f["content"]
+
+    def exists(self, path: str) -> bool:
+        return path in self._files
+
+    # ------------------------------------------------------------------ #
+    def crash(self) -> None:
+        """Collapse to the post-crash disk image, in place."""
+        survivors: Dict[str, Dict[str, Optional[str]]] = {}
+        for path, f in self._files.items():
+            content = f["content"]
+            if content is None:
+                continue
+            if content == f["durable"]:
+                survivors[path] = {"content": content, "durable": content}
+            else:
+                half = content[: len(content) // 2]
+                survivors[path] = {"content": half, "durable": half}
+        self._files = survivors
+        self._sched = None
+
+
+class CrashRun:
+    """One crash–restart schedule: a workload phase, killed at
+    ``crash_at`` transitions (or run to completion for the
+    clean-restart path), then a recovery phase on a FRESH scheduler
+    over the surviving DurableStore. Duck-types :class:`Run` for the
+    invariant checkers; ``notes`` carries a ``("crash", {...})`` marker
+    between the phases so invariants can split pre from post."""
+
+    def __init__(self, scenario: str, pre: Run, post: Optional[Run],
+                 crash_at: Optional[int], crashed: bool,
+                 id_choices: Tuple[int, ...]) -> None:
+        self.scenario = scenario
+        self.pre = pre
+        self.post = post
+        self.crash_at = crash_at
+        self.crashed = crashed
+        self.state = dict(pre.state)
+        self.error = pre.error
+        self.notes = list(pre.notes)
+        self.notes.append(("crash", {"at": crash_at, "clean": not crashed}))
+        marker = "crash" if crashed else "restart"
+        self.trace = (list(pre.trace)
+                      + [(-1, marker, f"@{crash_at}" if crashed
+                          else "@clean")])
+        # the base schedule's full choices, not pre's (possibly
+        # crash-truncated) recording: replaying the id must re-force the
+        # SAME base interleaving up to the crash point
+        self.decisions = tuple(id_choices)
+        self.points = pre.points
+        self.pruned = pre.pruned
+        self.deadlock = pre.deadlock
+        self.stalled = pre.stalled
+        self.leaked = list(pre.leaked)
+        self.transitions = pre.transitions
+        self.preemptions = pre.preemptions
+        self.thread_errors = list(pre.thread_errors)
+        if post is not None:
+            self.state.update(post.state)
+            self.error = self.error or post.error
+            self.notes.extend(post.notes)
+            self.trace.extend(post.trace)
+            self.deadlock = self.deadlock or post.deadlock
+            self.stalled = self.stalled or post.stalled
+            self.leaked.extend(post.leaked)
+            self.transitions += post.transitions
+            self.thread_errors.extend(post.thread_errors)
+
+    @property
+    def schedule_id(self) -> str:
+        base = f"{self.scenario}:{encode_choices(self.decisions)}"
+        if self.crash_at is None:
+            return base
+        return f"{base}@crash:{self.crash_at}"
+
+    def trace_fingerprint(self) -> str:
+        """Both phases plus the crash marker — bit-for-bit replay means
+        equal fingerprints across the whole crash–restart schedule."""
+        h = hashlib.sha256()
+        for tid, kind, obj in self.trace:
+            h.update(f"{tid}|{kind}|{obj}\n".encode())
+        return h.hexdigest()[:16]
+
+
+def run_crash_schedule(scenario_name: str,
+                       workload_fn: Callable[..., Optional[Dict[str, Any]]],
+                       recover_fn: Callable[..., Optional[Dict[str, Any]]],
+                       *, forced: Tuple[int, ...] = (),
+                       sleep_plan: Tuple[FrozenSet[int], ...] = (),
+                       bound: Optional[int] = None,
+                       crash_at: Optional[int] = None,
+                       store: Optional[DurableStore] = None) -> CrashRun:
+    """Execute one crash–restart schedule.
+
+    Phase 1 runs ``workload_fn(ctx, store)`` under ``forced``/
+    ``sleep_plan``/``bound`` with the crash injected after ``crash_at``
+    transitions (None: run to completion — the clean-restart path).
+    The store then collapses to its post-crash image (no-op on a clean
+    exit), and phase 2 runs ``recover_fn(ctx, store, pre_run)`` on a
+    fresh scheduler under the DEFAULT deterministic schedule — so a
+    crash schedule is fully determined by (choices, crash point) and
+    its id ``scenario:<choices>@crash:<point>`` replays bit-for-bit."""
+    store = store if store is not None else DurableStore()
+    sched = Scheduler(forced=forced, sleep_plan=sleep_plan, bound=bound,
+                      crash_at=crash_at)
+    store.bind(sched)
+    result: Dict[str, Any] = {}
+    error: List[Optional[BaseException]] = [None]
+
+    def main() -> None:
+        ctx = Ctx(sched)
+        try:
+            out = workload_fn(ctx, store)
+            if out:
+                result.update(out)
+        except SchedAbort:
+            raise
+        except BaseException as exc:  # noqa: BLE001 — surfaced on Run
+            error[0] = exc
+
+    with install(sched):
+        sched.run(main)
+    pre = Run(scenario_name, sched, result, error[0])
+    crashed = sched.crashed
+    if crashed:
+        # threads died mid-op by design; their aborts are not errors,
+        # and a workload killed mid-wait is neither deadlocked nor
+        # stalled — recovery decides whether anything was LOST
+        pre.error = None
+        pre.thread_errors = []
+        store.crash()
+    id_choices = tuple(forced) if crash_at is not None else pre.decisions
+    if pre.pruned is not None:
+        return CrashRun(scenario_name, pre, None, crash_at, crashed,
+                        id_choices)
+
+    sched2 = Scheduler()
+    store.bind(sched2)
+    result2: Dict[str, Any] = {}
+    error2: List[Optional[BaseException]] = [None]
+
+    def main2() -> None:
+        ctx2 = Ctx(sched2)
+        try:
+            out = recover_fn(ctx2, store, pre)
+            if out:
+                result2.update(out)
+        except SchedAbort:
+            raise
+        except BaseException as exc:  # noqa: BLE001 — surfaced on Run
+            error2[0] = exc
+
+    with install(sched2):
+        sched2.run(main2)
+    post = Run(scenario_name, sched2, result2, error2[0])
+    return CrashRun(scenario_name, pre, post, crash_at, crashed,
+                    id_choices)
+
+
+class CrashExploreResult:
+    def __init__(self, scenario: str) -> None:
+        self.scenario = scenario
+        self.schedule_ids: List[str] = []
+        self.pruned = 0
+        self.exhausted = False    # base-interleaving DFS emptied
+        self.bases = 0            # distinct base interleavings
+        self.crash_schedules = 0  # (base, crash point) schedules run
+        self.max_preemptions = 0
+        self.max_transitions = 0
+        self.runs_with_errors = 0
+        self.sample: Dict[str, str] = {}  # schedule_id -> fingerprint
+
+    @property
+    def schedules(self) -> int:
+        return len(self.schedule_ids)
+
+    def summary(self) -> Dict[str, Any]:
+        explored = self.schedules
+        total = explored + self.pruned
+        return {
+            "schedules": explored,
+            "pruned": self.pruned,
+            "pruning_ratio": (self.pruned / total) if total else 0.0,
+            "exhausted": self.exhausted,
+            "max_preemptions": self.max_preemptions,
+            "max_transitions": self.max_transitions,
+            "bases": self.bases,
+            "crash_schedules": self.crash_schedules,
+        }
+
+
+def explore_crashes(scenario_name: str,
+                    workload_fn: Callable[..., Optional[Dict[str, Any]]],
+                    recover_fn: Callable[..., Optional[Dict[str, Any]]],
+                    *, budget: int = 40,
+                    bound: Optional[int] = 3,
+                    crash_budget: int = 200,
+                    on_run: Optional[Callable[[CrashRun], None]] = None
+                    ) -> CrashExploreResult:
+    """Interleavings × crash points, deterministically.
+
+    Stage 1 DFS-explores up to ``budget`` base interleavings of the
+    workload (each also runs the clean-restart recovery — the crash-off
+    durability check). Stage 2 replays each base with the crash
+    injected at transition points spread evenly over the base's length,
+    ``crash_budget`` schedules in total. ``on_run`` sees every
+    completed CrashRun — the invariant hook."""
+    res = CrashExploreResult(scenario_name)
+    seen: set = set()
+
+    def finish(crun: CrashRun) -> None:
+        sid = crun.schedule_id
+        if sid in seen:
+            return
+        seen.add(sid)
+        res.schedule_ids.append(sid)
+        res.max_preemptions = max(res.max_preemptions, crun.preemptions)
+        res.max_transitions = max(res.max_transitions, crun.transitions)
+        if crun.error is not None or crun.thread_errors:
+            res.runs_with_errors += 1
+        if len(res.sample) < 4:
+            res.sample[sid] = crun.trace_fingerprint()
+        if on_run is not None:
+            on_run(crun)
+
+    # stage 1: base interleavings (same DFS + sleep sets as explore())
+    bases: List[Tuple[Tuple[int, ...], Tuple[FrozenSet[int], ...], int]] = []
+    stack: List[Tuple[Tuple[int, ...], Tuple[FrozenSet[int], ...]]] = [
+        ((), ())]
+    while stack:
+        if len(bases) >= budget:
+            break
+        forced, sleep_plan = stack.pop()
+        crun = run_crash_schedule(scenario_name, workload_fn, recover_fn,
+                                  forced=forced, sleep_plan=sleep_plan,
+                                  bound=bound, crash_at=None)
+        if crun.pruned is not None:
+            res.pruned += 1
+        else:
+            bases.append((crun.decisions, sleep_plan,
+                          crun.pre.transitions))
+            finish(crun)
+        for j in range(len(forced), len(crun.pre.decisions)):
+            pt = crun.points[j]
+            chosen = pt["chosen"]
+            slept = set(pt["sleeping"])
+            newly = [chosen]
+            for alt in pt["schedulable"]:
+                if alt == chosen or alt in slept:
+                    continue
+                child_plan = list(sleep_plan)
+                while len(child_plan) < j:
+                    child_plan.append(frozenset())
+                child_plan.append(frozenset(newly))
+                stack.append((tuple(crun.pre.decisions[:j]) + (alt,),
+                              tuple(child_plan)))
+                newly.append(alt)
+    res.exhausted = not stack
+    res.bases = len(bases)
+
+    # stage 2: crash points, spread evenly across each base's length
+    if bases:
+        per_base = max(1, -(-crash_budget // len(bases)))  # ceil
+        for decisions, sleep_plan, ntrans in bases:
+            if res.crash_schedules >= crash_budget:
+                break
+            if ntrans <= 1:
+                continue
+            stride = max(1, -(-(ntrans - 1) // per_base))
+            for k in range(1, ntrans, stride):
+                if res.crash_schedules >= crash_budget:
+                    break
+                crun = run_crash_schedule(
+                    scenario_name, workload_fn, recover_fn,
+                    forced=decisions, sleep_plan=sleep_plan, bound=bound,
+                    crash_at=k)
+                res.crash_schedules += 1
+                if crun.pruned is not None:
+                    res.pruned += 1
+                else:
+                    finish(crun)
     return res

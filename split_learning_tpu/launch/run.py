@@ -24,6 +24,7 @@ every ``--checkpoint-every`` steps on the fused/pipeline paths);
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import sys
@@ -31,6 +32,19 @@ import time
 from typing import Any, Dict, Optional
 
 import numpy as np
+
+
+@contextlib.contextmanager
+def _ckpt_drain(ckptr):
+    """Barrier on in-flight async checkpoint saves on EVERY exit path.
+    save()/save_once() enqueue background Orbax writes; a mid-epoch
+    exception that skips the success-path wait_until_finished() would
+    let interpreter teardown tear the newest checkpoint on disk."""
+    try:
+        yield
+    finally:
+        if ckptr is not None:
+            ckptr.wait_until_finished()
 
 
 def _add_common(p: argparse.ArgumentParser) -> None:
@@ -291,7 +305,8 @@ def cmd_train(args) -> int:
     from split_learning_tpu.runtime import (
         FederatedClientTrainer, ServerRuntime, SplitClientTrainer,
         USplitClientTrainer)
-    from split_learning_tpu.runtime.checkpoint import Checkpointer
+    from split_learning_tpu.runtime.checkpoint import (
+        Checkpointer, read_latest_extras, write_extras)
     from split_learning_tpu.transport import LocalTransport
     from split_learning_tpu.utils import Config
 
@@ -572,7 +587,7 @@ def cmd_train(args) -> int:
         lr_fn = make_lr(cfg)
         if not callable(lr_fn):
             lr_fn = None
-        with trace_ctx:
+        with _ckpt_drain(ckptr), trace_ctx:
             for epoch in range(cfg.epochs):  # step cap enforced by data_iter
                 if can_scan:
                     # chunk T batches into one lax.scan dispatch; the
@@ -767,8 +782,15 @@ def cmd_train(args) -> int:
                     client.state = tree["client"]
                 if server is not None:
                     # re-arms the step handshake: every client must resume
-                    # at or after the restored step (runtime/server.py)
-                    server.resume_from(tree["server"], latest)
+                    # at or after the restored step (runtime/server.py).
+                    # The extras sidecar — replay cache + EF residuals —
+                    # restores with it when one was written for this
+                    # exact step; otherwise resume_from falls back to
+                    # clearing both (stale-lineage rejection)
+                    server.resume_from(
+                        tree["server"], latest,
+                        extras=read_latest_extras(ckptr.directory,
+                                                  step=latest))
                 start_step = latest
                 print(f"[ckpt] resumed at step {start_step} from "
                       f"{cfg.checkpoint_dir}", file=sys.stderr)
@@ -791,7 +813,13 @@ def cmd_train(args) -> int:
 
         def on_epoch_end(epoch: int, next_step: int) -> None:
             if ckptr is not None:
-                ckptr.save_once(next_step, party_tree())
+                if ckptr.save_once(next_step, party_tree()) \
+                        and server is not None:
+                    # the runtime-extras sidecar rides beside every Orbax
+                    # save: one small JSON, written tmp+fsync+rename so a
+                    # crash can never leave a readable half-file
+                    write_extras(ckptr.directory,
+                                 server.export_runtime_extras(next_step))
 
         prefetch = getattr(args, "prefetch", 0) or 0
         if prefetch > 0 and cfg.mode != "split":
@@ -810,6 +838,11 @@ def cmd_train(args) -> int:
         finally:
             if hasattr(client, "close"):  # pipelined: join lanes + conns
                 client.close()
+            if ckptr is not None:
+                # saves are async — barrier on them even when an epoch
+                # raises, or the newest checkpoint on disk can be an
+                # in-flight write torn by interpreter teardown
+                ckptr.wait_until_finished()
         n_steps = len(records)
         final_loss = records[-1].loss if records else float("nan")
         # pipelined client: its .stats merges every lane's transport —
@@ -888,7 +921,8 @@ def cmd_serve(args) -> int:
 
     from split_learning_tpu.models import get_plan
     from split_learning_tpu.runtime import ServerRuntime
-    from split_learning_tpu.runtime.checkpoint import Checkpointer
+    from split_learning_tpu.runtime.checkpoint import (
+        Checkpointer, read_latest_extras, write_extras)
     from split_learning_tpu.transport.http import SplitHTTPServer
 
     from split_learning_tpu.data.datasets import _SHAPES
@@ -1042,7 +1076,10 @@ def cmd_serve(args) -> int:
                                   "a client whose server was remote)",
                                   file=sys.stderr)
                             return 2
-                        runtime.resume_from(tree["server"], root_latest)
+                        runtime.resume_from(
+                            tree["server"], root_latest,
+                            extras=read_latest_extras(cfg.checkpoint_dir,
+                                                      step=root_latest))
                     print(f"[ckpt] server resumed at step {root_latest} "
                           f"from joint {cfg.checkpoint_dir} "
                           f"(layout {layout or 'split_local'})",
@@ -1052,7 +1089,12 @@ def cmd_serve(args) -> int:
                 root.close()
         if args.resume and latest is not None:
             tree = ckptr.restore({"server": runtime.state})
-            runtime.resume_from(tree["server"], latest)
+            # sidecar restore: replay cache + EF residuals come back iff
+            # an extras file was written for exactly this step (anything
+            # stale is rejected and resume_from clears instead)
+            runtime.resume_from(
+                tree["server"], latest,
+                extras=read_latest_extras(ckptr.directory, step=latest))
             print(f"[ckpt] server resumed at step {latest} from "
                   f"{ckptr.directory}", file=sys.stderr)
 
@@ -1067,8 +1109,14 @@ def cmd_serve(args) -> int:
             # flush only dispatches async jitted calls, so it is safe
             # under the lock this hook already holds.
             if (step + 1) % every == 0:
-                ckptr.save_once(step + 1,
-                                {"server": runtime.export_state()})
+                if ckptr.save_once(step + 1,
+                                   {"server": runtime.export_state()}):
+                    # one small JSON beside the (async) Orbax save: the
+                    # replay cache + EF residuals a restart needs to keep
+                    # duplicate delivery exactly-once. tmp+fsync+rename,
+                    # so no crash point leaves a readable half-file.
+                    write_extras(ckptr.directory,
+                                 runtime.export_runtime_extras(step + 1))
 
         runtime.on_step = on_step
 

@@ -15,10 +15,14 @@ silently desyncs the halves (SURVEY.md §5 "Checkpoint / resume" and
 
 from __future__ import annotations
 
+import base64
+import hashlib
+import json
 import os
 from typing import Any, Dict, Optional
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
 
@@ -134,6 +138,195 @@ class Checkpointer:
     def close(self) -> None:
         self._mgr.wait_until_finished()
         self._mgr.close()
+
+
+# --------------------------------------------------------------------- #
+# Runtime-extras sidecar: the server state Orbax does NOT carry — the
+# replay cache (exactly-once across a restart) and the topk8 EF residual
+# ledger (compression state that must migrate with the party). One JSON
+# file per save, lineage-stamped and checksummed, written with the
+# tmp-write + fsync + rename idiom so a crash at any point leaves either
+# the previous extras or the new one — never a readable half-file.
+#
+# The filesystem is injectable (``fs=``): slt-crash (analysis/sched.py
+# DurableStore) drives these exact functions through its crash-point
+# explorer, so the idiom is model-checked, not just convention.
+# --------------------------------------------------------------------- #
+
+EXTRAS_VERSION = 1
+_EXTRAS_PREFIX = "extras-"
+_EXTRAS_SUFFIX = ".json"
+
+
+def encode_obj(obj: Any) -> Any:
+    """Tagged JSON-able encoding: ndarrays (b64, bit-exact), bytes,
+    tuples, and non-str-keyed dicts all round-trip through
+    :func:`decode_obj`. Raises TypeError on anything else."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, (bytes, bytearray)):
+        return {"__b64__": base64.b64encode(bytes(obj)).decode("ascii")}
+    if isinstance(obj, tuple):
+        return {"__tup__": [encode_obj(v) for v in obj]}
+    if isinstance(obj, list):
+        return [encode_obj(v) for v in obj]
+    if isinstance(obj, dict):
+        if all(isinstance(k, str) and not k.startswith("__") for k in obj):
+            return {k: encode_obj(v) for k, v in obj.items()}
+        return {"__kvs__": [[encode_obj(k), encode_obj(v)]
+                            for k, v in obj.items()]}
+    if isinstance(obj, np.generic):
+        return encode_obj(obj.item())
+    arr = np.asarray(obj)  # ndarray, or a jax array materialized to host
+    if arr.dtype == object:
+        raise TypeError(f"cannot encode {type(obj).__name__} into extras")
+    arr = np.ascontiguousarray(arr)
+    return {"__nd__": {"dtype": str(arr.dtype), "shape": list(arr.shape),
+                       "b64": base64.b64encode(arr.tobytes())
+                                    .decode("ascii")}}
+
+
+def decode_obj(obj: Any) -> Any:
+    """Inverse of :func:`encode_obj`."""
+    if isinstance(obj, list):
+        return [decode_obj(v) for v in obj]
+    if isinstance(obj, dict):
+        if "__b64__" in obj:
+            return base64.b64decode(obj["__b64__"])
+        if "__tup__" in obj:
+            return tuple(decode_obj(v) for v in obj["__tup__"])
+        if "__kvs__" in obj:
+            return {decode_obj(k): decode_obj(v) for k, v in obj["__kvs__"]}
+        if "__nd__" in obj:
+            nd = obj["__nd__"]
+            raw = base64.b64decode(nd["b64"])
+            return np.frombuffer(raw, dtype=np.dtype(nd["dtype"])) \
+                     .reshape(nd["shape"]).copy()
+        return {k: decode_obj(v) for k, v in obj.items()}
+    return obj
+
+
+def _canonical(payload: Any) -> str:
+    return json.dumps(payload, sort_keys=True, separators=(",", ":"))
+
+
+def build_extras(step: int, lineage: int, *, replay: Any = None,
+                 wire_ef: Any = None) -> Dict[str, Any]:
+    """Assemble + checksum one extras payload. ``replay`` / ``wire_ef``
+    are the raw ``export_state()`` outputs (encoded here); ``lineage``
+    is the writer's monotonic commit counter — a restore whose sidecar
+    step does not match the restored Orbax step is stale and rejected
+    (``read_latest_extras(step=...)``)."""
+    payload: Dict[str, Any] = {"version": EXTRAS_VERSION,
+                               "step": int(step), "lineage": int(lineage)}
+    if replay is not None:
+        payload["replay"] = encode_obj(replay)
+    if wire_ef is not None:
+        payload["wire_ef"] = encode_obj(wire_ef)
+    return finalize_extras(payload)
+
+
+def finalize_extras(payload: Dict[str, Any]) -> Dict[str, Any]:
+    """Stamp the sha256 checksum over the canonical body."""
+    body = {k: v for k, v in payload.items() if k != "checksum"}
+    out = dict(body)
+    out["checksum"] = hashlib.sha256(
+        _canonical(body).encode("utf-8")).hexdigest()
+    return out
+
+
+def extras_valid(payload: Any) -> bool:
+    """True iff the payload is a well-formed, checksum-intact extras
+    dict of the current version. A torn or bit-rotted file fails here
+    and the reader falls back to the previous sidecar."""
+    if not isinstance(payload, dict):
+        return False
+    if payload.get("version") != EXTRAS_VERSION:
+        return False
+    if not isinstance(payload.get("step"), int) or \
+            not isinstance(payload.get("lineage"), int):
+        return False
+    body = {k: v for k, v in payload.items() if k != "checksum"}
+    want = hashlib.sha256(_canonical(body).encode("utf-8")).hexdigest()
+    return payload.get("checksum") == want
+
+
+class _OsFS:
+    """The real-filesystem leg of the injectable fs seam. rename is
+    os.replace: atomic within a filesystem, the commit point of the
+    tmp-write idiom."""
+
+    def put(self, path: str, text: str) -> None:
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(text)
+
+    def fsync(self, path: str) -> None:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    def rename(self, src: str, dst: str) -> None:
+        os.replace(src, dst)
+
+    def listdir(self, directory: str) -> list:
+        try:
+            return os.listdir(directory)
+        except OSError:
+            return []
+
+    def read(self, path: str) -> str:
+        with open(path, "r", encoding="utf-8") as f:
+            return f.read()
+
+
+def extras_name(step: int, lineage: int) -> str:
+    # zero-padded so lexicographic filename order == (step, lineage)
+    return f"{_EXTRAS_PREFIX}{int(step):08d}-{int(lineage):08d}" \
+           f"{_EXTRAS_SUFFIX}"
+
+
+def write_extras(directory: str, payload: Dict[str, Any],
+                 fs: Any = None) -> str:
+    """Durably publish one extras payload: write the canonical JSON to a
+    ``.tmp`` sibling, fsync it, then rename onto the final name. A crash
+    before the rename leaves only the tmp (ignored by readers); after,
+    the full file. Returns the final path."""
+    fs = fs or _OsFS()
+    final = f"{directory}/{extras_name(payload['step'], payload['lineage'])}"
+    tmp = final + ".tmp"
+    blob = _canonical(payload)
+    fs.put(tmp, blob)
+    fs.fsync(tmp)
+    fs.rename(tmp, final)
+    return final
+
+
+def read_latest_extras(directory: str, fs: Any = None,
+                       step: Optional[int] = None) -> Optional[Dict[str, Any]]:
+    """Newest-valid-wins scan of the extras sidecars. Unparseable or
+    checksum-failing files (torn writes) are skipped; with ``step=``,
+    sidecars for any other step are skipped too (stale-lineage
+    rejection — the caller pairs this with the Orbax step it actually
+    restored). Returns the payload dict or None."""
+    fs = fs or _OsFS()
+    names = sorted(
+        (n for n in fs.listdir(directory)
+         if n.startswith(_EXTRAS_PREFIX) and n.endswith(_EXTRAS_SUFFIX)),
+        reverse=True)
+    for name in names:
+        try:
+            text = fs.read(f"{directory}/{name}")
+            payload = json.loads(text)
+        except (OSError, KeyError, ValueError):
+            continue
+        if not extras_valid(payload):
+            continue
+        if step is not None and payload["step"] != int(step):
+            continue
+        return payload
+    return None
 
 
 def joint_state(**named_states: Any) -> Dict[str, Any]:

@@ -248,6 +248,35 @@ class ReplayCache:
         with self._lock:
             self._entries.clear()
 
+    # -- persistence (runtime/checkpoint.py extras sidecar) ------------- #
+    def export_state(self) -> list:
+        """Resolved entries only, in FIFO order. A pending entry has an
+        owner thread mid-materialization — its result does not exist yet,
+        so it cannot be made durable; after a crash the retry simply
+        re-owns the step. Bodies ride along so a post-restart duplicate
+        is served the byte-identical wire reply."""
+        with self._lock:
+            return [{"key": list(e.key), "result": e.result, "body": e.body}
+                    for e in self._entries.values() if e.done]
+
+    def restore_state(self, entries: list) -> None:
+        """Repopulate from :meth:`export_state` output. Every restored
+        entry is born resolved (event already set) so pre-crash
+        duplicates are served immediately, never blocked on an owner
+        that no longer exists."""
+        with self._lock:
+            self._entries.clear()
+            for rec in entries:
+                cid, op, step = rec["key"]
+                key = (int(cid), str(op), int(step))
+                entry = _Entry(key)
+                entry.result = rec.get("result")
+                body = rec.get("body")
+                entry.body = bytes(body) if body is not None else None
+                entry.done = True
+                entry.event.set()
+                self._entries[key] = entry
+
     def counters(self) -> Dict[str, float]:
         with self._lock:
             return {

@@ -307,6 +307,11 @@ class ServerRuntime:
         from split_learning_tpu.transport import codec as _codec
         self.wire_ef = _codec.TopK8EF()
         self._wire_totals = [0, 0]  # raw, wire — behind the ratio gauge
+        # monotonic commit counter for the runtime-extras sidecar
+        # (runtime/checkpoint.py): stamps every export so a restore can
+        # reject a sidecar that does not belong to the Orbax step it
+        # actually restored
+        self._ckpt_lineage = 0
 
     # ------------------------------------------------------------------ #
     def _build_jitted(self) -> None:
@@ -841,6 +846,25 @@ class ServerRuntime:
                 self._deferred.flush()
             return self.state
 
+    def export_runtime_extras(self, step: int) -> Dict[str, Any]:
+        """Checksummed sidecar payload for the runtime state Orbax does
+        not carry: the replay cache (so post-restart duplicates are
+        served the pre-crash replies bit-identically) and the topk8 EF
+        residual ledger. Flushes the deferred-apply queue first, under
+        the same lock as the snapshot — the sidecar must describe the
+        same caught-up instant as the ``export_state`` tree it rides
+        beside (SLT112's flush-before-save contract)."""
+        from split_learning_tpu.runtime import checkpoint as _ckpt
+        with self._lock:
+            if self._deferred is not None:
+                self._deferred.flush()
+            self._ckpt_lineage += 1
+            return _ckpt.build_extras(
+                step, self._ckpt_lineage,
+                replay=(self.replay.export_state()
+                        if self.replay is not None else None),
+                wire_ef=self.wire_ef.export_state())
+
     def _dispatch_group(self, group: "list[CoalesceRequest]",
                         reason: str) -> None:
         """Flusher callback (runtime/coalesce.py): one batched dispatch
@@ -1175,10 +1199,24 @@ class ServerRuntime:
                 self.on_step(step)
         return mean_params
 
-    def resume_from(self, state: TrainState, step: int) -> None:
+    def resume_from(self, state: TrainState, step: int,
+                    extras: Optional[Dict[str, Any]] = None) -> None:
         """Adopt a restored TrainState and re-arm the handshake so the
         next client step must be ``step`` or later (checkpoint/resume
-        protocol — SURVEY.md §5)."""
+        protocol — SURVEY.md §5).
+
+        ``extras`` is the runtime-extras sidecar payload
+        (:meth:`export_runtime_extras`, read back through
+        ``checkpoint.read_latest_extras``): when present, valid, and
+        stamped with this exact ``step``, the replay cache and EF
+        residuals are restored from it — a client retrying its
+        in-flight step against the recovered server is then served the
+        pre-crash reply instead of a 409. Anything else (no sidecar,
+        torn file, stale step) falls back to the PR 4 semantics: clear
+        the cache, reset the residuals."""
+        from split_learning_tpu.runtime import checkpoint as _ckpt
+        use_extras = (extras is not None and _ckpt.extras_valid(extras)
+                      and extras["step"] == int(step))
         with self._lock:
             if self._deferred is not None:
                 # DROP (not flush) pending applies: they are gradients
@@ -1196,12 +1234,28 @@ class ServerRuntime:
             self._step_floor = step - 1  # applies to every client_id
             self._u_residual.clear()
             # replies from the pre-restore lineage must not be replayable
-            # into the restored one
+            # into the restored one — unless the sidecar carries this
+            # step's own cache, in which case restoring it is what makes
+            # post-restart duplicate delivery exactly-once
             if self.replay is not None:
-                self.replay.clear()
+                if use_extras and "replay" in extras:
+                    self.replay.restore_state(
+                        _ckpt.decode_obj(extras["replay"]))
+                else:
+                    self.replay.clear()
             # error-feedback residuals describe the *pre-restore* stream;
-            # feeding them into post-restore steps would inject stale mass
-            self.wire_ef.reset()
+            # feeding them into post-restore steps would inject stale
+            # mass — restore them only from a matching sidecar
+            if use_extras and "wire_ef" in extras:
+                self.wire_ef.restore_state(
+                    _ckpt.decode_obj(extras["wire_ef"]))
+            else:
+                self.wire_ef.reset()
+            if use_extras:
+                # future exports must stay monotonic past the restored
+                # sidecar's commit counter
+                self._ckpt_lineage = max(self._ckpt_lineage,
+                                         int(extras["lineage"]))
             if self._agg is not None:
                 # drop any pre-restore FedAvg submissions: averaging stale
                 # params into the first post-restore round would corrupt it

@@ -382,6 +382,34 @@ class TopK8EF:
             self._res.clear()
             self._prev.clear()
 
+    # -- persistence (runtime/checkpoint.py extras sidecar) ------------- #
+    def export_state(self) -> list:
+        """Residual ledger as ``[{key, res}]`` records. ``_prev`` (the
+        one-deep rollback buffer) is deliberately not exported: a
+        rollback undoes an un-delivered send, and across a restart the
+        send either landed (residual correct as stored) or the client
+        retries from the replay cache without re-compressing."""
+        with self._lock:
+            return [{"key": list(k) if isinstance(k, tuple) else k,
+                     "res": v}
+                    for k, v in self._res.items()]
+
+    def restore_state(self, entries: list) -> None:
+        """Rebuild ``_res`` from :meth:`export_state` output; keys that
+        exported as lists come back as the tuples compress() uses."""
+        # materialize the arrays before taking the lock (SLT001: no
+        # host-side copies inside the compressor's critical section)
+        restored = {}
+        for rec in entries:
+            key = rec["key"]
+            if isinstance(key, list):
+                key = tuple(key)
+            restored[key] = np.asarray(rec["res"], dtype=np.float32)
+        with self._lock:
+            self._res.clear()
+            self._prev.clear()
+            self._res.update(restored)
+
 
 def compressed_leaf_bytes(obj: Any) -> Tuple[int, int]:
     """(logical_bytes, wire_bytes) summed over every q8/topk8 leaf in a

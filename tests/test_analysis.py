@@ -887,6 +887,119 @@ def test_slt013_waiver_file(tmp_path):
 
 
 # ---------------------------------------------------------------------- #
+# SLT014: persistence discipline (crash-atomic writes + field pairing)
+# ---------------------------------------------------------------------- #
+
+def test_slt014_flags_in_place_writes(tmp_path):
+    findings = _lint(tmp_path, "runtime/ckpt.py", """
+        import pickle
+        def save_meta(path, text):
+            with open(path, "w") as f:
+                f.write(text)
+        def save_blob(path, obj):
+            with open(path, "wb") as f:
+                pickle.dump(obj, f)
+    """)
+    # the bare open(...,'w'), the open(...,'wb'), and pickle.dump
+    assert _rules(findings) == ["SLT014", "SLT014", "SLT014"]
+    msgs = " ".join(f.message for f in findings)
+    assert "rename" in msgs or "atomic" in msgs
+
+
+def test_slt014_tmp_write_rename_idiom_clean(tmp_path):
+    findings = _lint(tmp_path, "runtime/ckpt.py", """
+        import os
+        def save_meta(path, text):
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(text)
+            os.replace(tmp, path)
+        class _OsFS:
+            def put(self, path, text):
+                with open(path, "w") as f:
+                    f.write(text)
+            def rename(self, src, dst):
+                os.replace(src, dst)
+        def read(path):
+            with open(path) as f:
+                return f.read()
+    """)
+    assert findings == []
+    # files outside runtime/ are out of scope for part A
+    findings = _lint(tmp_path, "scripts/dump.py", """
+        def save(path, text):
+            with open(path, "w") as f:
+                f.write(text)
+    """)
+    assert findings == []
+
+
+def test_slt014_inline_waiver(tmp_path):
+    findings = _lint(tmp_path, "runtime/ckpt.py", """
+        def save(path, text):
+            with open(path, "w") as f:  # slt-lint: disable=SLT014 (scratch file, rebuilt on boot)
+                f.write(text)
+    """)
+    assert _rules(findings, waived=True) == ["SLT014"]
+    assert _rules(findings, waived=False) == []
+
+
+def test_slt014_waiver_file(tmp_path):
+    bad = tmp_path / "runtime" / "ckpt.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(textwrap.dedent("""
+        def save(path, text):
+            with open(path, "w") as f:
+                f.write(text)
+    """))
+    wf = tmp_path / "waivers"
+    wf.write_text("SLT014 runtime/ckpt.py legacy dump path, migration tracked\n")
+    assert engine.main([str(tmp_path), "--waiver-file", str(wf)]) == 0
+    assert engine.main([str(tmp_path)]) == 1
+
+
+def test_slt014_pairing_cross_file(tmp_path):
+    # exporter writes "ghost" nobody restores; restorer hard-reads
+    # "missing" nobody exports — both cross-file findings
+    exp = tmp_path / "runtime" / "state.py"
+    exp.parent.mkdir(parents=True)
+    exp.write_text(textwrap.dedent("""
+        def export_state(self):
+            return {"step": 1, "ghost": 2}
+    """))
+    res = tmp_path / "transport" / "wire.py"
+    res.parent.mkdir(parents=True)
+    res.write_text(textwrap.dedent("""
+        def restore_state(self, rec):
+            step = rec["step"]
+            val = rec["missing"]
+            return step, val
+    """))
+    findings = [f for f in engine.lint_paths([str(tmp_path)])
+                if f.rule == "SLT014"]
+    msgs = " ".join(f.message for f in findings)
+    assert "ghost" in msgs
+    assert "missing" in msgs
+
+
+def test_slt014_pairing_matched_fields_clean(tmp_path):
+    exp = tmp_path / "runtime" / "state.py"
+    exp.parent.mkdir(parents=True)
+    exp.write_text(textwrap.dedent("""
+        def export_state(self):
+            return {"step": 1, "replay": []}
+    """))
+    res = tmp_path / "runtime" / "boot.py"
+    res.write_text(textwrap.dedent("""
+        def restore_state(self, rec):
+            return rec["step"], rec.get("replay", [])
+    """))
+    findings = [f for f in engine.lint_paths([str(tmp_path)])
+                if f.rule == "SLT014"]
+    assert findings == []
+
+
+# ---------------------------------------------------------------------- #
 # engine: exit codes, waiver file, real tree
 # ---------------------------------------------------------------------- #
 
@@ -937,10 +1050,12 @@ def test_list_rules(capsys):
     out = capsys.readouterr().out
     for rule in ("SLT001", "SLT002", "SLT003", "SLT004", "SLT005",
                  "SLT006", "SLT007", "SLT008", "SLT009", "SLT010",
-                 "SLT011", "SLT012",
+                 "SLT011", "SLT012", "SLT013", "SLT014",
                  # slt-check dynamic-invariant pseudo-rules
                  "SLT100", "SLT101", "SLT102", "SLT103", "SLT104",
-                 "SLT105", "SLT106", "SLT107", "SLT108"):
+                 "SLT105", "SLT106", "SLT107", "SLT108",
+                 # slt-crash durability invariants
+                 "SLT109", "SLT110", "SLT111", "SLT112"):
         assert rule in out
 
 

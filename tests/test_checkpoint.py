@@ -142,6 +142,98 @@ def test_save_is_async_and_reads_barrier(tmp_path, monkeypatch):
     assert "wait" in calls, "close() must drain outstanding writes"
 
 
+def test_ckpt_drain_barriers_on_every_exit_path(tmp_path, monkeypatch):
+    """PR 12 shutdown regression: the CLI train/serve paths wrap their
+    run loops in ``_ckpt_drain``, so an in-flight async save is drained
+    before process exit EVEN when the loop raises — a slow write must
+    never be torn by interpreter teardown. Pinned at the manager seam
+    with a slow-save stub so the contract holds regardless of disk
+    speed."""
+    import time as _time
+
+    import jax.numpy as jnp
+
+    from split_learning_tpu.launch.run import _ckpt_drain
+
+    ckpt = Checkpointer(str(tmp_path / "slow"))
+    calls = []
+    orig_wait = ckpt._mgr.wait_until_finished
+    monkeypatch.setattr(
+        ckpt._mgr, "wait_until_finished",
+        lambda: (calls.append("wait"), orig_wait())[1])
+    orig_save = ckpt._mgr.save
+
+    def slow_save(*a, **kw):
+        _time.sleep(0.05)  # the write is still in flight at teardown
+        return orig_save(*a, **kw)
+
+    monkeypatch.setattr(ckpt._mgr, "save", slow_save)
+
+    with pytest.raises(RuntimeError, match="mid-epoch"):
+        with _ckpt_drain(ckpt):
+            ckpt.save(1, {"w": jnp.ones((4,))})
+            raise RuntimeError("mid-epoch failure")
+    assert "wait" in calls, "error exit must drain in-flight saves"
+
+    calls.clear()
+    with _ckpt_drain(ckpt):
+        ckpt.save(2, {"w": jnp.zeros((4,))})
+    assert "wait" in calls, "clean exit must drain in-flight saves"
+    assert ckpt.latest_step() == 2
+    ckpt.close()
+
+    with _ckpt_drain(None):  # serve/train without --ckpt-dir: a no-op
+        pass
+
+
+def test_resume_restores_replay_cache_from_extras(tmp_path):
+    """PR 12 satellite: a resume whose checkpoint carries the runtime
+    extras sidecar restores the replay cache — a client retrying its
+    in-flight step against the recovered server gets the pre-crash
+    reply byte-for-byte. A stale or missing sidecar falls back to the
+    PR 4 semantics (clear)."""
+    import jax
+    import jax.numpy as jnp
+
+    from split_learning_tpu.runtime.checkpoint import (read_latest_extras,
+                                                       write_extras)
+
+    cfg = Config(mode="split", batch_size=8)
+    plan = get_plan(mode="split")
+    rs = np.random.RandomState(0)
+    x = rs.randn(8, 28, 28, 1).astype(np.float32)
+    y = rs.randint(0, 10, (8,)).astype(np.int64)
+    rt = ServerRuntime(plan, cfg, jax.random.PRNGKey(0), x)
+    acts = np.asarray(plan.stages[0].apply(
+        plan.init(jax.random.PRNGKey(0), jnp.asarray(x))[0],
+        jnp.asarray(x)))
+    grads, loss = rt.split_step(acts, y, 0)
+    rt.attach_reply_body(0, "split_step", 0, b"\x01wire-reply")
+    state = rt.export_state()
+    payload = rt.export_runtime_extras(0)
+
+    ckdir = tmp_path / "extras"
+    ckdir.mkdir()
+    write_extras(str(ckdir), payload)
+
+    # restart with a matching sidecar: the duplicate is served from the
+    # restored cache, bit-identical, without touching the model
+    rt2 = ServerRuntime(plan, cfg, jax.random.PRNGKey(1), x)
+    rt2.resume_from(state, 0, extras=read_latest_extras(str(ckdir), step=0))
+    body, _ = rt2.replay_lookup(0, "split_step", 0)
+    assert body == b"\x01wire-reply"
+
+    # stale sidecar (step mismatch): rejected, cache cleared
+    rt3 = ServerRuntime(plan, cfg, jax.random.PRNGKey(2), x)
+    rt3.resume_from(state, 5, extras=read_latest_extras(str(ckdir)))
+    assert rt3.replay_lookup(0, "split_step", 0) == (None, None)
+
+    # no sidecar at all: same clear fallback
+    rt4 = ServerRuntime(plan, cfg, jax.random.PRNGKey(3), x)
+    rt4.resume_from(state, 0)
+    assert rt4.replay_lookup(0, "split_step", 0) == (None, None)
+
+
 def test_restore_partial_preserves_optimizer_types(tmp_path):
     """The server half of a JOINT checkpoint must restore TYPED (optax
     TraceState namedtuples intact): a raw restore decays opt_state to

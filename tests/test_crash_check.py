@@ -1,0 +1,423 @@
+"""slt-crash (PR 12): crash–restart model checking of checkpoint /
+replay / deferred-apply durability.
+
+Covers: DurableStore worst-case crash semantics (torn un-fsynced
+writes, atomic rename), one seeded-violation toy per durability
+invariant (SLT109–112 — each proving the invariant actually fires),
+the REAL write_extras tmp+fsync+rename path surviving every crash
+point, forced-crash bit-identity (same (choices, crash point) =>
+identical fingerprint), explorer determinism, the registered crash
+scenarios' clean gate through the CLI, ``--schedule <id>@crash:<k>``
+counterexample replay, and replay-cache + topk8 EF-residual round
+trips through the extras sidecar on both fs legs.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from split_learning_tpu.analysis import engine
+from split_learning_tpu.analysis.invariants import check_run
+from split_learning_tpu.analysis.sched import (
+    DurableStore, explore_crashes, run_crash_schedule)
+from split_learning_tpu.analysis.scenarios import CRASH_SCENARIOS
+from split_learning_tpu.runtime.checkpoint import (
+    build_extras, decode_obj, extras_valid, read_latest_extras,
+    write_extras)
+from split_learning_tpu.runtime.replay import ReplayCache
+from split_learning_tpu.transport.codec import TopK8EF
+
+
+# ---------------------------------------------------------------------- #
+# DurableStore: the adversarial disk
+# ---------------------------------------------------------------------- #
+
+def test_durable_store_unfsynced_put_survives_torn():
+    st = DurableStore()
+    st.put("d/a.txt", "payload-AAAA")
+    st.crash()
+    # never fsynced: survives as a prefix of the in-flight bytes
+    assert st.read("d/a.txt") == "payload-AAAA"[: len("payload-AAAA") // 2]
+
+
+def test_durable_store_fsync_then_crash_survives_intact():
+    st = DurableStore()
+    st.put("d/a.txt", "payload-AAAA")
+    st.fsync("d/a.txt")
+    st.crash()
+    assert st.read("d/a.txt") == "payload-AAAA"
+
+
+def test_durable_store_rename_is_atomic_and_keeps_durability():
+    st = DurableStore()
+    st.put("d/x.json.tmp", "hello!")
+    st.fsync("d/x.json.tmp")
+    st.rename("d/x.json.tmp", "d/x.json")
+    st.crash()
+    assert not st.exists("d/x.json.tmp")
+    assert st.listdir("d") == ["x.json"]
+    assert st.read("d/x.json") == "hello!"
+
+
+def test_durable_store_overwrite_after_fsync_is_torn_again():
+    st = DurableStore()
+    st.put("d/a.txt", "old-old-old!")
+    st.fsync("d/a.txt")
+    st.put("d/a.txt", "new-new-new!")  # dirties past the fsync
+    st.crash()
+    assert st.read("d/a.txt") == "new-new-new!"[: len("new-new-new!") // 2]
+
+
+# ---------------------------------------------------------------------- #
+# seeded-violation toys: each durability invariant actually fires
+# ---------------------------------------------------------------------- #
+
+_BLOB = "0123456789abcdef"
+
+
+def _torn_ckpt_workload(ctx, store):
+    # BUG under test: checkpoint written in place, no fsync, no
+    # tmp+rename — a crash leaves a torn file the recovery accepts
+    store.put("ckpt/extras-1.json", _BLOB)
+    ctx.note("c_commit", step=1, lineage=1, captured=[])
+
+
+def _torn_ckpt_recover(ctx, store, pre):
+    names = store.listdir("ckpt")
+    if not names:
+        ctx.note("c_restore", step=None, lineage=None, torn=False)
+        return
+    ok = store.read("ckpt/" + names[-1]) == _BLOB
+    ctx.note("c_restore", step=1 if ok else None,
+             lineage=1 if ok else None, torn=not ok)
+
+
+def test_slt110_torn_checkpoint_toy_caught():
+    torn = []
+    for k in range(1, 6):
+        run = run_crash_schedule("torn_ckpt", _torn_ckpt_workload,
+                                 _torn_ckpt_recover, crash_at=k)
+        if not run.crashed:
+            continue
+        vs = check_run(run, ("checkpoint_atomicity",))
+        torn.extend(v for v in vs if v.invariant == "checkpoint_atomicity")
+    assert torn, "no crash point exposed the missing-fsync checkpoint"
+    assert any("torn" in v.message for v in torn)
+    # every counterexample hands back a replayable @crash id
+    assert all("@crash:" in v.schedule_id for v in torn)
+    # and the crash-off path is clean (the bug needs the crash)
+    clean = run_crash_schedule("torn_ckpt", _torn_ckpt_workload,
+                               _torn_ckpt_recover)
+    assert not clean.crashed
+    assert check_run(clean, ("checkpoint_atomicity",)) == []
+
+
+def _real_extras_workload(ctx, store):
+    payload = build_extras(1, 1, replay=[])
+    write_extras("ckpt", payload, fs=store)
+    ctx.note("c_commit", step=1, lineage=1, captured=[])
+
+
+def _real_extras_recover(ctx, store, pre):
+    payload = read_latest_extras("ckpt", fs=store)
+    if payload is None:
+        ctx.note("c_restore", step=None, lineage=None, torn=False)
+    else:
+        ctx.note("c_restore", step=payload["step"],
+                 lineage=payload["lineage"], torn=False)
+
+
+def test_real_write_extras_path_survives_every_crash_point():
+    """The shipped tmp-write + fsync + rename idiom, run against the
+    adversarial store: NO crash point tears a visible checkpoint or
+    desyncs restore from the newest durable commit."""
+    for k in range(1, 10):
+        run = run_crash_schedule("atomic_ckpt", _real_extras_workload,
+                                 _real_extras_recover, crash_at=k)
+        assert check_run(run, ("checkpoint_atomicity",)) == [], \
+            f"crash point {k} broke the tmp+fsync+rename idiom"
+
+
+def test_slt109_lost_deferred_apply_toy_caught():
+    key = [0, "split_step", 1]
+
+    def workload(ctx, store):
+        ctx.note("c_sent", key=key)
+        # BUG under test: the update sat in the deferred queue at
+        # capture time, so the commit's captured set misses it
+        ctx.note("c_commit", step=1, lineage=1, captured=[])
+
+    def recover(ctx, store, pre):
+        # ...and the recovery trusts the checkpoint without retrying
+        ctx.note("c_restore", step=1, lineage=1, torn=False)
+
+    run = run_crash_schedule("lost_deferred", workload, recover)
+    vs = check_run(run, ("durable_exactly_once",))
+    assert [v.invariant for v in vs] == ["durable_exactly_once"]
+    assert "lost" in vs[0].message
+
+
+def test_slt109_double_apply_toy_caught():
+    key = [0, "split_step", 1]
+
+    def workload(ctx, store):
+        ctx.note("c_sent", key=key)
+        ctx.note("c_apply", key=key)
+        ctx.note("c_commit", step=1, lineage=1, captured=[key])
+
+    def recover(ctx, store, pre):
+        ctx.note("c_restore", step=1, lineage=1, torn=False)
+        # BUG under test: the captured step re-applied instead of being
+        # served from the restored replay cache
+        ctx.note("c_apply", key=key)
+
+    run = run_crash_schedule("double_apply", workload, recover)
+    vs = check_run(run, ("durable_exactly_once",))
+    assert [v.invariant for v in vs] == ["durable_exactly_once"]
+    assert "double-applied" in vs[0].message
+
+
+def test_slt111_mutated_replay_toy_caught():
+    key = [0, "split_step", 1]
+
+    def workload(ctx, store):
+        ctx.note("c_sent", key=key)
+        ctx.note("c_apply", key=key)
+        ctx.note("c_reply", key=key, value=7)
+        ctx.note("c_commit", step=1, lineage=1, captured=[key])
+
+    def recover(ctx, store, pre):
+        ctx.note("c_restore", step=1, lineage=1, torn=False)
+        # BUG under test: the retry recomputed instead of replaying
+        ctx.note("c_replay_reply", key=key, value=8)
+
+    run = run_crash_schedule("mutated_replay", workload, recover)
+    vs = check_run(run, ("replay_recovery_bit_identical",))
+    assert [v.invariant for v in vs] == ["replay_recovery_bit_identical"]
+    assert "not bit-identical" in vs[0].message
+
+
+def test_slt111_replay_of_never_replied_step_caught():
+    def workload(ctx, store):
+        ctx.note("c_commit", step=1, lineage=1, captured=[])
+
+    def recover(ctx, store, pre):
+        ctx.note("c_restore", step=1, lineage=1, torn=False)
+        ctx.note("c_replay_reply", key=[9, "split_step", 9], value=0)
+
+    run = run_crash_schedule("ghost_replay", workload, recover)
+    vs = check_run(run, ("replay_recovery_bit_identical",))
+    assert [v.invariant for v in vs] == ["replay_recovery_bit_identical"]
+    assert "never replied" in vs[0].message
+
+
+def test_slt112_unflushed_save_toy_caught():
+    def workload(ctx, store):
+        # BUG under test: snapshot taken with 2 updates still queued
+        ctx.note("c_save_capture", step=1, depth=2)
+        ctx.note("c_commit", step=1, lineage=1, captured=[])
+
+    def recover(ctx, store, pre):
+        ctx.note("c_restore", step=1, lineage=1, torn=False)
+
+    run = run_crash_schedule("unflushed_save", workload, recover)
+    vs = check_run(run, ("flush_before_save",))
+    assert [v.invariant for v in vs] == ["flush_before_save"]
+    assert "flush-before-save" in vs[0].message
+
+
+# ---------------------------------------------------------------------- #
+# determinism: same (choices, crash point) => bit-identical schedule
+# ---------------------------------------------------------------------- #
+
+def _two_writer_workload(ctx, store):
+    lock = ctx.lock("m")
+
+    def writer(i):
+        with lock:
+            ctx.step("box")
+        store.put(f"d/f{i}", f"value-{i}!")
+        store.fsync(f"d/f{i}")
+
+    a = ctx.spawn(writer, 0)
+    b = ctx.spawn(writer, 1)
+    a.join()
+    b.join()
+
+
+def _two_writer_recover(ctx, store, pre):
+    ctx.note("c_restore", step=None, lineage=None, torn=False)
+    return {"survivors": store.listdir("d")}
+
+
+def test_forced_crash_replay_is_bit_identical():
+    runs = [run_crash_schedule("two_writer", _two_writer_workload,
+                               _two_writer_recover, crash_at=3)
+            for _ in range(2)]
+    assert runs[0].schedule_id == runs[1].schedule_id
+    assert "@crash:3" in runs[0].schedule_id
+    assert runs[0].trace_fingerprint() == runs[1].trace_fingerprint()
+    assert runs[0].state == runs[1].state
+    # a different crash point is a different schedule id
+    other = run_crash_schedule("two_writer", _two_writer_workload,
+                               _two_writer_recover, crash_at=4)
+    assert other.schedule_id != runs[0].schedule_id
+
+
+def test_explore_crashes_deterministic_and_counts():
+    def sweep():
+        ids = []
+        res = explore_crashes("two_writer", _two_writer_workload,
+                              _two_writer_recover, budget=6, bound=2,
+                              crash_budget=24,
+                              on_run=lambda r: ids.append(
+                                  (r.schedule_id, r.trace_fingerprint())))
+        return res, ids
+
+    res1, ids1 = sweep()
+    res2, ids2 = sweep()
+    assert ids1 == ids2
+    assert res1.schedule_ids == res2.schedule_ids
+    assert res1.bases >= 2                      # the lock really races
+    assert res1.crash_schedules >= res1.bases   # crash points per base
+    s = res1.summary()
+    for k in ("schedules", "pruned", "pruning_ratio", "bases",
+              "crash_schedules", "exhausted"):
+        assert k in s
+
+
+# ---------------------------------------------------------------------- #
+# registered crash scenarios: clean gate + CLI replay
+# ---------------------------------------------------------------------- #
+
+def _crash_scenario_or_skip(name):
+    sc = CRASH_SCENARIOS[name]
+    if not sc.available():
+        pytest.skip(f"scenario {name} requires {sc.requires}")
+    return sc
+
+
+def test_registered_crash_scenarios_exist():
+    for name in ("crash_replay_dup_storm", "crash_deferred_queue",
+                 "crash_ckpt_race"):
+        assert name in CRASH_SCENARIOS
+
+
+@pytest.mark.parametrize("name", sorted(CRASH_SCENARIOS))
+def test_crash_scenario_clean_under_small_sweep(name):
+    sc = _crash_scenario_or_skip(name)
+    bad = []
+    res = explore_crashes(
+        name, sc.workload, sc.recover, budget=4, bound=sc.bound,
+        crash_budget=12,
+        on_run=lambda r: bad.extend(check_run(r, sc.invariants)))
+    assert res.crash_schedules > 0
+    assert bad == [], [str(v) for v in bad]
+
+
+def test_crash_check_cli_clean_gate_and_report(tmp_path, capsys):
+    name = "crash_replay_dup_storm"
+    _crash_scenario_or_skip(name)
+    rpt = tmp_path / "report.json"
+    rc = engine.main(["--check", "--crash", "--scenario", name,
+                      "--budget", "24", "--report", str(rpt)])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert f"slt-crash: {name}:" in out
+    data = json.loads(rpt.read_text())
+    assert data["crash"] is True
+    entry = data["scenarios"][name]
+    assert entry["crash"] is True
+    assert entry["violations"] == []
+    assert entry["bases"] > 0 and entry["crash_schedules"] > 0
+    assert entry["schedules"] == data["total_schedules"]
+    assert entry["sample_fingerprints"]
+
+
+def test_crash_schedule_cli_replay_is_deterministic(capsys):
+    name = "crash_replay_dup_storm"
+    sc = _crash_scenario_or_skip(name)
+    res = explore_crashes(name, sc.workload, sc.recover, budget=2,
+                          bound=sc.bound, crash_budget=4)
+    crash_ids = [s for s in res.schedule_ids if "@crash:" in s]
+    assert crash_ids
+    sid = crash_ids[0]
+    outs = []
+    for _ in range(2):
+        assert engine.main(["--schedule", sid]) == 0
+        outs.append(capsys.readouterr().out)
+    assert outs[0] == outs[1]
+    assert "fingerprint" in outs[0]
+    assert "crashed at transition" in outs[0]
+
+
+# ---------------------------------------------------------------------- #
+# extras round trips: replay cache + EF residuals, both fs legs
+# ---------------------------------------------------------------------- #
+
+def _populated_cache():
+    cache = ReplayCache(window=8, max_total=64)
+    entry, owner = cache.begin(0, "split_step", 1)
+    assert owner
+    cache.resolve(entry, {"loss": 1.5})
+    cache.attach_body(0, "split_step", 1, b"\x00\x01wire-bytes")
+    return cache
+
+
+def test_extras_roundtrip_replay_and_ef_on_real_fs(tmp_path):
+    cache = _populated_cache()
+    ef = TopK8EF()
+    grad = np.linspace(-1.0, 1.0, 64, dtype=np.float32)
+    ef.compress(("c0", "grads"), grad, 0.125)
+    payload = build_extras(3, 2, replay=cache.export_state(),
+                           wire_ef=ef.export_state())
+    ckdir = tmp_path / "ck"
+    ckdir.mkdir()
+    write_extras(str(ckdir), payload)
+    # no stray tmp file after the rename commit
+    assert all(not n.endswith(".tmp") for n in __import__("os")
+               .listdir(ckdir))
+
+    got = read_latest_extras(str(ckdir), step=3)
+    assert got is not None and extras_valid(got)
+    cache2 = ReplayCache(window=8, max_total=64)
+    cache2.restore_state(decode_obj(got["replay"]))
+    body, _ = cache2.lookup(0, "split_step", 1)
+    assert body == b"\x00\x01wire-bytes"  # byte-identical replay body
+
+    ef2 = TopK8EF()
+    ef2.restore_state(decode_obj(got["wire_ef"]))
+    res1 = {k: v for k, v in
+            ((tuple(r["key"]), r["res"]) for r in ef.export_state())}
+    res2 = {k: v for k, v in
+            ((tuple(r["key"]), r["res"]) for r in ef2.export_state())}
+    assert set(res1) == set(res2) == {("c0", "grads")}
+    np.testing.assert_array_equal(res1[("c0", "grads")],
+                                  res2[("c0", "grads")])
+
+
+def test_extras_stale_step_and_torn_file_rejected(tmp_path):
+    ckdir = tmp_path / "ck"
+    ckdir.mkdir()
+    path = write_extras(str(ckdir), build_extras(3, 2, replay=[]))
+    # stale-lineage rejection: the Orbax step the caller restored wins
+    assert read_latest_extras(str(ckdir), step=99) is None
+    # torn file: checksum fails, reader skips it
+    blob = (ckdir / path.rsplit("/", 1)[1]).read_text()
+    (ckdir / path.rsplit("/", 1)[1]).write_text(blob[: len(blob) // 2])
+    assert read_latest_extras(str(ckdir), step=3) is None
+
+
+def test_extras_roundtrip_on_durable_store():
+    store = DurableStore()  # unbound: no scheduler, direct calls
+    cache = _populated_cache()
+    write_extras("ckpt", build_extras(5, 1, replay=cache.export_state()),
+                 fs=store)
+    store.crash()  # write_extras fsynced before rename: survives intact
+    got = read_latest_extras("ckpt", fs=store, step=5)
+    assert got is not None
+    cache2 = ReplayCache(window=8, max_total=64)
+    cache2.restore_state(decode_obj(got["replay"]))
+    body, _ = cache2.lookup(0, "split_step", 1)
+    assert body == b"\x00\x01wire-bytes"

@@ -427,6 +427,56 @@ def test_trace_report_schedules_section(tmp_path):
     assert report.main(["--schedules", str(p)]) == 0
 
 
+def test_trace_report_crash_subsection(tmp_path):
+    """slt-crash entries (``"crash": true``) get their own subsection —
+    bases, crash points, pruning ratio — and crash violations render
+    with the full replayable ``@crash:`` id. Reports from the crash-off
+    checker (no crash keys anywhere) must render exactly as before."""
+    report = _load_trace_report()
+    check = {
+        "total_schedules": 190,
+        "crash": True,
+        "scenarios": {
+            "replay_dup_storm": {
+                "schedules": 20, "pruned": 4, "pruning_ratio": 1 / 6,
+                "exhausted": True, "max_preemptions": 2,
+                "max_transitions": 40, "invariants": ["no_errors"],
+                "violations": [], "sample_fingerprints": {}},
+            "crash_replay_dup_storm": {
+                "crash": True, "bases": 12, "crash_schedules": 168,
+                "schedules": 170, "pruned": 56,
+                "pruning_ratio": 56 / 226, "exhausted": True,
+                "max_preemptions": 2, "max_transitions": 64,
+                "invariants": ["durable_exactly_once"],
+                "violations": [{
+                    "invariant": "durable_exactly_once",
+                    "schedule_id": "crash_replay_dup_storm:3F@crash:7",
+                    "message": "step (0, 'split_step', 1) lost"}],
+                "sample_fingerprints": {}},
+            "crash_needs_jax": {"skipped": "jax", "crash": True},
+        },
+    }
+    p = tmp_path / "crash-check.json"
+    p.write_text(json.dumps(check))
+    rep = report.summarize_schedules(str(p))
+    assert rep["totals"] == {"schedules": 190, "pruned": 60,
+                             "violations": 1, "skipped": 1}
+    assert rep["scenarios"]["crash_replay_dup_storm"]["bases"] == 12
+    assert "crash" not in rep["scenarios"]["replay_dup_storm"]
+    text = report.render_schedules(rep)
+    assert "crash-restart schedules" in text
+    assert "--schedule crash_replay_dup_storm:3F@crash:7" in text
+    assert report.main(["--schedules", str(p)]) == 0
+    # tolerant fallback: a crash-off report renders with NO subsection
+    old = {"total_schedules": 5, "scenarios": {
+        "replay_dup_storm": {"schedules": 5, "pruned": 0}}}
+    p2 = tmp_path / "old.json"
+    p2.write_text(json.dumps(old))
+    text2 = report.render_schedules(report.summarize_schedules(str(p2)))
+    assert "crash-restart schedules" not in text2
+    assert report.main(["--schedules", str(p2)]) == 0
+
+
 # --------------------------------------------------------------------- #
 # runtime.metrics() snapshot (the in-process twin of GET /metrics)
 
