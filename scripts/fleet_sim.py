@@ -6,8 +6,11 @@ The runnable face of runtime/fleet.py: builds a split-mode ServerRuntime
 (same recipe as tests/test_coalesce.py), warms it with warm_fleet (shape
 priming + burst rounds — measured runs see zero in-run compiles), then
 runs the configured fleet and prints one JSON object with per-tenant and
-pooled p50/p99 queue-wait and step latency, admission counters, and the
-replay/compile integrity numbers the bench gates on.
+pooled p50/p99 queue-wait and step latency, admission counters, the
+replay/compile integrity numbers the bench gates on, and a
+``utilization`` block (steady-state group occupancy as a fraction of
+``--coalesce-max``, admission reject rate, pooled step p99 against
+``--slo-ms``) for capacity-planning sweeps.
 
 Used by CI as a smoke gate (`--gate-dropped-steps` exits 1 if any step
 was dropped) and by hand for regime exploration:
@@ -138,6 +141,32 @@ def main() -> int:
     expected = args.clients * args.steps
     completed = int(res.counters.get("fleet_steps_total", 0))
     dropped = int(res.counters.get("fleet_dropped_steps", 0))
+
+    # utilization / saturation: how close the run sat to its knobs.
+    # occupancy is requests per flushed group; dividing by the group
+    # ceiling gives the saturation fraction a capacity sweep bisects on.
+    adm = health.get("admission")
+    reject_rate = None
+    if adm is not None:
+        offered = (adm.get("admission_admitted", 0.0)
+                   + adm.get("admission_rejected", 0.0))
+        reject_rate = (adm.get("admission_rejected", 0.0) / offered
+                       if offered else 0.0)
+    occupancy = float(coalescing.get("mean_occupancy", 0.0) or 0.0)
+    step_p99 = res.overall.get("step_p99_ms")
+    p99_over_slo = (step_p99 / args.slo_ms
+                    if args.slo_ms and step_p99 is not None else None)
+    utilization = {
+        "mean_occupancy": round(occupancy, 3),
+        "steady_state_occupancy": round(
+            occupancy / max(args.coalesce_max, 1), 4),
+        "admission_reject_rate": (None if reject_rate is None
+                                  else round(reject_rate, 4)),
+        "step_p99_over_slo": (None if p99_over_slo is None
+                              else round(p99_over_slo, 3)),
+        "slo_attained": (None if p99_over_slo is None
+                         else bool(p99_over_slo <= 1.0)),
+    }
     summary = {
         "config": {
             "clients": args.clients, "tenants": args.tenants,
@@ -164,7 +193,8 @@ def main() -> int:
             str(t): {k: (round(v, 3) if isinstance(v, float) else v)
                      for k, v in row.items()}
             for t, row in res.per_tenant.items()},
-        "admission": health.get("admission"),
+        "admission": adm,
+        "utilization": utilization,
         "replay": replay,
     }
     print(json.dumps(summary, indent=1))

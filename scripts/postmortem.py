@@ -1,0 +1,357 @@
+#!/usr/bin/env python
+"""Merge flight-recorder dumps into one cross-party causal timeline.
+
+Input: one or more ``slt-flight-dump`` JSON files (obs/flight.py) — the
+``--flight PATH`` exit dump, a watchdog-trip dump, a ``GET
+/debug/flight`` response body saved to disk, or a SIGTERM/fatal dump.
+A client dump and a server dump from the same run merge into one
+journal ordered by wall time (each recorder derives its stamps from a
+single monotonic base, so within a party the order is exact; across
+parties it is as good as the hosts' clocks).
+
+Output, in ``scripts/trace_report.py`` section style:
+
+* the dump inventory (party / pid / reason / events kept vs dropped);
+* an event-name frequency table;
+* per-step causal timelines for the most interesting steps (anomalous
+  steps and steps where a duplicate was served from the replay cache
+  come first);
+* duplicate-delivery accounting: every (client, op, step) served from
+  the replay cache, with how many times and via which path
+  (claim-wait vs wire replay-hit);
+* anomaly findings — causal orders that should be impossible:
+    - ``claim_never_resolved``: an owning ``fl_claim_begin`` with no
+      later resolve/fail for the same (client, op, step) — an owner
+      crashed or deadlocked mid-materialization;
+    - ``apply_after_close``: a deferred weight apply journaled after
+      that party's ``fl_close`` — the 2BP drain outlived shutdown;
+    - ``reply_before_admit``: on a run with admission control armed, a
+      client's replies outran its admissions at some point in the
+      timeline;
+    - ``duplicate_without_resolve``: a duplicate was served
+      (``fl_claim_wait`` / ``fl_replay_hit``) with no prior
+      ``fl_claim_resolve`` for that key — a reply fabricated from
+      nothing.
+
+Run:    python scripts/postmortem.py client.json server.json
+Also:   --json (machine-readable), --step N (timeline for one step),
+        --strict (exit 1 when any anomaly is found — CI gate).
+
+Stdlib-only (no jax, no numpy): usable on any box the dumps land on.
+The event-name constants fall back to a literal copy of the
+obs/spans.py registry that tests/test_analysis.py pins byte-equal, so
+the script also runs standalone without the package importable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+try:
+    from split_learning_tpu.obs.spans import (
+        FL_ADMIT, FL_CHAOS, FL_CLAIM_BEGIN, FL_CLAIM_FAIL,
+        FL_CLAIM_RESOLVE, FL_CLAIM_WAIT, FL_CLOSE, FL_DEFER_APPLY,
+        FL_FATAL, FL_REPLAY_HIT, FL_REPLY, FL_WATCHDOG_TRIP)
+except ImportError:
+    FL_ADMIT = "fl_admit"
+    FL_CLAIM_BEGIN = "fl_claim_begin"
+    FL_CLAIM_RESOLVE = "fl_claim_resolve"
+    FL_CLAIM_FAIL = "fl_claim_fail"
+    FL_CLAIM_WAIT = "fl_claim_wait"
+    FL_REPLAY_HIT = "fl_replay_hit"
+    FL_REPLY = "fl_reply"
+    FL_DEFER_APPLY = "fl_defer_apply"
+    FL_CHAOS = "fl_chaos"
+    FL_CLOSE = "fl_close"
+    FL_WATCHDOG_TRIP = "fl_watchdog_trip"
+    FL_FATAL = "fl_fatal"
+
+Key = Tuple[int, Optional[str], int]  # (client_id, op, step)
+
+
+def load_dump(path: str) -> Dict[str, Any]:
+    """One flight dump, validated just enough to merge. Tolerant of
+    extra keys (newer recorders) but not of the wrong kind of file — a
+    Chrome trace fed here by mistake should say so, not render garbage."""
+    with open(path) as f:
+        dump = json.load(f)
+    if not isinstance(dump, dict) or dump.get("kind") != "slt-flight-dump":
+        raise ValueError(
+            f"{path}: not a flight dump (expected kind='slt-flight-dump'; "
+            "Chrome traces go to scripts/trace_report.py)")
+    dump.setdefault("events", [])
+    dump["path"] = path
+    return dump
+
+
+def merge_events(dumps: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """All events from all dumps, oldest first. Each event is tagged
+    with its source dump index; within one dump the per-process seq
+    breaks wall-time ties exactly."""
+    merged: List[Dict[str, Any]] = []
+    for i, dump in enumerate(dumps):
+        for ev in dump["events"]:
+            if isinstance(ev, dict):
+                ev = dict(ev)
+                ev["src"] = i
+                merged.append(ev)
+    merged.sort(key=lambda e: (float(e.get("t", 0.0)), e.get("src", 0),
+                               int(e.get("seq", 0))))
+    return merged
+
+
+def _key(ev: Dict[str, Any]) -> Key:
+    fields = ev.get("fields") or {}
+    return (int(ev.get("client_id", -1)), fields.get("op"),
+            int(ev.get("step", -1)))
+
+
+def detect_anomalies(events: List[Dict[str, Any]],
+                     truncated: bool) -> List[Dict[str, Any]]:
+    """The four causal-order checks, over the merged timeline. When any
+    dump dropped events (ring overflow) the checks that depend on an
+    event's *absence* (claim_never_resolved, reply_before_admit,
+    duplicate_without_resolve) are skipped — the missing event may
+    simply have fallen off the ring."""
+    anomalies: List[Dict[str, Any]] = []
+
+    # claim lifecycle: owner begin -> resolve | fail
+    owned: Dict[Key, int] = {}
+    resolved: Dict[Key, int] = {}
+    close_at: Dict[str, int] = {}   # party -> index of its fl_close
+    admits: Dict[int, int] = {}
+    replies: Dict[int, int] = {}
+    admission_armed = any(e.get("name") == FL_ADMIT for e in events)
+    for i, ev in enumerate(events):
+        name = ev.get("name")
+        fields = ev.get("fields") or {}
+        if name == FL_CLAIM_BEGIN and fields.get("owner"):
+            owned.setdefault(_key(ev), i)
+        elif name in (FL_CLAIM_RESOLVE, FL_CLAIM_FAIL):
+            k = _key(ev)
+            resolved.setdefault(k, i)
+            owned.pop(k, None)
+        elif name in (FL_CLAIM_WAIT, FL_REPLAY_HIT):
+            k = _key(ev)
+            if not truncated and k not in resolved:
+                anomalies.append({
+                    "kind": "duplicate_without_resolve",
+                    "client_id": k[0], "op": k[1], "step": k[2],
+                    "message": (
+                        f"duplicate served via {name} for client {k[0]} "
+                        f"op {k[1]!r} step {k[2]} with no prior "
+                        "fl_claim_resolve in the journal"),
+                })
+        elif name == FL_CLOSE:
+            close_at.setdefault(str(ev.get("party")), i)
+        elif name == FL_DEFER_APPLY:
+            at = close_at.get(str(ev.get("party")))
+            if at is not None:
+                anomalies.append({
+                    "kind": "apply_after_close",
+                    "client_id": int(ev.get("client_id", -1)),
+                    "step": int(ev.get("step", -1)),
+                    "message": (
+                        f"deferred apply for step {ev.get('step')} "
+                        f"journaled after {ev.get('party')}'s fl_close "
+                        "— the 2BP drain outlived shutdown"),
+                })
+        if admission_armed and not truncated:
+            cid = int(ev.get("client_id", -1))
+            if name == FL_ADMIT:
+                admits[cid] = admits.get(cid, 0) + 1
+            elif name == FL_REPLY:
+                replies[cid] = replies.get(cid, 0) + 1
+                if replies[cid] > admits.get(cid, 0):
+                    anomalies.append({
+                        "kind": "reply_before_admit",
+                        "client_id": cid, "step": int(ev.get("step", -1)),
+                        "message": (
+                            f"client {cid}: reply #{replies[cid]} (step "
+                            f"{ev.get('step')}) journaled with only "
+                            f"{admits.get(cid, 0)} admissions before it"),
+                    })
+    if not truncated:
+        for k, i in sorted(owned.items(), key=lambda kv: kv[1]):
+            anomalies.append({
+                "kind": "claim_never_resolved",
+                "client_id": k[0], "op": k[1], "step": k[2],
+                "message": (
+                    f"owning claim for client {k[0]} op {k[1]!r} step "
+                    f"{k[2]} never resolved or failed — owner crashed or "
+                    "deadlocked mid-materialization"),
+            })
+    return anomalies
+
+
+def duplicates_served(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Every (client, op, step) a duplicate delivery was served for,
+    with the serve count per path — the exactly-once evidence."""
+    table: Dict[Key, Dict[str, int]] = {}
+    for ev in events:
+        name = ev.get("name")
+        if name not in (FL_CLAIM_WAIT, FL_REPLAY_HIT):
+            continue
+        row = table.setdefault(_key(ev), {"claim_wait": 0, "replay_hit": 0})
+        row["claim_wait" if name == FL_CLAIM_WAIT else "replay_hit"] += 1
+    return [{"client_id": k[0], "op": k[1], "step": k[2], **row,
+             "serves": row["claim_wait"] + row["replay_hit"]}
+            for k, row in sorted(table.items())]
+
+
+def summarize(dumps: List[Dict[str, Any]],
+              step: Optional[int] = None,
+              timeline_limit: int = 6) -> Dict[str, Any]:
+    events = merge_events(dumps)
+    truncated = any(int(d.get("dropped", 0)) > 0 for d in dumps)
+    by_name: Dict[str, int] = {}
+    for ev in events:
+        by_name[str(ev.get("name", "?"))] = \
+            by_name.get(str(ev.get("name", "?")), 0) + 1
+
+    anomalies = detect_anomalies(events, truncated)
+    dups = duplicates_served(events)
+    chaos: Dict[str, int] = {}
+    for ev in events:
+        if ev.get("name") == FL_CHAOS:
+            kind = str((ev.get("fields") or {}).get("kind", "?"))
+            chaos[kind] = chaos.get(kind, 0) + 1
+
+    # timeline selection: an explicit --step wins; else anomalous steps
+    # and duplicate-served steps first, then the earliest steps, capped
+    by_step: Dict[Tuple[int, int], List[Dict[str, Any]]] = {}
+    for ev in events:
+        s = int(ev.get("step", -1))
+        if s < 0:
+            continue
+        by_step.setdefault((int(ev.get("client_id", -1)), s),
+                           []).append(ev)
+    if step is not None:
+        chosen = [k for k in sorted(by_step) if k[1] == step]
+    else:
+        hot = {(a.get("client_id", -1), a.get("step", -1))
+               for a in anomalies}
+        hot |= {(d["client_id"], d["step"]) for d in dups}
+        chosen = [k for k in sorted(by_step) if k in hot]
+        for k in sorted(by_step):
+            if len(chosen) >= timeline_limit:
+                break
+            if k not in chosen:
+                chosen.append(k)
+        chosen = chosen[:max(timeline_limit, len(hot))]
+
+    t0 = float(events[0].get("t", 0.0)) if events else 0.0
+    timelines = {}
+    for cid, s in chosen:
+        rows = []
+        for ev in by_step[(cid, s)]:
+            fields = ev.get("fields") or {}
+            rows.append({
+                "t_rel_ms": (float(ev.get("t", 0.0)) - t0) * 1e3,
+                "party": ev.get("party"),
+                "name": ev.get("name"),
+                "trace_id": ev.get("trace_id"),
+                "fields": fields,
+            })
+        timelines[f"client {cid} step {s}"] = rows
+
+    return {
+        "dumps": [{"path": d.get("path"), "party": d.get("party"),
+                   "pid": d.get("pid"), "reason": d.get("reason"),
+                   "events": len(d.get("events", [])),
+                   "dropped": int(d.get("dropped", 0))} for d in dumps],
+        "events": len(events),
+        "truncated": truncated,
+        "by_name": dict(sorted(by_name.items())),
+        "chaos": chaos,
+        "duplicates_served": dups,
+        "timelines": timelines,
+        "anomalies": anomalies,
+    }
+
+
+def render(rep: Dict[str, Any]) -> str:
+    lines = []
+    lines.append(f"{'dump':<28} {'party':<8} {'pid':>7} {'events':>7} "
+                 f"{'dropped':>8}  reason")
+    for d in rep["dumps"]:
+        lines.append(
+            f"{str(d['path'])[-28:]:<28} {str(d['party']):<8} "
+            f"{d['pid']:>7} {d['events']:>7d} {d['dropped']:>8d}  "
+            f"{d['reason']}")
+    if rep["truncated"]:
+        lines.append("  (ring overflow: absence-based anomaly checks "
+                     "skipped — what fell off cannot be reasoned about)")
+    lines.append("")
+    lines.append(f"{'event':<20} {'count':>7}")
+    for name, n in rep["by_name"].items():
+        lines.append(f"{name:<20} {n:>7d}")
+    if rep["chaos"]:
+        lines.append("")
+        lines.append("chaos injections: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(rep["chaos"].items())))
+    dups = rep["duplicates_served"]
+    if dups:
+        lines.append("")
+        lines.append("duplicates served from the replay cache "
+                     "(exactly-once evidence):")
+        lines.append(f"  {'client':>6} {'op':<14} {'step':>5} "
+                     f"{'claim_wait':>10} {'replay_hit':>10}")
+        for d in dups:
+            lines.append(
+                f"  {d['client_id']:>6d} {str(d['op']):<14} "
+                f"{d['step']:>5d} {d['claim_wait']:>10d} "
+                f"{d['replay_hit']:>10d}")
+    for label, rows in rep["timelines"].items():
+        lines.append("")
+        lines.append(f"timeline — {label}:")
+        for r in rows:
+            extra = " ".join(f"{k}={v}" for k, v in r["fields"].items())
+            lines.append(
+                f"  {r['t_rel_ms']:>10.3f}ms {str(r['party']):<8} "
+                f"{str(r['name']):<18} {extra}")
+    lines.append("")
+    if rep["anomalies"]:
+        lines.append(f"ANOMALIES ({len(rep['anomalies'])}):")
+        for a in rep["anomalies"]:
+            lines.append(f"  [{a['kind']}] {a['message']}")
+    else:
+        lines.append("anomalies: none — every duplicate was served from "
+                     "a resolved claim, every owner resolved, no apply "
+                     "outlived close")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dumps", nargs="+",
+                    help="flight dump JSON files (client and/or server)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON instead of the tables")
+    ap.add_argument("--step", type=int, default=None,
+                    help="render the causal timeline for this step only")
+    ap.add_argument("--limit", type=int, default=6,
+                    help="max (client, step) timelines rendered (default "
+                         "6; anomalous steps always render)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when any anomaly is found (CI gate)")
+    args = ap.parse_args(argv)
+    try:
+        dumps = [load_dump(p) for p in args.dumps]
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"[postmortem] {e}", file=sys.stderr)
+        return 2
+    rep = summarize(dumps, step=args.step,
+                    timeline_limit=max(args.limit, 0))
+    try:
+        print(json.dumps(rep, indent=2) if args.json else render(rep))
+    except BrokenPipeError:  # | head
+        return 0
+    return 1 if (args.strict and rep["anomalies"]) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

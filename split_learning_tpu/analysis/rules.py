@@ -27,6 +27,9 @@ SLT013    on a mesh-aware runtime (``--mesh-data/-model``, PR 11) the
           sanctioned per-shard gather — a raw ``np.asarray``/
           ``jax.device_get`` drags every shard (padding included)
           to host on the hot path
+SLT015    flight-recorder event names at ``flight.record(...)`` call
+          sites come from the obs/spans.py ``FL_*`` registry — the
+          postmortem merge taxonomy must not drift (PR 13)
 ========  ==============================================================
 
 Rules are deliberately project-shaped: scopes are path suffixes inside
@@ -984,6 +987,60 @@ def check_slt014_pairing(srcs) -> Iterator[Finding]:
 
 
 # ---------------------------------------------------------------------- #
+# SLT015: flight-recorder event names come from the spans.py registry
+# ---------------------------------------------------------------------- #
+
+# receivers the runtime actually binds the recorder to; "fl" is the
+# conventional local (`fl = obs_flight.get_recorder()`), and anything
+# ending in "flight" catches module-level aliases
+_FLIGHT_RECEIVERS = ("fl", "flight")
+
+
+def _flight_registry() -> Set[str]:
+    """Constant names of the FL_* registry, read off obs/spans.py
+    itself so the rule can never drift from it (spans is stdlib-only,
+    so analysis stays importable on any box)."""
+    from split_learning_tpu.obs import spans
+    return {k for k in vars(spans) if k.startswith("FL_")}
+
+
+def check_slt015(src: Src) -> Iterator[Finding]:
+    if not _in_dir(src, "runtime", "transport", "obs", "launch"):
+        return
+    if _ends(src, "obs/spans.py", "obs/flight.py"):
+        return  # the registry itself and the recorder's own machinery
+    registered = None  # resolved lazily: most files have no flight calls
+    for node in ast.walk(src.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "record" and node.args):
+            continue
+        last = _unparse(node.func.value).rsplit(".", 1)[-1].lstrip("_")
+        if not (last in _FLIGHT_RECEIVERS or last.endswith("flight")):
+            continue  # a tracer/registry .record() — SLT003's turf
+        if registered is None:
+            registered = _flight_registry()
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            yield Finding(
+                "SLT015", src.path, node.lineno,
+                f"flight event name {first.value!r} passed to .record() "
+                f"as a string literal — use the obs/spans.py FL_* "
+                f"constant so the postmortem taxonomy cannot drift")
+        elif isinstance(first, ast.Attribute) \
+                and first.attr not in registered:
+            yield Finding(
+                "SLT015", src.path, node.lineno,
+                f"flight event name {_unparse(first)} is not a "
+                f"registered obs/spans.py FL_* constant")
+        elif isinstance(first, ast.Name) and first.id not in registered:
+            yield Finding(
+                "SLT015", src.path, node.lineno,
+                f"flight event name {first.id!r} is not a registered "
+                f"obs/spans.py FL_* constant")
+
+
+# ---------------------------------------------------------------------- #
 
 RULES = {
     "SLT001": (check_slt001,
@@ -1011,6 +1068,9 @@ RULES = {
     "SLT014": (check_slt014,
                "runtime/ persistence is crash-atomic: Orbax or "
                "tmp-write+rename, never in-place writes"),
+    "SLT015": (check_slt015,
+               "flight-recorder event names come from the obs/spans.py "
+               "FL_* registry, never literals or unregistered names"),
 }
 
 

@@ -1367,6 +1367,49 @@ def cmd_generate(args) -> int:
     return 0
 
 
+def _run_with_flight(args) -> int:
+    """Dispatch one subcommand under the flight recorder's process-level
+    dump triggers (obs/flight.py): ``--flight PATH`` arms the recorder
+    and dumps the journal on normal exit (trigger #4); SIGTERM and a
+    fatal exception dump it on the way down (trigger #2). With neither
+    the flag nor ``SLT_FLIGHT`` set this is a plain ``args.fn(args)`` —
+    the recorder stays ``None`` and nothing here allocates."""
+    from split_learning_tpu.obs import flight as obs_flight
+    party = "server" if args.cmd == "serve" else "client"
+    flight_path = getattr(args, "flight", None)
+    if flight_path:
+        # the CLI flag is both switch and dump path; it wins over any
+        # recorder SLT_FLIGHT already armed
+        obs_flight.enable(party=party, dump_path=flight_path)
+    else:
+        obs_flight.maybe_enable_from_env(party=party)
+    if obs_flight.enabled():
+        import signal
+
+        def _on_sigterm(signum, frame):
+            obs_flight.fatal("sigterm", f"signal {signum}")
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+        try:
+            signal.signal(signal.SIGTERM, _on_sigterm)
+        except ValueError:
+            pass  # not the main thread (embedded use): no signal hook
+    try:
+        rc = args.fn(args)
+    except Exception as exc:
+        # fatal-exception dump: journal what led up to the crash, then
+        # let the exception propagate untouched
+        obs_flight.fatal(type(exc).__name__, str(exc))
+        raise
+    fl = obs_flight.get_recorder()
+    if fl is not None and fl.dump_path:
+        out = fl.dump_json(fl.dump_path, reason="exit")
+        print(f"[flight] {len(fl.events())} events -> {out} "
+              "(merge with scripts/postmortem.py)", file=sys.stderr)
+    return rc
+
+
 def main(argv: Optional[list] = None) -> int:
     from split_learning_tpu.utils import ensure_pinned_platform_hermetic
     ensure_pinned_platform_hermetic()  # JAX_PLATFORMS=cpu must never dial
@@ -1393,6 +1436,12 @@ def main(argv: Optional[list] = None) -> int:
                          "trace JSON here on exit (Perfetto-loadable; "
                          "summarize with scripts/trace_report.py). Off = "
                          "zero overhead")
+    pt.add_argument("--flight", default=None, metavar="PATH",
+                    help="flight recorder (obs/flight.py): journal causal "
+                         "runtime events into a bounded ring and dump "
+                         "them here as JSON on exit / SIGTERM / fatal "
+                         "exception / watchdog trip (merge with "
+                         "scripts/postmortem.py). Off = zero overhead")
     pt.add_argument("--scan-steps", dest="scan_steps", type=int, default=0,
                     help="fused transport: batch N steps per device "
                          "dispatch via lax.scan (per-step losses still "
@@ -1613,6 +1662,11 @@ def main(argv: Optional[list] = None) -> int:
                          "and write a Chrome trace here on shutdown. "
                          "Off = zero overhead (/metrics stays up but "
                          "histograms stay empty)")
+    ps.add_argument("--flight", default=None, metavar="PATH",
+                    help="flight recorder (obs/flight.py): journal causal "
+                         "server events; dump JSON here on shutdown / "
+                         "SIGTERM / watchdog trip, or fetch the live ring "
+                         "via GET /debug/flight. Off = zero overhead")
     ps.set_defaults(fn=cmd_serve)
 
     pe = sub.add_parser("eval", help="evaluate a checkpoint on the test split")
@@ -1652,7 +1706,7 @@ def main(argv: Optional[list] = None) -> int:
     pg.set_defaults(fn=cmd_generate)
 
     args = ap.parse_args(argv)
-    return args.fn(args)
+    return _run_with_flight(args)
 
 
 if __name__ == "__main__":

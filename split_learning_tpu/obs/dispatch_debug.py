@@ -215,6 +215,14 @@ class DispatchTracker:
         # caller holds self._mu
         self.violations.append(violation)
         print(f"[slt-dispatch] {violation['message']}", file=sys.stderr)
+        # flight-recorder dump trigger #1 (obs/flight.py): lazy import
+        # keeps this module importable standalone; trip() never raises
+        # and takes no locks, so it is safe under self._mu
+        try:
+            from split_learning_tpu.obs import flight as obs_flight
+            obs_flight.trip("dispatch", violation["message"])
+        except Exception:
+            pass
 
     def gauges(self) -> Dict[str, float]:
         """The watchdog's /metrics contribution (runtimes fold this into

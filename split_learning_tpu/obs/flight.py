@@ -1,0 +1,246 @@
+"""Flight recorder — a bounded causal event journal for postmortems.
+
+The preventive machinery (slt-lint, the lock/dispatch watchdogs,
+slt-check, slt-crash) proves invariants hold *before* a run; this module
+is the evidence when a live run misbehaves anyway. Each party keeps a
+bounded ring of structured causal events — admission admit/reject,
+replay claim begin/resolve/fail/wait, coalesce group form/pickup,
+deferred-apply enqueue/drain/flush, breaker transitions, chaos
+injections, checkpoint capture/commit/lineage, mesh dispatch + gather —
+each stamped with a monotonic per-process sequence number, the step, the
+client_id, and the PR-2 trace ID so ``scripts/postmortem.py`` can merge
+client and server dumps into one per-step causal timeline.
+
+Event *names* live in obs/spans.py (``FL_*`` / ``FLIGHT_EVENTS``) — the
+registry discipline spans already follow (SLT003); slt-lint rule SLT015
+flags any ``flight.record(...)`` call site that spells a name as a
+string literal or names an unregistered constant.
+
+ZERO-OVERHEAD-OFF CONTRACT (the tracer's, verbatim): the global recorder
+defaults to ``None`` and every instrumentation site is gated on
+``get_recorder() is None`` — with the recorder off no event tuple is
+allocated, no recorder object is touched, and the wire and loss series
+are bit-for-bit the legacy ones (pinned in tests/test_flight.py).
+
+RECORD PATH IS LOCK-LIGHT BY CONSTRUCTION: the ring is a
+``deque(maxlen=...)`` (thread-safe append in CPython, oldest falls off)
+and the sequence is ``itertools.count().__next__`` (atomic). No lock is
+taken on :meth:`FlightRecorder.record`, so instrumentation sites may
+safely record while holding runtime locks — including the watchdogs'
+own report paths (:func:`trip` is called from LockGraph._report /
+DispatchTracker._report while their graph lock is held).
+
+Dumps fire on four triggers:
+
+1. a lock/dispatch watchdog trip (obs/locks.py, obs/dispatch_debug.py
+   call :func:`trip`);
+2. SIGTERM or a fatal exception in ``launch/run.py``;
+3. ``GET /debug/flight`` on ``SplitHTTPServer`` (JSON over the wire);
+4. the CLI ``--flight PATH`` flag (dump on normal exit).
+
+``SLT_FLIGHT`` enables from the environment: ``1``/``true``/``on``
+turns the recorder on; any other non-empty value is both "on" AND the
+dump path the trip/fatal triggers write to. ``SLT_FLIGHT_CAPACITY``
+sizes the ring (default 65536 events).
+
+The event *names* stay stdlib-only in obs/spans.py (importable by the
+linter and scripts/postmortem.py's pin test); this module itself rides
+on obs/trace.py for the CTX thread-local and is jax-free — the
+watchdogs import it lazily inside their report paths.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import socket
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from split_learning_tpu.obs import spans
+from split_learning_tpu.obs import trace as obs_trace
+
+DEFAULT_CAPACITY = 65_536
+
+# names that mean "on, no dump path" when found in SLT_FLIGHT
+_TRUTHY = ("1", "true", "on", "yes")
+
+
+class FlightRecorder:
+    """The bounded event ring for one process/party.
+
+    ``party`` labels every dump (``"client"`` / ``"server"`` /
+    ``"proc"``); a single-process run (LocalTransport) records both
+    parties into one ring and tags each event with its party instead.
+    """
+
+    def __init__(self, party: str = "proc",
+                 capacity: int = DEFAULT_CAPACITY,
+                 dump_path: Optional[str] = None) -> None:
+        if capacity < 1:
+            raise ValueError("flight ring capacity must be >= 1")
+        self.party = party
+        self.capacity = int(capacity)
+        self.dump_path = dump_path
+        self._events: deque = deque(maxlen=self.capacity)
+        self._seq = itertools.count()
+        self._t0_wall = time.time()
+        self._t0_mono = time.monotonic()
+        # dump serialization only — never taken on the record path
+        self._dump_lock = threading.Lock()
+
+    # -------------------------------------------------------------- #
+    def record(self, name: str, *, step: int = -1, client_id: int = -1,
+               trace_id: Optional[str] = None, party: Optional[str] = None,
+               **fields: Any) -> None:
+        """Journal one causal event. ``name`` must be a registered
+        ``spans.FL_*`` constant (slt-lint SLT015). ``trace_id`` defaults
+        to the in-flight ``obs_trace.CTX.trace_id`` so events correlate
+        across the wire without every call site threading it through.
+        Extra keyword ``fields`` ride along verbatim (JSON-safe values
+        only — they go straight into the dump)."""
+        if trace_id is None:
+            trace_id = obs_trace.CTX.trace_id
+        # wall-clock timestamp derived from one monotonic base so the
+        # postmortem merge order is immune to clock steps within a run
+        t = self._t0_wall + (time.monotonic() - self._t0_mono)
+        self._events.append((next(self._seq), t, name,
+                             party if party is not None else self.party,
+                             int(step), int(client_id), trace_id,
+                             fields or None))
+
+    # -------------------------------------------------------------- #
+    def events(self) -> List[Dict[str, Any]]:
+        """The ring as dicts, oldest first. Snapshot via list() — safe
+        against concurrent appends (CPython deque iteration over a
+        moment-in-time copy)."""
+        return [{"seq": q, "t": t, "name": n, "party": p, "step": s,
+                 "client_id": c, "trace_id": tr, "fields": f}
+                for q, t, n, p, s, c, tr, f in list(self._events)]
+
+    def dump(self, reason: str = "manual") -> Dict[str, Any]:
+        """The full dump payload scripts/postmortem.py consumes."""
+        events = self.events()
+        # seq is dense from 0, so the newest event says how many were
+        # ever recorded — without touching (and consuming) the counter
+        dropped = (events[-1]["seq"] + 1 - len(events)) if events else 0
+        return {
+            "version": 1,
+            "kind": "slt-flight-dump",
+            "party": self.party,
+            "pid": os.getpid(),
+            "host": socket.gethostname(),
+            "captured_at": time.time(),
+            "reason": reason,
+            "capacity": self.capacity,
+            "dropped": dropped,
+            "events": events,
+        }
+
+    def dump_json(self, path: str, reason: str = "manual") -> str:
+        """Write the dump crash-atomically (tmp + fsync + rename — the
+        checkpoint discipline: a reader never sees a torn dump)."""
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        with self._dump_lock:
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(self.dump(reason=reason), f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        return path
+
+
+# ------------------------------------------------------------------ #
+# the global switch — None means OFF and is the default (obs/trace.py
+# discipline: instrumentation sites gate on ``get_recorder() is None``)
+# ------------------------------------------------------------------ #
+_recorder: Optional[FlightRecorder] = None
+_switch_lock = threading.Lock()
+
+
+def enable(party: str = "proc", capacity: Optional[int] = None,
+           dump_path: Optional[str] = None) -> FlightRecorder:
+    """Install (and return) a fresh global recorder. Call sites pick it
+    up on their next event; no restart needed."""
+    global _recorder
+    if capacity is None:
+        capacity = int(os.environ.get("SLT_FLIGHT_CAPACITY",
+                                      DEFAULT_CAPACITY))
+    with _switch_lock:
+        _recorder = FlightRecorder(party=party, capacity=capacity,
+                                   dump_path=dump_path)
+        return _recorder
+
+
+def disable() -> Optional[FlightRecorder]:
+    """Turn recording off; returns the recorder that was active (so
+    callers can still dump what it collected)."""
+    global _recorder
+    with _switch_lock:
+        r, _recorder = _recorder, None
+        return r
+
+
+def get_recorder() -> Optional[FlightRecorder]:
+    return _recorder
+
+
+def enabled() -> bool:
+    return _recorder is not None
+
+
+def maybe_enable_from_env(party: str = "proc") -> Optional[FlightRecorder]:
+    """Honor ``SLT_FLIGHT``: truthy ("1"/"true"/"on"/"yes") enables; any
+    other non-empty value enables AND sets the trip/fatal dump path."""
+    val = os.environ.get("SLT_FLIGHT", "")
+    if val and not enabled():
+        path = None if val.strip().lower() in _TRUTHY else val
+        return enable(party=party, dump_path=path)
+    return get_recorder()
+
+
+# ------------------------------------------------------------------ #
+# dump triggers
+# ------------------------------------------------------------------ #
+def trip(source: str, message: str) -> Optional[str]:
+    """Watchdog-trip hook (obs/locks.py LockGraph._report and
+    obs/dispatch_debug.py DispatchTracker._report). Records a
+    ``FL_WATCHDOG_TRIP`` event and, when a dump path is configured,
+    writes the dump there. Never raises and never blocks on runtime
+    locks — it is called while the reporting watchdog holds its own
+    graph lock. Returns the dump path written, or None."""
+    fl = get_recorder()
+    if fl is None:
+        return None
+    try:
+        fl.record(spans.FL_WATCHDOG_TRIP, source=source,
+                  message=str(message)[:500])
+        if fl.dump_path:
+            return fl.dump_json(fl.dump_path, reason=f"watchdog:{source}")
+    except Exception:
+        pass  # a broken dump path must not mask the watchdog's report
+    return None
+
+
+def fatal(reason: str, message: str = "",
+          path: Optional[str] = None) -> Optional[str]:
+    """SIGTERM / fatal-exception hook (launch/run.py). Records
+    ``FL_FATAL`` and dumps to ``path`` (or the configured dump path).
+    Never raises — crash handling must not crash."""
+    fl = get_recorder()
+    if fl is None:
+        return None
+    try:
+        fl.record(spans.FL_FATAL, reason=reason,
+                  message=str(message)[:500])
+        target = path or fl.dump_path
+        if target:
+            return fl.dump_json(target, reason=f"fatal:{reason}")
+    except Exception:
+        pass
+    return None

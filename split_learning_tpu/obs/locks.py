@@ -105,6 +105,15 @@ class LockGraph:
         # caller holds self._lock
         self.violations.append(violation)
         print(f"[slt-lock] {violation['message']}", file=sys.stderr)
+        # flight-recorder dump trigger #1 (obs/flight.py): lazy import —
+        # this module must stay importable with obs.flight's deps absent
+        # — and trip() never raises and takes no locks, so calling it
+        # while holding self._lock cannot deadlock or mask the report
+        try:
+            from split_learning_tpu.obs import flight as obs_flight
+            obs_flight.trip("lock", violation["message"])
+        except Exception:
+            pass
 
     def clear(self) -> None:
         with self._lock:

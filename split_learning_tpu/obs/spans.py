@@ -78,6 +78,49 @@ MESH_META = "mesh_meta"
 # (a compile nests inside ``dispatch``, counting both would double-book)
 COMPILE = "xla_compile"
 
+# -- flight-recorder events (obs/flight.py, PR 13) --------------------- #
+# Causal runtime events — NOT spans (no duration; a flight event is a
+# point in a per-process sequence, not a timeline tile) and therefore
+# deliberately NOT in the phase tuples below, which trace_report.py's
+# stdlib fallback mirrors byte-equal. scripts/postmortem.py carries its
+# own literal fallback copy of FLIGHT_EVENTS; tests/test_analysis.py
+# pins that copy equal to this tuple (the admission_* precedent).
+# slt-lint SLT015 enforces that every ``flight.record(...)`` call site
+# names one of these via this registry, never a string literal.
+FL_ADMIT = "fl_admit"                    # admission granted (EDF deadline set)
+FL_REJECT = "fl_reject"                  # Backpressure raised (quota/queue)
+FL_CLAIM_BEGIN = "fl_claim_begin"        # replay claim decided (owner or not)
+FL_CLAIM_RESOLVE = "fl_claim_resolve"    # owner published the reply
+FL_CLAIM_FAIL = "fl_claim_fail"          # owner failed; claim removed
+FL_CLAIM_WAIT = "fl_claim_wait"          # non-owner woke on a resolved claim
+FL_REPLAY_HIT = "fl_replay_hit"          # wire-path duplicate served from cache
+FL_GROUP_FORM = "fl_group_form"          # request enqueued at the coalescer
+FL_GROUP_PICKUP = "fl_group_pickup"      # flusher collected a group
+FL_DISPATCH = "fl_dispatch"              # jitted server program dispatched
+FL_REPLY = "fl_reply"                    # reply handed back to the caller
+FL_DEFER_ENQ = "fl_defer_enqueue"        # deferred weight-apply queued (2BP)
+FL_DEFER_APPLY = "fl_defer_apply"        # one deferred apply dispatched
+FL_DEFER_FLUSH = "fl_defer_flush"        # deferred queue drained (lag/close)
+FL_BREAKER = "fl_breaker"                # circuit breaker state transition
+FL_CHAOS = "fl_chaos"                    # fault injected by the chaos wire
+FL_CKPT_CAPTURE = "fl_ckpt_capture"      # runtime extras captured (lineage++)
+FL_CKPT_COMMIT = "fl_ckpt_commit"        # extras durably committed (rename)
+FL_CKPT_LINEAGE = "fl_ckpt_lineage"      # lineage adopted on restore/scan
+FL_GATHER = "fl_gather"                  # sanctioned sharded host-gather
+FL_SEND = "fl_send"                      # client posted a request
+FL_RECV = "fl_recv"                      # party received a request/reply
+FL_CLOSE = "fl_close"                    # runtime close entered
+FL_WATCHDOG_TRIP = "fl_watchdog_trip"    # lock/dispatch watchdog violation
+FL_FATAL = "fl_fatal"                    # SIGTERM / fatal exception dump
+
+FLIGHT_EVENTS = (
+    FL_ADMIT, FL_REJECT, FL_CLAIM_BEGIN, FL_CLAIM_RESOLVE, FL_CLAIM_FAIL,
+    FL_CLAIM_WAIT, FL_REPLAY_HIT, FL_GROUP_FORM, FL_GROUP_PICKUP,
+    FL_DISPATCH, FL_REPLY, FL_DEFER_ENQ, FL_DEFER_APPLY, FL_DEFER_FLUSH,
+    FL_BREAKER, FL_CHAOS, FL_CKPT_CAPTURE, FL_CKPT_COMMIT,
+    FL_CKPT_LINEAGE, FL_GATHER, FL_SEND, FL_RECV, FL_CLOSE,
+    FL_WATCHDOG_TRIP, FL_FATAL)
+
 # the client-level phases that tile a step — the denominator of the
 # compute-vs-wire fraction (encode/wire are sub-phases of transport and
 # queue_wait/dispatch belong to the server party; counting either would

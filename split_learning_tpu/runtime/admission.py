@@ -30,6 +30,7 @@ from __future__ import annotations
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Union
 
+from split_learning_tpu.obs import flight as obs_flight
 from split_learning_tpu.obs import locks as obs_locks
 from split_learning_tpu.obs import spans
 from split_learning_tpu.transport.base import Backpressure
@@ -130,11 +131,20 @@ class AdmissionController:
             if retry_after is None:
                 self._admitted[t] += 1
                 self._depth[t] += 1
+        fl = obs_flight.get_recorder()
         if retry_after is not None:
+            if fl is not None:
+                fl.record(spans.FL_REJECT, client_id=int(client_id),
+                          party="server", tenant=t,
+                          retry_after_s=retry_after)
             raise Backpressure(
                 f"tenant {t} over quota ({self._quota[t]:g} steps/s): "
                 f"retry in {retry_after:.3f}s", retry_after_s=retry_after)
-        return (now + self._slo_s[t]) if self._slo_s is not None else None
+        deadline = (now + self._slo_s[t]) if self._slo_s is not None else None
+        if fl is not None:
+            fl.record(spans.FL_ADMIT, client_id=int(client_id),
+                      party="server", tenant=t, deadline=deadline)
+        return deadline
 
     def complete(self, client_id: int) -> None:
         """Release the in-flight slot an :meth:`admit` charged (success

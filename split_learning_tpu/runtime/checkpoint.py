@@ -25,6 +25,9 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from split_learning_tpu.obs import flight as obs_flight
+from split_learning_tpu.obs import spans
+
 
 class Checkpointer:
     """Thin wrapper over orbax CheckpointManager for step-indexed saves."""
@@ -300,6 +303,10 @@ def write_extras(directory: str, payload: Dict[str, Any],
     fs.put(tmp, blob)
     fs.fsync(tmp)
     fs.rename(tmp, final)
+    fl = obs_flight.get_recorder()
+    if fl is not None:
+        fl.record(spans.FL_CKPT_COMMIT, step=int(payload["step"]),
+                  party="server", lineage=int(payload["lineage"]))
     return final
 
 
@@ -325,6 +332,11 @@ def read_latest_extras(directory: str, fs: Any = None,
             continue
         if step is not None and payload["step"] != int(step):
             continue
+        fl = obs_flight.get_recorder()
+        if fl is not None:
+            fl.record(spans.FL_CKPT_LINEAGE, step=int(payload["step"]),
+                      party="server", lineage=int(payload["lineage"]),
+                      source=name)
         return payload
     return None
 

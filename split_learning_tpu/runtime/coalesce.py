@@ -178,7 +178,17 @@ class RequestCoalescer:
             req.seq = self._arrivals
             self._arrivals += 1
             self._queue.append(req)
+            depth = len(self._queue)
             self._cond.notify_all()
+        # lazy import, like the obs_trace republish below: the flight
+        # recorder must not land on the pure queue unit tests' surface
+        from split_learning_tpu.obs import flight as obs_flight
+        fl = obs_flight.get_recorder()
+        if fl is not None:
+            from split_learning_tpu.obs import spans
+            fl.record(spans.FL_GROUP_FORM, step=int(step),
+                      client_id=int(client_id), party="server",
+                      depth=depth)
         if not req.done.wait(timeout=timeout):
             raise TimeoutError(
                 f"coalesced split_step for client {client_id} step {step} "
@@ -274,6 +284,13 @@ class RequestCoalescer:
             if got is None:
                 return
             group, reason = got
+            from split_learning_tpu.obs import flight as obs_flight
+            fl = obs_flight.get_recorder()
+            if fl is not None:
+                from split_learning_tpu.obs import spans
+                fl.record(spans.FL_GROUP_PICKUP, step=int(group[0].step),
+                          client_id=int(group[0].client_id),
+                          party="server", size=len(group), reason=reason)
             t0 = time.perf_counter()
             try:
                 self._dispatch(group, reason)

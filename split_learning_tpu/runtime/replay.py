@@ -41,7 +41,9 @@ from __future__ import annotations
 from collections import OrderedDict
 from typing import Any, Dict, Optional, Tuple
 
+from split_learning_tpu.obs import flight as obs_flight
 from split_learning_tpu.obs import locks as obs_locks
+from split_learning_tpu.obs import spans
 
 Key = Tuple[int, str, int]  # (client_id, op, step)
 
@@ -99,12 +101,19 @@ class ReplayCache:
         key = (int(client_id), op, int(step))
         with self._lock:
             entry = self._entries.get(key)
-            if entry is not None:
-                return entry, False
-            entry = _Entry(key)
-            self._entries[key] = entry
-            self._evict_locked(int(client_id), op)
-            return entry, True
+            if entry is None:
+                entry = _Entry(key)
+                self._entries[key] = entry
+                self._evict_locked(int(client_id), op)
+                owner = True
+            else:
+                owner = False
+        fl = obs_flight.get_recorder()
+        if fl is not None:
+            fl.record(spans.FL_CLAIM_BEGIN, step=int(step),
+                      client_id=int(client_id), party="server",
+                      op=op, owner=owner)
+        return entry, owner
 
     def resolve(self, entry: _Entry, result: Any) -> None:
         """Publish the owner's materialized result and wake waiters.
@@ -115,6 +124,11 @@ class ReplayCache:
             entry.result = result
             entry.done = True
         entry.event.set()
+        fl = obs_flight.get_recorder()
+        if fl is not None:
+            cid, op, step = entry.key
+            fl.record(spans.FL_CLAIM_RESOLVE, step=step, client_id=cid,
+                      party="server", op=op)
 
     def fail(self, entry: _Entry, error: BaseException) -> None:
         """Owner's apply never produced a result (admission 409, dispatch
@@ -127,6 +141,11 @@ class ReplayCache:
             if self._entries.get(entry.key) is entry:
                 del self._entries[entry.key]
         entry.event.set()
+        fl = obs_flight.get_recorder()
+        if fl is not None:
+            cid, op, step = entry.key
+            fl.record(spans.FL_CLAIM_FAIL, step=step, client_id=cid,
+                      party="server", op=op, error=type(error).__name__)
 
     def wait(self, entry: _Entry, timeout: float = 120.0) -> Any:
         """Block a duplicate on the in-flight future; counts the hit.
@@ -140,7 +159,13 @@ class ReplayCache:
             raise entry.error
         with self._lock:
             self.hits += 1
-            return entry.result
+            result = entry.result
+        fl = obs_flight.get_recorder()
+        if fl is not None:
+            cid, op, step = entry.key
+            fl.record(spans.FL_CLAIM_WAIT, step=step, client_id=cid,
+                      party="server", op=op)
+        return result
 
     # -- value-level back-compat surface ------------------------------- #
     def get(self, client_id: int, op: str, step: int) -> Optional[Any]:
@@ -218,9 +243,16 @@ class ReplayCache:
         with self._lock:
             if entry.body is not None:
                 self.body_hits += 1
-                return entry.body, None
-            self.hits += 1
-            return None, entry.result
+                body, result = entry.body, None
+            else:
+                self.hits += 1
+                body, result = None, entry.result
+        fl = obs_flight.get_recorder()
+        if fl is not None:
+            fl.record(spans.FL_REPLAY_HIT, step=int(step),
+                      client_id=int(client_id), party="server", op=op,
+                      body=body is not None)
+        return body, result
 
     # ------------------------------------------------------------------ #
     def _evict_locked(self, client_id: int, op: str) -> None:

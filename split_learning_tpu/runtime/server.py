@@ -31,6 +31,7 @@ from split_learning_tpu.core.losses import (
     cross_entropy, per_example_cross_entropy)
 from split_learning_tpu.core.stage import SplitPlan
 from split_learning_tpu.obs import dispatch_debug as obs_dispatch
+from split_learning_tpu.obs import flight as obs_flight
 from split_learning_tpu.obs import locks as obs_locks
 from split_learning_tpu.obs import spans
 from split_learning_tpu.obs import trace as obs_trace
@@ -312,6 +313,9 @@ class ServerRuntime:
         # reject a sidecar that does not belong to the Orbax step it
         # actually restored
         self._ckpt_lineage = 0
+        # build attribution for /health, /metrics and trace_metadata():
+        # uptime measured from runtime construction
+        self._t_start = time.monotonic()
 
     # ------------------------------------------------------------------ #
     def _build_jitted(self) -> None:
@@ -526,6 +530,10 @@ class ServerRuntime:
             # gather-byte accounting is mesh-only so the legacy hot path
             # does not grow even a counter update
             self._metrics.incr(spans.GATHER_BYTES, float(out.nbytes))
+            fl = obs_flight.get_recorder()
+            if fl is not None:
+                fl.record(spans.FL_GATHER, party="server",
+                          nbytes=int(out.nbytes))
         return out
 
     def _note_flops(self, name: str, fn: Any, args: Tuple[Any, ...],
@@ -588,10 +596,15 @@ class ServerRuntime:
                 "mfu": (mfu(rate, peak * n_dev)
                         if (peak and rate) else None),
             }
+        from split_learning_tpu.version import __version__
         return {"mesh": mesh_info,
                 "gather_bytes": int(gather),
                 "peak_flops_per_device": peak,
-                "programs": programs}
+                "programs": programs,
+                # build attribution: every trace/dump names the build it
+                # came from (ISSUE 13 — same fields as /health)
+                "build": {"version": __version__,
+                          "uptime_seconds": time.monotonic() - self._t_start}}
 
     def split_step(self, activations: np.ndarray, labels: np.ndarray,
                    step: int, client_id: int = 0) -> Tuple[np.ndarray, float]:
@@ -643,6 +656,11 @@ class ServerRuntime:
                 if admitted:
                     admitted = False
                     self._admission.complete(client_id)
+                fl = obs_flight.get_recorder()
+                if fl is not None:
+                    fl.record(spans.FL_REPLY, step=step,
+                              client_id=client_id, party="server",
+                              op="split_step", coalesced=True)
                 return res
             t_q0 = time.perf_counter() if tr is not None else 0.0
             with self._lock:
@@ -712,6 +730,12 @@ class ServerRuntime:
                 if self.on_step is not None:
                     self.on_step(acked)
                 t_d1 = time.perf_counter() if tr is not None else 0.0
+            fl = obs_flight.get_recorder()
+            if fl is not None:
+                fl.record(spans.FL_DISPATCH, step=step,
+                          client_id=client_id, party="server",
+                          program=("reply_grad" if self._deferred
+                                   is not None else "split_step"))
             if self.overlap:
                 # off the lock: the jitted call above returned device
                 # futures (async dispatch), so forcing the transfer here
@@ -735,6 +759,10 @@ class ServerRuntime:
             if admitted:
                 admitted = False
                 self._admission.complete(client_id)
+            if fl is not None:
+                fl.record(spans.FL_REPLY, step=step, client_id=client_id,
+                          party="server", op="split_step",
+                          coalesced=False)
             if tr is not None:
                 self._record_server_spans(
                     tr, t_q0, t_d0 - t_q0, t_d0, t_d1 - t_d0, t_d1,
@@ -820,6 +848,11 @@ class ServerRuntime:
                       trace_id=obs_trace.CTX.trace_id, party="server",
                       tid=entry["client_id"], step=entry["step"])
             self._metrics.observe(spans.DEFERRED_APPLY, dw)
+        fl = obs_flight.get_recorder()
+        if fl is not None:
+            fl.record(spans.FL_DEFER_APPLY, step=entry["step"],
+                      client_id=entry["client_id"], party="server",
+                      kind=entry["kind"])
 
     def flush_deferred(self) -> int:
         """Flush barrier: apply every queued deferred update now, in
@@ -859,11 +892,16 @@ class ServerRuntime:
             if self._deferred is not None:
                 self._deferred.flush()
             self._ckpt_lineage += 1
-            return _ckpt.build_extras(
+            payload = _ckpt.build_extras(
                 step, self._ckpt_lineage,
                 replay=(self.replay.export_state()
                         if self.replay is not None else None),
                 wire_ef=self.wire_ef.export_state())
+        fl = obs_flight.get_recorder()
+        if fl is not None:
+            fl.record(spans.FL_CKPT_CAPTURE, step=int(step),
+                      party="server", lineage=payload["lineage"])
+        return payload
 
     def _dispatch_group(self, group: "list[CoalesceRequest]",
                         reason: str) -> None:
@@ -981,6 +1019,17 @@ class ServerRuntime:
                     g_acts = self._host_gather(g_acts, rows=total)
                     per_ex = self._host_gather(per_ex, rows=total)
             dw = time.perf_counter() - t_d0 if tr is not None else 0.0
+            fl = obs_flight.get_recorder()
+            if fl is not None:
+                # one causal event for the whole batched dispatch; the
+                # per-member replies are journaled by split_step
+                fl.record(spans.FL_DISPATCH,
+                          step=max(r.step for r in admitted),
+                          party="server",
+                          program=("group_reply" if self._deferred
+                                   is not None else "coalesced_step"),
+                          size=len(admitted), rows=total, padded=padded,
+                          reason=reason)
             pg = (_GroupD2H(self, g_acts, per_ex, tr, rows=total)
                   if self.overlap else None)
             off = 0
@@ -1260,6 +1309,11 @@ class ServerRuntime:
                 # drop any pre-restore FedAvg submissions: averaging stale
                 # params into the first post-restore round would corrupt it
                 self._agg = FedAvgAggregator(self._agg.num_clients)
+        fl = obs_flight.get_recorder()
+        if fl is not None:
+            fl.record(spans.FL_CKPT_LINEAGE, step=int(step),
+                      party="server", use_extras=use_extras,
+                      lineage=self._ckpt_lineage)
 
     def note_wire_compression(self, raw_bytes: int, wire_bytes: int) -> None:
         """Fold one compressed exchange (logical fp32 bytes vs bytes on
@@ -1288,12 +1342,17 @@ class ServerRuntime:
         with self._lock:
             step = max(self._last_step.values(), default=-1)
             step = max(step, self._step_floor)
+        from split_learning_tpu.version import __version__
         info = {"status": "healthy", "mode": self.mode,
                 "model_type": model_type, "step": step,
                 # pipelined clients (depth > 1) need this False: with W
                 # lanes in flight, arrival order is a thread race and the
                 # strict handshake would 409 nondeterministically
-                "strict_steps": self.strict_steps}
+                "strict_steps": self.strict_steps,
+                # build attribution (ISSUE 13): dumps, traces, and
+                # scrapes all name the build they came from
+                "version": __version__,
+                "uptime_seconds": time.monotonic() - self._t_start}
         if self._coalescer is not None:
             info["coalescing"] = {
                 "coalesce_max": self._coalescer.max_group,
@@ -1325,6 +1384,7 @@ class ServerRuntime:
         snap = self._metrics.snapshot()
         h = self.health()
         snap["gauges"]["acked_step"] = float(h["step"])
+        snap["gauges"]["uptime_seconds"] = float(h["uptime_seconds"])
         for k, v in h.get("coalescing", {}).items():
             if isinstance(v, (int, float)):
                 snap["counters"][f"coalesce_{k}"] = float(v)
@@ -1385,6 +1445,9 @@ class ServerRuntime:
         not dropped: the replies for these steps already went out, so a
         clean shutdown must land their updates (the mid-run close()
         drain SLT108 pins)."""
+        fl = obs_flight.get_recorder()
+        if fl is not None:
+            fl.record(spans.FL_CLOSE, party="server")
         if self._coalescer is not None:
             self._coalescer.close()
         if self._deferred is not None:
@@ -1425,6 +1488,12 @@ class _DeferredApply:
         with self._lock:
             self._q.append(entry)
             self._enqueued += 1
+            depth = len(self._q)
+        fl = obs_flight.get_recorder()
+        if fl is not None:
+            fl.record(spans.FL_DEFER_ENQ, step=entry["step"],
+                      client_id=entry["client_id"], party="server",
+                      kind=entry["kind"], depth=depth)
 
     def drain_over_lag(self) -> int:
         """Apply oldest entries until depth <= lag (the staleness
@@ -1449,6 +1518,12 @@ class _DeferredApply:
                 n += 1
             if n:
                 self._flushes += 1
+        if n:
+            fl = obs_flight.get_recorder()
+            if fl is not None:
+                fl.record(spans.FL_DEFER_FLUSH, party="server",
+                          applied=n,
+                          mode=("over_lag" if limit_to_lag else "flush"))
         return n
 
     def clear(self) -> int:

@@ -56,6 +56,8 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from split_learning_tpu.obs import flight as obs_flight
+from split_learning_tpu.obs import spans
 from split_learning_tpu.transport.base import Transport, TransportError
 
 FAULT_KINDS = ("drop_req", "drop_resp", "dup", "delay", "corrupt",
@@ -184,6 +186,10 @@ class ChaosTransport(Transport):
         kind, arg = fault
         self.policy.count(kind)
         self.stats.incr(f"chaos_{kind}")
+        fl = obs_flight.get_recorder()
+        if fl is not None:
+            fl.record(spans.FL_CHAOS, step=int(step), party="client",
+                      kind=kind, path=path, attempt=attempt)
         if kind == "delay":
             time.sleep(arg / 1e3)
             return call()

@@ -21,6 +21,7 @@ logic, N wire formats (SURVEY.md §7 layering).
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -29,6 +30,7 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 import requests
 
+from split_learning_tpu.obs import flight as obs_flight
 from split_learning_tpu.obs import spans
 from split_learning_tpu.obs import trace as obs_trace
 from split_learning_tpu.transport import codec
@@ -125,11 +127,34 @@ class SplitHTTPServer:
                     # step hot path)
                     from split_learning_tpu.obs.metrics import (
                         render_prometheus)
+                    from split_learning_tpu.version import __version__
                     snap = (outer.runtime.metrics()
                             if hasattr(outer.runtime, "metrics") else {})
+                    text = render_prometheus(snap)
+                    # build-info gauge with a version label — the one
+                    # labeled series we export, so it is rendered here
+                    # (render_prometheus's snapshot names are label-free)
+                    text += (f'slt_build_info{{version="{__version__}"}}'
+                             f" 1\n")
                     self._reply(
-                        200, render_prometheus(snap).encode("utf-8"),
+                        200, text.encode("utf-8"),
                         ctype="text/plain; version=0.0.4; charset=utf-8")
+                elif self.path == "/debug/flight":
+                    # flight-recorder dump trigger #3 (obs/flight.py):
+                    # the in-memory ring as JSON. 404 with the recorder
+                    # off — the off-path serves exactly the legacy
+                    # routes. Authenticated-free by design, like /health
+                    # and /metrics: the journal carries event metadata
+                    # (steps, ids, names), never tensor payloads.
+                    fl = obs_flight.get_recorder()
+                    if fl is None:
+                        self._reply(404, codec.encode(
+                            {"error": "flight recorder off "
+                                      "(SLT_FLIGHT/--flight)"}))
+                    else:
+                        body = json.dumps(
+                            fl.dump(reason="http")).encode("utf-8")
+                        self._reply(200, body, ctype="application/json")
                 else:
                     self._reply(404, codec.encode({"error": "not found"}))
 
@@ -163,9 +188,26 @@ class SplitHTTPServer:
                             (cid, self.path, int(req["step"])))
                         fault = outer.chaos.draw(self.path,
                                                  int(req["step"]), attempt)
+                    fl = obs_flight.get_recorder()
+                    if fl is not None:
+                        # CTX adoption happens below; pass the client's
+                        # trace id explicitly so even pre-adoption
+                        # events correlate across the wire
+                        _tid = req.get("trace_id")
+                        fl.record(spans.FL_RECV, step=int(
+                                      req.get("step", -1)),
+                                  client_id=cid, party="server",
+                                  trace_id=(str(_tid) if _tid is not None
+                                            else None),
+                                  path=self.path)
                     if fault is not None:
                         outer.chaos.count(fault[0])
                         kind, arg = fault
+                        if fl is not None:
+                            fl.record(spans.FL_CHAOS, step=int(
+                                          req.get("step", -1)),
+                                      client_id=cid, party="server",
+                                      kind=kind, path=self.path)
                         if kind == "delay":
                             time.sleep(arg / 1e3)
                             fault = None
@@ -427,6 +469,11 @@ class HttpTransport(Transport):
             raw_b, wire_b = codec.compressed_leaf_bytes(payload)
             if wire_b:
                 self.stats.record_compression(raw_b, wire_b)
+        fl = obs_flight.get_recorder()
+        if fl is not None and path in _TRACED_PATHS:
+            fl.record(spans.FL_SEND, step=int(payload.get("step", -1)),
+                      client_id=int(payload.get("client_id", 0)),
+                      party="client", trace_id=tid, path=path)
         t_enc0 = time.perf_counter() if tid is not None else 0.0
         body = codec.encode(payload)
         enc_s = time.perf_counter() - t_enc0 if tid is not None else 0.0
@@ -463,6 +510,10 @@ class HttpTransport(Transport):
         if resp.status_code != 200:
             raise TransportError(
                 f"POST {path} -> {resp.status_code}: {resp.content[:200]!r}")
+        if fl is not None and path in _TRACED_PATHS:
+            fl.record(spans.FL_RECV, step=int(payload.get("step", -1)),
+                      client_id=int(payload.get("client_id", 0)),
+                      party="client", trace_id=tid, path=path)
         t_dec0 = time.perf_counter() if tid is not None else 0.0
         tree = codec.decode(resp.content)
         if self.compress != "none":

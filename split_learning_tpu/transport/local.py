@@ -13,6 +13,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from split_learning_tpu.obs import flight as obs_flight
 from split_learning_tpu.obs import spans
 from split_learning_tpu.obs import trace as obs_trace
 from split_learning_tpu.transport import codec
@@ -98,19 +99,34 @@ class LocalTransport(Transport):
 
     def split_step(self, activations: np.ndarray, labels: np.ndarray,
                    step: int, client_id: int = 0) -> Tuple[np.ndarray, float]:
+        # flight journal (obs/flight.py): one send/recv pair per
+        # delivery attempt, client party — gated exactly like the
+        # tracer, so the recorder-off path touches nothing
+        fl = obs_flight.get_recorder()
+        if fl is not None:
+            fl.record(spans.FL_SEND, step=int(step),
+                      client_id=int(client_id), party="client",
+                      op="split_step")
         if self.compress is not None:
-            return self._split_step_wire(activations, labels, step,
-                                         client_id)
-        tr = obs_trace.get_tracer()
-        if tr is None:  # the untraced hot path, unchanged
-            with timed(self.stats):
-                acts = self._roundtrip(np.asarray(activations))
-                labs = self._roundtrip(np.asarray(labels))
-                grads, loss = self._call(self.server.split_step, acts, labs,
-                                         step, client_id)
-                return self._roundtrip(grads), float(loss)
-        return self._split_step_traced(tr, activations, labels, step,
-                                       client_id)
+            res = self._split_step_wire(activations, labels, step,
+                                        client_id)
+        else:
+            tr = obs_trace.get_tracer()
+            if tr is None:  # the untraced hot path, unchanged
+                with timed(self.stats):
+                    acts = self._roundtrip(np.asarray(activations))
+                    labs = self._roundtrip(np.asarray(labels))
+                    grads, loss = self._call(self.server.split_step,
+                                             acts, labs, step, client_id)
+                    res = self._roundtrip(grads), float(loss)
+            else:
+                res = self._split_step_traced(tr, activations, labels,
+                                              step, client_id)
+        if fl is not None:
+            fl.record(spans.FL_RECV, step=int(step),
+                      client_id=int(client_id), party="client",
+                      op="split_step")
+        return res
 
     def _split_step_wire(self, activations, labels, step, client_id):
         """Emulated-wire variant: both directions go through the real

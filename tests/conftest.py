@@ -30,6 +30,16 @@ except Exception:
     pass
 
 
+# Honor SLT_FLIGHT for the suite the way the CLI does (obs/flight.py):
+# a CI job exporting SLT_FLIGHT=<path> gets a causal event journal from
+# the tests' own runtimes, dumped on any watchdog trip. Unset (the
+# default) this returns None and the recorder stays off — the pinned
+# bit-identity tests in tests/test_flight.py rely on that.
+from split_learning_tpu.obs import flight as _obs_flight  # noqa: E402
+
+_obs_flight.maybe_enable_from_env()
+
+
 @pytest.fixture(scope="session", autouse=True)
 def _lock_watchdog_gate():
     """Under SLT_LOCK_DEBUG=1 the runtime locks report inversions and

@@ -1000,6 +1000,56 @@ def test_slt014_pairing_matched_fields_clean(tmp_path):
 
 
 # ---------------------------------------------------------------------- #
+# SLT015: flight event names come from the spans.py FL_* registry
+# ---------------------------------------------------------------------- #
+
+def test_slt015_flags_literal_and_unregistered(tmp_path):
+    findings = _lint(tmp_path, "runtime/server.py", """
+        from split_learning_tpu.obs import flight as obs_flight
+        from split_learning_tpu.obs import spans
+        def step(self):
+            fl = obs_flight.get_recorder()
+            if fl is not None:
+                fl.record("my_event", step=1)
+                fl.record(spans.FL_BOGUS, step=1)
+    """)
+    rules = _rules(findings)
+    # the literal also co-fires SLT003 (same sink, same registry
+    # discipline) — SLT015 must flag both the literal and the
+    # unregistered constant
+    assert rules.count("SLT015") == 2
+    msgs = " ".join(f.message for f in findings if f.rule == "SLT015")
+    assert "my_event" in msgs and "FL_BOGUS" in msgs
+
+
+def test_slt015_registered_constant_and_scope_clean(tmp_path):
+    findings = _lint(tmp_path, "runtime/server.py", """
+        from split_learning_tpu.obs import spans
+        def step(self, fl):
+            if fl is not None:
+                fl.record(spans.FL_DISPATCH, step=3, client_id=0)
+        def trace(self, tr):
+            tr.record(spans.DISPATCH, 0.0, 0.1)
+    """)
+    assert [f for f in findings if f.rule == "SLT015"] == []
+    # non-flight receivers and out-of-scope dirs never fire
+    findings = _lint(tmp_path, "models/demo.py", """
+        def f(fl):
+            fl.record("free_text")
+    """)
+    assert [f for f in findings if f.rule == "SLT015"] == []
+
+
+def test_slt015_inline_waiver(tmp_path):
+    findings = _lint(tmp_path, "transport/wire.py", """
+        def f(fl):
+            fl.record(FL_EXPERIMENTAL)  # slt-lint: disable=SLT015 (prototype event, registered next PR)
+    """)
+    assert _rules(findings, waived=True) == ["SLT015"]
+    assert _rules(findings, waived=False) == []
+
+
+# ---------------------------------------------------------------------- #
 # engine: exit codes, waiver file, real tree
 # ---------------------------------------------------------------------- #
 
@@ -1050,7 +1100,7 @@ def test_list_rules(capsys):
     out = capsys.readouterr().out
     for rule in ("SLT001", "SLT002", "SLT003", "SLT004", "SLT005",
                  "SLT006", "SLT007", "SLT008", "SLT009", "SLT010",
-                 "SLT011", "SLT012", "SLT013", "SLT014",
+                 "SLT011", "SLT012", "SLT013", "SLT014", "SLT015",
                  # slt-check dynamic-invariant pseudo-rules
                  "SLT100", "SLT101", "SLT102", "SLT103", "SLT104",
                  "SLT105", "SLT106", "SLT107", "SLT108",
@@ -1098,6 +1148,29 @@ def test_trace_report_fallback_matches_registry():
     assert fallback["REPLY_GRAD"] == spans.REPLY_GRAD
     assert fallback["DEFERRED_APPLY"] == spans.DEFERRED_APPLY
     assert fallback["MESH_META"] == spans.MESH_META
+
+
+def test_postmortem_fallback_matches_registry():
+    """scripts/postmortem.py runs standalone too: its ImportError
+    fallback of FL_* event names is pinned byte-equal to the
+    obs/spans.py registry."""
+    tree = ast.parse((REPO / "scripts" / "postmortem.py").read_text())
+    fallback = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Try):
+            continue
+        for h in node.handlers:
+            if getattr(h.type, "id", None) != "ImportError":
+                continue
+            for s in h.body:
+                if (isinstance(s, ast.Assign)
+                        and isinstance(s.targets[0], ast.Name)):
+                    fallback[s.targets[0].id] = ast.literal_eval(s.value)
+    assert fallback, "postmortem.py lost its ImportError fallback"
+    registered = {k for k in vars(spans) if k.startswith("FL_")}
+    assert set(fallback) <= registered
+    for name, value in fallback.items():
+        assert getattr(spans, name) == value, name
 
 
 def test_analysis_package_is_stdlib_only():

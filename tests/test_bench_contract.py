@@ -399,3 +399,52 @@ def test_transformer_trunk_kwargs_contract(monkeypatch):
     kw = transformer_trunk_kwargs("split", "float32")
     assert kw["num_heads"] == 8 and kw["d_model"] // kw["num_heads"] == 128
     assert kw["max_len"] == 8192
+
+
+def test_fleet_sim_summary_utilization_schema(monkeypatch, capsys):
+    """scripts/fleet_sim.py's JSON summary carries the utilization /
+    saturation block capacity sweeps bisect on: steady-state occupancy
+    as a fraction of --coalesce-max, the admission reject rate, and the
+    pooled step p99 measured against --slo-ms. Run in-process (the
+    suite's JAX is already warm) on a tiny quota'd fleet so every field
+    takes its non-null arm."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "fleet_sim", os.path.join(REPO, "scripts", "fleet_sim.py"))
+    fleet_sim = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fleet_sim)
+
+    monkeypatch.setattr(sys, "argv", [
+        "fleet_sim.py", "--clients", "4", "--tenants", "2",
+        "--steps", "1", "--rate", "5.0", "--batch", "4",
+        "--batching", "continuous", "--coalesce-max", "4",
+        "--quota", "100", "--slo-ms", "5000"])
+    assert fleet_sim.main() == 0
+    out = capsys.readouterr().out
+    summary = json.loads(out[out.index("{"):])
+
+    util = summary["utilization"]
+    assert set(util) == {"mean_occupancy", "steady_state_occupancy",
+                         "admission_reject_rate", "step_p99_over_slo",
+                         "slo_attained"}
+    assert util["mean_occupancy"] >= 1.0
+    assert 0.0 < util["steady_state_occupancy"] <= 1.0
+    assert util["steady_state_occupancy"] == pytest.approx(
+        util["mean_occupancy"] / 4, abs=5e-4)
+    # quota'd run: the admission layer is live, so the rate is a number
+    assert 0.0 <= util["admission_reject_rate"] <= 1.0
+    assert util["step_p99_over_slo"] > 0.0
+    assert util["slo_attained"] == (util["step_p99_over_slo"] <= 1.0)
+    # without --quota/--slo-ms the null arms must ship as nulls, not be
+    # dropped from the schema (jq-stable for sweep scripts)
+    monkeypatch.setattr(sys, "argv", [
+        "fleet_sim.py", "--clients", "2", "--tenants", "1",
+        "--steps", "1", "--rate", "5.0", "--batch", "4",
+        "--batching", "continuous"])
+    assert fleet_sim.main() == 0
+    out = capsys.readouterr().out
+    summary = json.loads(out[out.index("{"):])
+    util = summary["utilization"]
+    assert util["admission_reject_rate"] is None
+    assert util["step_p99_over_slo"] is None
+    assert util["slo_attained"] is None

@@ -1,0 +1,295 @@
+"""Flight recorder (obs/flight.py) acceptance: the zero-overhead-off
+bit-identity pin, bounded ring memory under soak, the chaos postmortem
+demo (duplicate served from the replay cache, visible in the merged
+cross-party timeline, zero anomalies), and the watchdog-trip dump
+trigger under SLT_LOCK_DEBUG=1 (subprocess — the conftest session gate
+treats default-graph violations as suite bugs)."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from split_learning_tpu.models import get_plan
+from split_learning_tpu.obs import flight
+from split_learning_tpu.obs import spans
+from split_learning_tpu.runtime import ServerRuntime, SplitClientTrainer
+from split_learning_tpu.runtime.client import FailurePolicy
+from split_learning_tpu.transport import LocalTransport
+from split_learning_tpu.transport.chaos import ChaosPolicy, ChaosTransport
+from split_learning_tpu.transport.http import SplitHTTPServer
+from split_learning_tpu.utils import Config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _recorder_off():
+    """The global recorder must never leak between tests — the rest of
+    the suite (and the off leg below) pins the recorder-off hot path."""
+    flight.disable()
+    yield
+    flight.disable()
+
+
+def _data(batch=8, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(batch, 28, 28, 1).astype(np.float32)
+    y = rs.randint(0, 10, (batch,)).astype(np.int64)
+    return x, y
+
+
+def _train(steps=3, batch=8):
+    """One seeded local split run; returns its loss series."""
+    cfg = Config(mode="split", batch_size=batch)
+    plan = get_plan(mode="split")
+    x, y = _data(batch)
+    server = ServerRuntime(plan, cfg, jax.random.PRNGKey(0), x)
+    trainer = SplitClientTrainer(plan, cfg, jax.random.PRNGKey(0),
+                                 LocalTransport(server))
+    try:
+        return [float(trainer.train_step(x, y, i)) for i in range(steps)]
+    finally:
+        server.close()
+
+
+# --------------------------------------------------------------------- #
+# zero-overhead-off: bit identity
+
+
+def test_recorder_on_leaves_loss_series_bit_identical():
+    """The recorder observes; it must never perturb. The same seeded run
+    with the recorder off and on produces float-identical losses, and
+    the on-run actually journaled the causal taxonomy."""
+    assert flight.get_recorder() is None
+    losses_off = _train()
+    assert flight.get_recorder() is None  # nothing armed it mid-run
+
+    fl = flight.enable(party="proc")
+    try:
+        losses_on = _train()
+        names = {e["name"] for e in fl.events()}
+    finally:
+        flight.disable()
+    assert losses_on == losses_off  # bitwise: same floats, not approx
+    assert {spans.FL_SEND, spans.FL_RECV, spans.FL_CLAIM_BEGIN,
+            spans.FL_CLAIM_RESOLVE, spans.FL_DISPATCH,
+            spans.FL_REPLY} <= names
+    # every event is stamped for the cross-party merge
+    for e in fl.events():
+        assert e["seq"] >= 0 and e["party"] in ("client", "server", "proc")
+
+
+def test_recorder_on_leaves_wire_bytes_legacy():
+    """The journal is process-local: with the recorder ON the raw HTTP
+    wire payloads are bit-for-bit the legacy schema — no flight fields
+    travel (the tracer's pinned contract, tests/test_obs.py)."""
+    from split_learning_tpu.transport import codec
+    cfg = Config(mode="split", batch_size=8)
+    plan = get_plan(mode="split")
+    x, y = _data()
+    runtime = ServerRuntime(plan, cfg, jax.random.PRNGKey(0), x)
+    server = SplitHTTPServer(runtime).start()
+    flight.enable(party="server")
+    try:
+        trainer = SplitClientTrainer(plan, cfg, jax.random.PRNGKey(0),
+                                     LocalTransport(runtime))
+        trainer.train_step(x, y, 0)
+        acts = np.asarray(trainer._fwd(trainer.state.params,
+                                       jax.numpy.asarray(x)))
+        payload = codec.encode({"activations": acts, "labels": y,
+                                "step": 1, "client_id": 0})
+        req = urllib.request.Request(
+            f"{server.url}/forward_pass", data=payload,
+            headers={"Content-Type": "application/octet-stream"})
+        with urllib.request.urlopen(req) as resp:
+            out = codec.decode(resp.read())
+        assert set(out) == {"grads", "loss", "step"}
+        # and the journal is served live on the debug route instead
+        with urllib.request.urlopen(f"{server.url}/debug/flight") as resp:
+            doc = json.loads(resp.read())
+        assert doc["kind"] == "slt-flight-dump"
+        assert any(e["name"] == spans.FL_RECV for e in doc["events"])
+    finally:
+        flight.disable()
+        server.stop()
+
+
+def test_debug_flight_route_404_when_off():
+    cfg = Config(mode="split", batch_size=8)
+    plan = get_plan(mode="split")
+    x, _ = _data()
+    runtime = ServerRuntime(plan, cfg, jax.random.PRNGKey(0), x)
+    server = SplitHTTPServer(runtime).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{server.url}/debug/flight")
+        assert ei.value.code == 404
+    finally:
+        server.stop()
+
+
+# --------------------------------------------------------------------- #
+# bounded memory
+
+
+def test_ring_stays_bounded_under_soak():
+    fl = flight.enable(party="proc", capacity=64)
+    try:
+        for i in range(1000):
+            fl.record(spans.FL_ADMIT, step=i, client_id=0, tenant=0)
+        events = fl.events()
+        assert len(events) == 64
+        assert events[-1]["step"] == 999  # newest survive, oldest drop
+        dump = fl.dump(reason="soak")
+        assert dump["dropped"] == 1000 - 64
+        # a real run on top keeps the same bound
+        _train(steps=2, batch=4)
+        assert len(fl.events()) == 64
+    finally:
+        flight.disable()
+
+
+def test_dump_json_roundtrip(tmp_path):
+    fl = flight.enable(party="proc", capacity=8)
+    try:
+        fl.record(spans.FL_BREAKER, step=0, client_id=1,
+                  state="open", reason="probe")
+        out = fl.dump_json(str(tmp_path / "d.json"), reason="manual")
+        with open(out) as f:
+            doc = json.load(f)
+        assert doc["version"] == 1 and doc["reason"] == "manual"
+        assert doc["events"][0]["fields"] == {"state": "open",
+                                              "reason": "probe"}
+    finally:
+        flight.disable()
+
+
+# --------------------------------------------------------------------- #
+# the postmortem demo: chaos duplicates, exactly-once, zero anomalies
+
+
+def _load_postmortem():
+    spec = importlib.util.spec_from_file_location(
+        "postmortem", os.path.join(REPO, "scripts", "postmortem.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_postmortem_shows_duplicate_served_from_replay(tmp_path, capsys):
+    """The acceptance demo: a chaos run (drop_resp + dup) with the
+    recorder on produces client+server journals whose postmortem merge
+    shows the duplicate arriving, losing the replay claim (owner=False),
+    and being served from the cache — with zero ordering anomalies."""
+    steps = 6
+    cfg = Config(mode="split", batch_size=4)
+    plan = get_plan(mode="split")
+    x, y = _data(batch=4)
+    server = ServerRuntime(plan, cfg, jax.random.PRNGKey(0), x,
+                           strict_steps=True)
+    policy = ChaosPolicy("drop_resp=0.3,dup=0.3", seed=3)
+    transport = ChaosTransport(LocalTransport(server), policy)
+    trainer = SplitClientTrainer(plan, cfg, jax.random.PRNGKey(0),
+                                 transport,
+                                 failure_policy=FailurePolicy.RETRY,
+                                 max_retries=5)
+    fl = flight.enable(party="proc")
+    try:
+        losses = [float(trainer.train_step(x, y, i)) for i in range(steps)]
+        events = fl.events()
+        base = fl.dump(reason="exit")
+    finally:
+        flight.disable()
+        server.close()
+    assert len(losses) == steps  # exactly-once: every step trained once
+    assert sum(policy.injected.values()) > 0
+
+    # split the single-process journal by party into the two dump files
+    # a real two-party deployment would write
+    paths = []
+    for party in ("client", "server"):
+        doc = dict(base, party=party,
+                   events=[e for e in events if e["party"] == party])
+        assert doc["events"], f"no {party}-party events journaled"
+        p = tmp_path / f"{party}.flight.json"
+        p.write_text(json.dumps(doc))
+        paths.append(str(p))
+
+    pm = _load_postmortem()
+    dumps = [pm.load_dump(p) for p in paths]
+    rep = pm.summarize(dumps)
+    assert rep["anomalies"] == []
+    assert rep["chaos"].get("drop_resp", 0) + rep["chaos"].get("dup", 0) > 0
+    # the duplicate's fate: it waited on (or replay-hit) a claim it did
+    # not own instead of dispatching a second time
+    dup_rows = rep["duplicates_served"]
+    assert dup_rows, "chaos injected duplicates but none were journaled"
+    assert any(r["claim_wait"] + r["replay_hit"] >= 1 for r in dup_rows)
+
+    # the CLI face renders and exits 0 (no anomalies even under --strict)
+    assert pm.main(paths + ["--strict"]) == 0
+    out = capsys.readouterr().out
+    assert "anomalies: none" in out
+
+
+def test_postmortem_flags_reply_before_admit(tmp_path):
+    """Anomaly detection proper: a synthetic journal whose reply count
+    outruns its admits must be flagged (the detector the chaos demo
+    proves stays quiet on a healthy run)."""
+    fl = flight.FlightRecorder(party="server")
+    fl.record(spans.FL_ADMIT, step=0, client_id=0, tenant=0)
+    fl.record(spans.FL_REPLY, step=0, client_id=0, op="forward_pass")
+    fl.record(spans.FL_REPLY, step=1, client_id=0, op="forward_pass")
+    p = tmp_path / "bad.flight.json"
+    p.write_text(json.dumps(fl.dump(reason="exit")))
+    pm = _load_postmortem()
+    rep = pm.summarize([pm.load_dump(str(p))])
+    kinds = {a["kind"] for a in rep["anomalies"]}
+    assert "reply_before_admit" in kinds
+    assert pm.main([str(p), "--strict"]) == 1
+
+
+# --------------------------------------------------------------------- #
+# watchdog-trip dump (trigger #1), in a subprocess so the intentional
+# inversion never reaches this session's default-graph gate
+
+
+def test_watchdog_trip_dumps_flight_journal(tmp_path):
+    dump_path = tmp_path / "trip.flight.json"
+    script = textwrap.dedent("""
+        from split_learning_tpu.obs import flight, locks
+        flight.maybe_enable_from_env()
+        assert flight.enabled() and locks.enabled()
+        a = locks.make_lock("a", reentrant=False)
+        b = locks.make_lock("b", reentrant=False)
+        with a:
+            with b:
+                pass
+        with b:
+            with a:   # a->b then b->a: the inversion the watchdog trips on
+                pass
+        assert locks.default_graph().violations
+    """)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               SLT_LOCK_DEBUG="1", SLT_FLIGHT=str(dump_path))
+    proc = subprocess.run([sys.executable, "-c", script], cwd=REPO,
+                          env=env, capture_output=True, text=True,
+                          timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    with open(dump_path) as f:
+        doc = json.load(f)
+    assert doc["kind"] == "slt-flight-dump"
+    assert doc["reason"] == "watchdog:lock"
+    trips = [e for e in doc["events"]
+             if e["name"] == spans.FL_WATCHDOG_TRIP]
+    assert trips and trips[0]["fields"]["source"] == "lock"
+    assert "lock-order" in trips[0]["fields"]["message"] or \
+        "inversion" in trips[0]["fields"]["message"]

@@ -11,6 +11,7 @@ from split_learning_tpu.runtime import (
 from split_learning_tpu.transport import LocalTransport, TransportError
 from split_learning_tpu.transport.http import HttpTransport, SplitHTTPServer
 from split_learning_tpu.utils import Config
+from split_learning_tpu.version import __version__
 
 BATCH = 8
 
@@ -31,9 +32,11 @@ def http_pair():
 def test_http_split_step_and_training(http_pair):
     cfg, plan, runtime, server, transport = http_pair
     h = transport.health()
+    uptime = h.pop("uptime_seconds")
+    assert uptime >= 0.0
     assert h == {"status": "healthy", "mode": "split",
                  "model_type": "part_b", "step": -1,
-                 "strict_steps": True}
+                 "strict_steps": True, "version": __version__}
 
     client = SplitClientTrainer(plan, cfg, jax.random.PRNGKey(2), transport)
     rs = np.random.RandomState(1)

@@ -1,0 +1,77 @@
+"""End-to-end smoke of the trace toolchain: a short traced CLI train
+(`--trace PATH`) followed by scripts/trace_report.py over the artifact it
+wrote. The unit pins in tests/test_obs.py freeze the span names and the
+report's arithmetic; this test freezes the seam between them — the CLI
+must keep writing a Chrome trace the report can summarize, and every
+unconditional report section must actually render from a real run."""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_trace_report():
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", os.path.join(REPO, "scripts", "trace_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def traced_run(tmp_path_factory):
+    """One 3-step traced local split train, shared by the cases below."""
+    from split_learning_tpu.launch import run as launch_run
+    trace = tmp_path_factory.mktemp("trace") / "train.trace.json"
+    rc = launch_run.main([
+        "train", "--mode", "split", "--transport", "local",
+        "--dataset", "synthetic", "--steps", "3", "--batch-size", "4",
+        "--trace", str(trace)])
+    assert rc == 0
+    assert trace.exists()
+    return trace
+
+
+def test_cli_trace_is_chrome_loadable(traced_run):
+    with open(traced_run) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"] if isinstance(doc, dict) else doc
+    assert any(e.get("ph") == "X" for e in events)
+
+
+def test_trace_report_renders_every_section(traced_run, capsys):
+    tr = _load_trace_report()
+    assert tr.main([str(traced_run)]) == 0
+    out = capsys.readouterr().out
+    # the unconditional sections, in render() order
+    assert "phase" in out and "count" in out          # per-phase table
+    assert "client phase mix" in out
+    assert "-> transport fraction:" in out
+    assert "transport decomposition (total seconds):" in out
+    assert "accounting: client spans sum to" in out
+    # a real local run must have stepped through the client phases
+    for phase in ("client_fwd", "transport", "step_total"):
+        assert phase in out, f"phase {phase!r} missing from\n{out}"
+
+
+def test_trace_report_json_schema(traced_run, capsys):
+    tr = _load_trace_report()
+    assert tr.main([str(traced_run), "--json", "--tenants", "2"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    for key in ("events", "spans", "phases", "client_phase_mix",
+                "transport_fraction", "transport_decomposition_s",
+                "compile", "decoupled_bwd", "mesh",
+                "span_sum_over_wall_clock", "tenant_queue_wait"):
+        assert key in rep, key
+    assert rep["spans"] > 0
+    assert 0.0 < rep["transport_fraction"] < 1.0
+    # accounting gate from the report's own epilogue: the client spans
+    # must cover step_total wall clock (within the documented 10%)
+    assert rep["span_sum_over_wall_clock"] == pytest.approx(1.0, abs=0.1)
+    # coupled local run: the conditional sections stay conditional
+    assert rep["decoupled_bwd"] is None
