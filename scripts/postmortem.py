@@ -31,7 +31,14 @@ Output, in ``scripts/trace_report.py`` section style:
     - ``duplicate_without_resolve``: a duplicate was served
       (``fl_claim_wait`` / ``fl_replay_hit``) with no prior
       ``fl_claim_resolve`` for that key — a reply fabricated from
-      nothing.
+      nothing;
+    - ``hop_out_of_order``: on a K-stage pipeline run, a party's
+      ``fl_hop_recv`` / ``fl_stage_reply`` journal shows a microbatch
+      id regression within one (party, stage, op, step) — the wire
+      workers are FIFO and the stage rejects non-monotonic hop
+      sequence numbers, so a merged 3-dump journal where mb goes
+      backwards means a duplicate was materialized twice or a relay
+      reordered the stream.
 
 Run:    python scripts/postmortem.py client.json server.json
 Also:   --json (machine-readable), --step N (timeline for one step),
@@ -54,7 +61,8 @@ try:
     from split_learning_tpu.obs.spans import (
         FL_ADMIT, FL_CHAOS, FL_CLAIM_BEGIN, FL_CLAIM_FAIL,
         FL_CLAIM_RESOLVE, FL_CLAIM_WAIT, FL_CLOSE, FL_DEFER_APPLY,
-        FL_FATAL, FL_REPLAY_HIT, FL_REPLY, FL_WATCHDOG_TRIP)
+        FL_FATAL, FL_HOP_RECV, FL_HOP_SEND, FL_REPLAY_HIT, FL_REPLY,
+        FL_STAGE_REPLY, FL_WATCHDOG_TRIP)
 except ImportError:
     FL_ADMIT = "fl_admit"
     FL_CLAIM_BEGIN = "fl_claim_begin"
@@ -68,6 +76,9 @@ except ImportError:
     FL_CLOSE = "fl_close"
     FL_WATCHDOG_TRIP = "fl_watchdog_trip"
     FL_FATAL = "fl_fatal"
+    FL_HOP_SEND = "fl_hop_send"
+    FL_HOP_RECV = "fl_hop_recv"
+    FL_STAGE_REPLY = "fl_stage_reply"
 
 Key = Tuple[int, Optional[str], int]  # (client_id, op, step)
 
@@ -124,6 +135,16 @@ def detect_anomalies(events: List[Dict[str, Any]],
     close_at: Dict[str, int] = {}   # party -> index of its fl_close
     admits: Dict[int, int] = {}
     replies: Dict[int, int] = {}
+    # pipeline hop streams: highest microbatch id seen so far per
+    # (name, party, stage, op, step). Each stream is produced by one
+    # FIFO wire worker (client side) or serialized by the stage's
+    # strict-seq check (stage side), so mb must be nondecreasing within
+    # a stream; a regression in the merged journal is causal evidence
+    # of a double-materialized duplicate or a reordering relay. This
+    # check is presence-based (both events are in the journal), so it
+    # stays armed even when a ring overflowed.
+    hop_high: Dict[Tuple[str, str, int, Optional[str], int],
+                   Tuple[int, int]] = {}
     admission_armed = any(e.get("name") == FL_ADMIT for e in events)
     for i, ev in enumerate(events):
         name = ev.get("name")
@@ -145,6 +166,31 @@ def detect_anomalies(events: List[Dict[str, Any]],
                         f"op {k[1]!r} step {k[2]} with no prior "
                         "fl_claim_resolve in the journal"),
                 })
+        elif name in (FL_HOP_RECV, FL_STAGE_REPLY):
+            mb = fields.get("mb")
+            if mb is not None:
+                hk = (str(name), str(ev.get("party")),
+                      int(fields.get("stage", -1)), fields.get("op"),
+                      int(ev.get("step", -1)))
+                prev = hop_high.get(hk)
+                if prev is not None and int(mb) < prev[0]:
+                    anomalies.append({
+                        "kind": "hop_out_of_order",
+                        "client_id": int(ev.get("client_id", -1)),
+                        "op": fields.get("op"),
+                        "step": int(ev.get("step", -1)),
+                        "message": (
+                            f"{name} for {ev.get('party')} stage "
+                            f"{fields.get('stage', -1)} op "
+                            f"{fields.get('op')!r} step {ev.get('step')} "
+                            f"journaled mb {int(mb)} after mb {prev[0]} "
+                            "— hop streams are FIFO per wire, so a "
+                            "microbatch regression means a duplicate "
+                            "materialized twice or a relay reordered "
+                            "the stream"),
+                    })
+                if prev is None or int(mb) > prev[0]:
+                    hop_high[hk] = (int(mb), i)
         elif name == FL_CLOSE:
             close_at.setdefault(str(ev.get("party")), i)
         elif name == FL_DEFER_APPLY:

@@ -45,7 +45,8 @@ from typing import Any, Dict, List
 try:
     from split_learning_tpu.obs.spans import (CLIENT_PHASES, COMPILE,
                                               DEFERRED_APPLY, MESH_META,
-                                              REPLY_GRAD, TRANSPORT_SUB)
+                                              REPLY_GRAD, STAGE_META,
+                                              TRANSPORT_SUB)
 except ImportError:
     CLIENT_PHASES = ("client_fwd", "transport", "client_bwd", "opt_apply")
     TRANSPORT_SUB = ("encode", "wire", "queue_wait", "dispatch", "d2h")
@@ -53,6 +54,7 @@ except ImportError:
     REPLY_GRAD = "reply_grad"
     DEFERRED_APPLY = "deferred_apply"
     MESH_META = "mesh_meta"
+    STAGE_META = "stage_meta"
 
 
 def load_events(path: str) -> List[Dict[str, Any]]:
@@ -226,6 +228,20 @@ def summarize(events: List[Dict[str, Any]],
                 mesh_meta = args_d
             break
 
+    # pipeline sidecar (PR 14, K-stage MPMD chain): export_chrome(
+    # stage_metadata=PipelineRunner.trace_metadata()) rides as one
+    # ph:"M" event named STAGE_META. Absent on 1-cut/old traces -> the
+    # section is not rendered, same contract as the mesh sidecar.
+    # Tolerant: a malformed args payload (not a dict) is treated as
+    # absent, and a "stages" entry that is not a list renders as empty.
+    stage_meta = None
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == STAGE_META:
+            args_d = e.get("args")
+            if isinstance(args_d, dict):
+                stage_meta = args_d
+            break
+
     rep = {
         "events": len(events),
         "spans": len(spans),
@@ -237,6 +253,7 @@ def summarize(events: List[Dict[str, Any]],
         "compile": compile_summary,
         "decoupled_bwd": decoupled,
         "mesh": mesh_meta,
+        "pipeline": stage_meta,
         "span_sum_over_wall_clock": coverage,
     }
     if tenants > 0:
@@ -318,6 +335,36 @@ def render(rep: Dict[str, Any]) -> str:
                     f"{float(row.get('model_flops', 0.0)) / 1e9:>9.3f} "
                     f"{float(row.get('dispatch_s', 0.0)):>8.4f} "
                     f"{rate_col} {mfu_col}")
+    pipe = rep.get("pipeline")
+    if pipe:
+        lines.append("")
+        lines.append(
+            f"MPMD pipeline — {pipe.get('num_stages', '?')} stages, "
+            f"M={pipe.get('microbatches', '?')} microbatches, "
+            f"{pipe.get('ticks_per_step', '?')} ticks/step over "
+            f"{pipe.get('steps', '?')} steps")
+        stages = pipe.get("stages")
+        if isinstance(stages, list) and stages:
+            lines.append(f"  {'stage':>5} {'bubble':>8} {'theo':>8} "
+                         f"{'reply_p50':>10} {'hops':>6} {'applyQ':>7}")
+            for row in stages:
+                if not isinstance(row, dict):
+                    continue
+                bub = row.get("bubble_fraction")
+                bub_col = f"{bub:>8.1%}" if bub is not None else f"{'-':>8}"
+                theo = row.get("bubble_theoretical")
+                theo_col = (f"{theo:>8.1%}" if theo is not None
+                            else f"{'-':>8}")
+                p50 = row.get("reply_p50_ms")
+                p50_col = (f"{p50:>8.3f}ms" if p50 is not None
+                           else f"{'-':>10}")
+                depth = row.get("deferred_apply_depth")
+                depth_col = (f"{int(depth):>7d}" if depth is not None
+                             else f"{'-':>7}")
+                lines.append(
+                    f"  {int(row.get('stage', 0)):>5d} {bub_col} "
+                    f"{theo_col} {p50_col} "
+                    f"{int(row.get('hop_calls', 0)):>6d} {depth_col}")
     tqw = rep.get("tenant_queue_wait")
     if tqw:
         lines.append("")

@@ -277,6 +277,65 @@ def deferred_apply_exactly_once(run: Any) -> None:
             f"applies ran out of enqueue order: {applied} vs {expect}")
 
 
+def pipeline_hops_exactly_once(run: Any) -> None:
+    """MPMD hop discipline (PR 14): every microbatch's forward hop and
+    backward-cotangent hop is applied exactly once per stage under
+    duplicate/dropped deliveries, applies land in microbatch order per
+    (stage, direction, step) — the per-wire FIFO workers guarantee it,
+    and the GPipe accumulation order depends on it — and no
+    microbatch's cotangent applies before its forward residual exists.
+
+    Notes read: ``hop_sent(stage, dir, step, mb)`` once per intended
+    hop; ``hop_apply(stage, dir, step, mb)`` from replay-claim owners
+    only (a duplicate served from the cache must not re-note)."""
+    sent = [(f["stage"], f["dir"], f["step"], f["mb"])
+            for f in _notes(run, "hop_sent")]
+    applies = [(f["stage"], f["dir"], f["step"], f["mb"])
+               for f in _notes(run, "hop_apply")]
+    counts: Dict[Any, int] = {}
+    for key in applies:
+        counts[key] = counts.get(key, 0) + 1
+    for key, n in counts.items():
+        if n > 1:
+            raise Violation(
+                "pipeline_hops_exactly_once", run.schedule_id,
+                f"hop {key} applied {n} times — a duplicate delivery "
+                f"re-ran the stage program")
+        if key not in sent:
+            raise Violation(
+                "pipeline_hops_exactly_once", run.schedule_id,
+                f"hop {key} applied but was never sent")
+    for key in sent:
+        if counts.get(key, 0) != 1:
+            raise Violation(
+                "pipeline_hops_exactly_once", run.schedule_id,
+                f"hop {key} applied {counts.get(key, 0)} times (want "
+                f"exactly 1: drops must be healed by retry, dups by "
+                f"the replay claim)")
+    # microbatch order per (stage, dir, step): the apply sequence must
+    # be nondecreasing in mb — FIFO wire workers never reorder
+    seq: Dict[Any, List[int]] = {}
+    for stage, d, step, mb in applies:
+        seq.setdefault((stage, d, step), []).append(mb)
+    for key, mbs in seq.items():
+        if mbs != sorted(mbs):
+            raise Violation(
+                "pipeline_hops_exactly_once", run.schedule_id,
+                f"stage/dir/step {key} applied microbatches out of "
+                f"order: {mbs}")
+    # causality: a cotangent needs its forward residual — bwd(mb) after
+    # fwd(mb) at the same stage and step
+    pos = {key: i for i, key in enumerate(applies)}
+    for stage, d, step, mb in applies:
+        if d == "bwd":
+            fwd = (stage, "fwd", step, mb)
+            if fwd in pos and pos[fwd] > pos[(stage, d, step, mb)]:
+                raise Violation(
+                    "pipeline_hops_exactly_once", run.schedule_id,
+                    f"stage {stage} step {step} mb {mb}: backward hop "
+                    f"applied before its forward residual existed")
+
+
 # --------------------------------------------------------------------- #
 # crash–restart invariants (slt-crash) — read the ("crash", ...) marker
 # a CrashRun inserts between the killed workload and the recovery phase
@@ -438,6 +497,7 @@ INVARIANTS: Dict[str, Callable[[Any], None]] = {
     "admission_conservation": admission_conservation,
     "all_resolved": all_resolved,
     "deferred_apply_exactly_once": deferred_apply_exactly_once,
+    "pipeline_hops_exactly_once": pipeline_hops_exactly_once,
     "durable_exactly_once": durable_exactly_once,
     "checkpoint_atomicity": checkpoint_atomicity,
     "replay_recovery_bit_identical": replay_recovery_bit_identical,
@@ -461,6 +521,7 @@ RULE_OF_INVARIANT: Dict[str, str] = {
     "checkpoint_atomicity": "SLT110",
     "replay_recovery_bit_identical": "SLT111",
     "flush_before_save": "SLT112",
+    "pipeline_hops_exactly_once": "SLT113",
 }
 
 

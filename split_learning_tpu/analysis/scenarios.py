@@ -517,6 +517,100 @@ def deferred_apply_storm(ctx: Ctx) -> Dict[str, Any]:
     return dict(dq.counters())
 
 # --------------------------------------------------------------------- #
+# MPMD pipeline hops: per-stage replay claims under dup/drop (PR 14)
+# --------------------------------------------------------------------- #
+
+@scenario("pipeline_hop_chain",
+          invariants=("pipeline_hops_exactly_once",
+                      "exactly_once_claims"),
+          budget=400, bound=3)
+def pipeline_hop_chain(ctx: Ctx) -> Dict[str, Any]:
+    """A 3-stage chain's hop traffic (2 microbatches, one step) under a
+    racing duplicate re-deliverer and a dropped-response retry: each
+    stage owns a real ReplayCache keyed by the composite hop seq, the
+    per-wire FIFO deliverers send microbatches in order (the runner's
+    worker-queue discipline), and causality events gate loss-after-fwd
+    and bwd-after-loss exactly as cotangents do — every hop must apply
+    exactly once, in mb order per (stage, dir), through every
+    interleaving of the deliverers, the dup, and the retry."""
+    from split_learning_tpu.obs import locks as obs_locks
+    from split_learning_tpu.runtime.replay import ReplayCache
+    from split_learning_tpu.runtime.stage import hop_seq
+
+    M, step = 2, 5
+    caches = {1: ReplayCache(window=8), 2: ReplayCache(window=8)}
+    ops = {("fwd", 1): "hop_fwd", ("fwd", 2): "hop_loss",
+           ("bwd", 1): "hop_bwd"}
+
+    def deliver(stage: int, direction: str, mb: int, tag: str) -> None:
+        """One wire delivery: claim the composite seq on the stage's
+        cache; only the owner 'runs the stage program' (notes
+        hop_apply); losers and post-done retries are served the cached
+        value. ``drop`` redelivers after a resolved first attempt —
+        the lost-response retry path."""
+        op = ops[(direction, stage)]
+        key = (0, op, hop_seq(step, mb))
+        if tag == "orig":
+            ctx.note("hop_sent", stage=stage, dir=direction, step=step,
+                     mb=mb)
+        else:
+            ctx.step("wire")  # the retransmit window
+        entry, owner = caches[stage].begin(*key)
+        ctx.note("begin", key=key, owner=owner, who=f"{tag}-s{stage}")
+        if owner:
+            ctx.note("hop_apply", stage=stage, dir=direction, step=step,
+                     mb=mb)
+            ctx.note("apply", key=key)
+            caches[stage].resolve(entry, f"y:{stage}:{direction}:{mb}")
+            ctx.note("resolve", key=key,
+                     value=f"y:{stage}:{direction}:{mb}")
+        else:
+            value = caches[stage].wait(entry, timeout=30.0)
+            ctx.note("wait_return", key=key, value=value)
+
+    # causality events: loss(mb) needs fwd(mb)'s activation, bwd(mb)
+    # needs loss(mb)'s cotangent — same dataflow as the real runner
+    fwd_ev = [obs_locks.make_event(f"fwd{m}") for m in range(M)]
+    loss_ev = [obs_locks.make_event(f"loss{m}") for m in range(M)]
+
+    def wire1_fwd() -> None:
+        for mb in range(M):
+            deliver(1, "fwd", mb, "orig")
+            fwd_ev[mb].set()
+
+    def wire2_loss() -> None:
+        for mb in range(M):
+            fwd_ev[mb].wait(timeout=30.0)
+            deliver(2, "fwd", mb, "orig")
+            loss_ev[mb].set()
+
+    def wire1_bwd() -> None:
+        for mb in range(M):
+            loss_ev[mb].wait(timeout=30.0)
+            deliver(1, "bwd", mb, "orig")
+
+    def chaos() -> None:
+        # a duplicated fwd delivery and a dropped-response loss retry:
+        # both must be absorbed by the stage claims, never re-applied
+        fwd_ev[0].wait(timeout=30.0)
+        deliver(1, "fwd", 0, "dup")
+        loss_ev[M - 1].wait(timeout=30.0)
+        deliver(2, "fwd", M - 1, "drop")
+
+    workers = [ctx.spawn(wire1_fwd, name="w1-fwd"),
+               ctx.spawn(wire2_loss, name="w2-loss"),
+               ctx.spawn(wire1_bwd, name="w1-bwd"),
+               ctx.spawn(chaos, name="chaos")]
+    for w in workers:
+        w.join()
+    for stage, cache in caches.items():
+        for mb in range(M):
+            assert cache.contains(0, ops[("fwd", stage)],
+                                  hop_seq(step, mb))
+    return {"hits_s1": caches[1].hits, "hits_s2": caches[2].hits}
+
+
+# --------------------------------------------------------------------- #
 # crash–restart scenarios (slt-crash, SLT109–112)
 # --------------------------------------------------------------------- #
 

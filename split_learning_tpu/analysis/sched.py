@@ -657,8 +657,15 @@ class Scheduler:
             while True:
                 self._control.wait()
                 self._control.clear()
-                if any(t.state == "running" for t in self.threads):
-                    continue  # someone still mid-slice; wait again
+                if any(t.state == "running"
+                       or (t.real is not None and t.state == "unstarted")
+                       for t in self.threads):
+                    # mid-slice, or a just-launched OS thread that has
+                    # not reached its first park yet: deciding now would
+                    # compute the enabled set without it — the thread's
+                    # visibility would depend on OS thread-start timing,
+                    # and a replayed prefix could legitimately diverge
+                    continue
                 if root.state == "finished":
                     return
                 if (self.crash_at is not None and not self.crashed

@@ -261,6 +261,11 @@ def _assemble_full_params(layout: str, raw: Dict[str, Any]):
     if layout == "u_split_local":
         return [raw["client_a"]["params"], raw["server"]["params"],
                 raw["client_c"]["params"]]
+    if layout == "chain":
+        # K-stage MPMD chain: client (stage 0) + stage1..stageK-1
+        ks = sorted((k for k in raw if k.startswith("stage")),
+                    key=lambda k: int(k[5:]))
+        return [raw["client"]["params"]] + [raw[k]["params"] for k in ks]
     if layout == "federated":
         return raw["client"]["params"]
     raise ValueError(
@@ -414,6 +419,8 @@ def cmd_train(args) -> int:
     n_steps = 0
     final_loss = float("nan")
     full_params = None  # for --eval
+    server = None       # the 2-party in-process server, when one exists
+    chain_meta = None   # PipelineRunner.trace_metadata() (chain path)
 
     if args.transport != "fused":
         # these knobs only exist on the fused single-program path; say so
@@ -448,7 +455,165 @@ def cmd_train(args) -> int:
               "reply from its weight update; the fused/pipeline paths "
               "have no server party)", file=sys.stderr)
 
-    if args.transport in ("fused", "pipeline"):
+    if cfg.mode == "split" and cfg.num_stages > 2 \
+            and args.transport in ("local", "http"):
+        # K-stage MPMD chain (PR 14): stage 0 trains here, stages
+        # 1..K-1 are StageRuntime parties — in-process behind
+        # LocalTransports, or remote `serve --role stage` processes —
+        # driven by the GPipe microbatched PipelineRunner
+        from split_learning_tpu.runtime.pipeline_runner import (
+            PipelineRunner)
+        from split_learning_tpu.runtime.stage import StageRuntime
+        if plan.num_stages != cfg.num_stages:
+            print(f"[error] --stages {cfg.num_stages} does not match "
+                  f"model {cfg.model!r} ({plan.num_stages} stages); "
+                  "pick a chain plan (e.g. split_cnn_chain3, "
+                  "resnet18_4stage)", file=sys.stderr)
+            return 2
+        M = max(cfg.microbatches, 1)
+        lag = getattr(args, "apply_lag", 0) or 0
+        stage_rts: list = []
+        transports: list = []
+        if args.transport == "http":
+            from split_learning_tpu.transport.http import HttpTransport
+            urls = [u.strip() for u in
+                    (getattr(args, "stage_urls", None) or "").split(",")
+                    if u.strip()]
+            if len(urls) != plan.num_stages - 1:
+                print(f"[error] chain over http needs --stage-urls with "
+                      f"{plan.num_stages - 1} URLs (one per remote "
+                      f"stage, chain order; got {len(urls)})",
+                      file=sys.stderr)
+                return 2
+            for i, url in enumerate(urls):
+                t = HttpTransport(url)
+                info = t.wait_ready(timeout=args.wait_server)
+                if info.get("role") != "stage" \
+                        or info.get("stage_index") != i + 1:
+                    print(f"[error] {url} reports "
+                          f"role={info.get('role')!r} "
+                          f"stage_index={info.get('stage_index')!r}; "
+                          f"expected a stage {i + 1} party (start it "
+                          f"with serve --role stage --stage-index "
+                          f"{i + 1})", file=sys.stderr)
+                    return 4
+                if info.get("microbatches") != M:
+                    print(f"[error] {url} serves microbatches="
+                          f"{info.get('microbatches')} but this client "
+                          f"runs --microbatches {M}; the 1/M loss "
+                          "scaling must agree", file=sys.stderr)
+                    return 4
+                transports.append(t)
+        else:
+            for i in range(1, plan.num_stages):
+                srt = StageRuntime(plan, i, cfg,
+                                   jax.random.PRNGKey(cfg.seed), sample,
+                                   microbatches=M, apply_lag=lag,
+                                   mesh=_server_mesh(args))
+                stage_rts.append(srt)
+                transports.append(LocalTransport(srt))
+        chaos_spec = getattr(args, "chaos", None)
+        if chaos_spec:
+            from split_learning_tpu.transport.chaos import (
+                ChaosPolicy, ChaosTransport)
+            chaos_policy = ChaosPolicy(
+                chaos_spec, seed=getattr(args, "chaos_seed", 0) or 0)
+            # one policy, every hop wire: the seeded draws key on
+            # (path, hop_seq) so the schedules stay disjoint per wire
+            # direction and microbatch
+            transports = [ChaosTransport(t, chaos_policy)
+                          for t in transports]
+            print(f"[chaos] injecting {chaos_spec!r} "
+                  f"(seed {chaos_policy.seed}) on every hop wire",
+                  file=sys.stderr)
+        runner = PipelineRunner(plan, cfg, rng, sample, transports,
+                                microbatches=M)
+
+        start_step = 0
+        if ckptr is not None:
+            _write_ckpt_meta(cfg.checkpoint_dir, "chain", cfg, size_kw,
+                             seq_len)
+            latest = ckptr.latest_step()
+            if args.resume and latest is not None and stage_rts:
+                tree = {"client": runner.state}
+                for srt in stage_rts:
+                    tree[f"stage{srt.stage_index}"] = srt.state
+                tree = ckptr.restore(tree)
+                runner.state = tree["client"]
+                for srt in stage_rts:
+                    # per-stage extras sidecar lives under stage<i>/ —
+                    # each party's replay cache restores (or clears)
+                    # independently
+                    d = os.path.join(ckptr.directory,
+                                     f"stage{srt.stage_index}")
+                    srt.resume_from(
+                        tree[f"stage{srt.stage_index}"], latest,
+                        extras=read_latest_extras(d, step=latest))
+                start_step = latest
+                runner.steps_done = latest
+                print(f"[ckpt] chain resumed at step {start_step} from "
+                      f"{cfg.checkpoint_dir}", file=sys.stderr)
+            elif args.resume and latest is not None:
+                print("[warn] --resume over http stage parties resumes "
+                      "only the client stage; restart the stage "
+                      "processes with their own checkpoints",
+                      file=sys.stderr)
+
+        def save_chain(step: int) -> None:
+            if ckptr is None or not stage_rts:
+                return
+            tree = {"client": runner.state}
+            for srt in stage_rts:
+                # export_state flushes each stage's deferred queue
+                # first: the joint snapshot never captures a party
+                # that is apply_lag updates behind its shipped replies
+                tree[f"stage{srt.stage_index}"] = srt.export_state()
+            if ckptr.save_once(step, tree):
+                for srt in stage_rts:
+                    d = os.path.join(ckptr.directory,
+                                     f"stage{srt.stage_index}")
+                    os.makedirs(d, exist_ok=True)
+                    write_extras(d, srt.export_runtime_extras(step))
+
+        step = start_step
+        try:
+            with _ckpt_drain(ckptr), trace_ctx:
+                for epoch in range(cfg.epochs):
+                    for x, y in data_iter():
+                        final_loss = runner.step(x, y, step)
+                        logger.log_metric("loss", final_loss, step=step)
+                        step += 1
+                        if (args.checkpoint_every
+                                and (step - start_step)
+                                % args.checkpoint_every == 0):
+                            save_chain(step)
+                    save_chain(step)
+        finally:
+            chain_meta = runner.trace_metadata()
+            runner.close()
+            for t in transports:
+                close = getattr(t, "close", None)
+                if close is not None:
+                    close()
+            for srt in stage_rts:
+                srt.close()
+            if ckptr is not None:
+                ckptr.wait_until_finished()
+        n_steps = step - start_step
+        for i, t in enumerate(transports):
+            print(f"[transport] hop {i + 1}: {t.stats.summary()}",
+                  file=sys.stderr)
+        for st in chain_meta.get("stages", []):
+            bf = st.get("bubble_fraction")
+            print(f"[pipeline] stage {st['stage']}: bubble="
+                  f"{bf if bf is None else round(bf, 3)} "
+                  f"(ideal {st['bubble_theoretical']:.3f}) "
+                  f"reply_p50={st['reply_p50_ms']:.1f}ms",
+                  file=sys.stderr)
+        if stage_rts:
+            full_params = [runner.state.params] + [
+                srt.export_state().params for srt in stage_rts]
+    elif args.transport in ("fused", "pipeline"):
         from split_learning_tpu.parallel import global_mesh
         from split_learning_tpu.parallel.mesh import replicated
         if args.transport == "fused":
@@ -881,7 +1046,8 @@ def cmd_train(args) -> int:
         obs.disable()
         out_path = step_tracer.export_chrome(
             trace_path,
-            metadata=server.trace_metadata() if server is not None else None)
+            metadata=server.trace_metadata() if server is not None else None,
+            stage_metadata=chain_meta)
         print(f"[trace] {len(step_tracer.spans())} spans -> {out_path} "
               "(Perfetto-loadable; summarize with scripts/trace_report.py)",
               file=sys.stderr)
@@ -960,23 +1126,47 @@ def cmd_serve(args) -> int:
             "mnist" if cfg.dataset == "synthetic" else cfg.dataset,
             (28, 28, 1))
         sample = np.zeros((cfg.batch_size,) + shape, np.float32)
-    try:
-        runtime = ServerRuntime(plan, cfg, jax.random.PRNGKey(cfg.seed),
-                                sample,
-                                strict_steps=not args.allow_out_of_order,
-                                coalesce_max=args.coalesce_max,
-                                coalesce_window_ms=args.coalesce_window_ms,
-                                overlap=not args.no_overlap,
-                                batching=args.batching,
-                                tenants=args.tenants,
-                                quota=args.quota,
-                                slo_ms=args.slo_ms,
-                                decouple_bwd=args.decouple_bwd,
-                                apply_lag=args.apply_lag,
-                                mesh=_server_mesh(args))
-    except ValueError as e:  # e.g. --coalesce-max outside split mode
-        print(f"[error] {e}", file=sys.stderr)
-        return 2
+    role = getattr(args, "role", "server") or "server"
+    if role == "stage":
+        # one middle/last party of the K-stage MPMD chain (PR 14): the
+        # same HTTP wire, serving the hop ops instead of split_step
+        from split_learning_tpu.runtime.stage import StageRuntime
+        if cfg.checkpoint_dir:
+            print("[warn] stage parties do not own checkpoints; "
+                  "--checkpoint-dir ignored (the chain client saves the "
+                  "joint tree over local transports)", file=sys.stderr)
+            cfg = cfg.replace(checkpoint_dir=None)
+        try:
+            runtime = StageRuntime(
+                plan, getattr(args, "stage_index", 1) or 1, cfg,
+                jax.random.PRNGKey(cfg.seed), sample,
+                strict_steps=not args.allow_out_of_order,
+                microbatches=max(cfg.microbatches, 1),
+                apply_lag=args.apply_lag,
+                tenants=args.tenants, quota=args.quota,
+                slo_ms=args.slo_ms, mesh=_server_mesh(args))
+        except ValueError as e:  # e.g. stage_index out of range
+            print(f"[error] {e}", file=sys.stderr)
+            return 2
+    else:
+        try:
+            runtime = ServerRuntime(
+                plan, cfg, jax.random.PRNGKey(cfg.seed),
+                sample,
+                strict_steps=not args.allow_out_of_order,
+                coalesce_max=args.coalesce_max,
+                coalesce_window_ms=args.coalesce_window_ms,
+                overlap=not args.no_overlap,
+                batching=args.batching,
+                tenants=args.tenants,
+                quota=args.quota,
+                slo_ms=args.slo_ms,
+                decouple_bwd=args.decouple_bwd,
+                apply_lag=args.apply_lag,
+                mesh=_server_mesh(args))
+        except ValueError as e:  # e.g. --coalesce-max outside split mode
+            print(f"[error] {e}", file=sys.stderr)
+            return 2
 
     # the server party owns its half's persistence (the client cannot
     # checkpoint it across HTTP): periodic saves + resume with the step
@@ -1140,7 +1330,7 @@ def cmd_serve(args) -> int:
                              compress=args.compress or "none",
                              density=args.compress_density,
                              chaos=chaos_policy).start()
-    print(f"[serve] mode={cfg.mode} listening on {server.url}")
+    print(f"[serve] mode={cfg.mode} role={role} listening on {server.url}")
     try:
         while True:
             time.sleep(3600)
@@ -1152,8 +1342,10 @@ def cmd_serve(args) -> int:
         if step_tracer is not None:
             from split_learning_tpu import obs
             obs.disable()
-            step_tracer.export_chrome(trace_path,
-                                      metadata=runtime.trace_metadata())
+            step_tracer.export_chrome(
+                trace_path,
+                metadata=(runtime.trace_metadata()
+                          if hasattr(runtime, "trace_metadata") else None))
             print(f"[trace] Chrome trace written to {trace_path}",
                   file=sys.stderr)
         if ckptr is not None:
@@ -1481,6 +1673,19 @@ def main(argv: Optional[list] = None) -> int:
     pt.add_argument("--process-id", dest="process_id", type=int, default=None,
                     help="this host's index (k8s: the pod ordinal)")
     pt.add_argument("--microbatches", type=int, default=None)
+    pt.add_argument("--stages", dest="num_stages", type=int, default=None,
+                    help="pipeline stages. On --transport local/http with "
+                         "mode=split and a chain plan (split_cnn_chain3, "
+                         "resnet18_4stage), > 2 selects the K-stage MPMD "
+                         "chain: stage 0 trains here, every other stage "
+                         "is a StageRuntime party and --microbatches "
+                         "GPipe-fills the hop wires (PR 14)")
+    pt.add_argument("--stage-urls", dest="stage_urls", default=None,
+                    metavar="URL[,URL...]",
+                    help="chain over http: comma-separated stage party "
+                         "URLs in chain order (stage 1 first), one per "
+                         "remote stage — each a `serve --role stage` "
+                         "process")
     pt.add_argument("--require-real", action="store_true",
                     help="fail if real dataset files are absent instead of "
                          "falling back to synthetic data")
@@ -1564,6 +1769,19 @@ def main(argv: Optional[list] = None) -> int:
     _add_common(ps)
     ps.add_argument("--host", default="0.0.0.0")
     ps.add_argument("--port", type=int, default=8000)
+    ps.add_argument("--role", choices=["server", "stage"], default="server",
+                    help="party kind: 'server' owns the tail of a 1-cut "
+                         "split; 'stage' owns one interior/tail stage of a "
+                         "K-stage MPMD chain (PR 14) and speaks the hop "
+                         "protocol (/hop_forward, /hop_backward, /hop_loss)")
+    ps.add_argument("--stage-index", dest="stage_index", type=int, default=1,
+                    help="--role stage: which SplitPlan stage this party "
+                         "owns (1..K-1; stage 0 is always the data-owning "
+                         "client)")
+    ps.add_argument("--microbatches", type=int, default=None,
+                    help="--role stage: GPipe microbatches per step the "
+                         "chain driver will send; must agree across all "
+                         "stage parties and the trainer (health-checked)")
     ps.add_argument("--resume", action="store_true",
                     help="restore the latest server checkpoint on startup")
     ps.add_argument("--checkpoint-every", type=int, default=100,

@@ -111,3 +111,21 @@ def u_split_cnn_plan(dtype: Any = jnp.float32) -> SplitPlan:
         ),
         owners=("client", "server", "client"),
     )
+
+
+def chain3_cnn_plan(dtype: Any = jnp.float32) -> SplitPlan:
+    """K-stage MPMD chain (PR 14): client(A) → stage(trunk) → stage(head).
+
+    Same three modules as the U-shape but with BOTH cut-side stages
+    server-owned — two wire cuts, each served by its own StageRuntime
+    party (runtime/stage.py); the composition is still exactly the
+    reference FullModel arithmetic (labels travel to the last stage,
+    which computes the loss, like the classic split)."""
+    return SplitPlan(
+        stages=(
+            from_flax("part_a", CNNPartA(dtype=dtype)),
+            from_flax("trunk_b", CNNTrunkB(dtype=dtype)),
+            from_flax("head_c", CNNHeadC(dtype=dtype)),
+        ),
+        owners=("client", "server", "server"),
+    )

@@ -14,7 +14,8 @@ from typing import Any, Tuple
 import jax.numpy as jnp
 
 from split_learning_tpu.core.stage import SplitPlan
-from split_learning_tpu.models.cnn import split_cnn_plan, u_split_cnn_plan
+from split_learning_tpu.models.cnn import (
+    chain3_cnn_plan, split_cnn_plan, u_split_cnn_plan)
 
 _FAMILIES = {}
 
@@ -43,6 +44,22 @@ def _split_cnn(mode: str, dtype: Any, **kw: Any) -> SplitPlan:
     # both "split" and "federated" use the same 2-stage plan: federated mode
     # trains the composition (the reference's FullModel, src/model_def.py:31-46)
     return split_cnn_plan(dtype=dtype)
+
+
+@register_model("split_cnn_chain3")
+def _split_cnn_chain3(mode: str, dtype: Any, **kw: Any) -> SplitPlan:
+    """The reference CNN as a 3-stage MPMD pipeline chain (PR 14):
+    client(A) → stage(trunk) → stage(head), two wire cuts. Served by
+    runtime/stage.py StageRuntime parties and driven by
+    runtime/pipeline_runner.py."""
+    if kw:
+        raise ValueError(f"split_cnn_chain3 is the fixed reference "
+                         f"architecture re-cut; it takes no size "
+                         f"overrides (got {sorted(kw)})")
+    if mode != "split":
+        raise ValueError("split_cnn_chain3 is a pipeline chain plan; "
+                         "use mode='split'")
+    return chain3_cnn_plan(dtype=dtype)
 
 
 @register_model("resnet18")

@@ -72,6 +72,15 @@ GATHER_BYTES = "gather_bytes"
 # metadata events have no duration to tile a timeline with.
 MESH_META = "mesh_meta"
 
+# -- MPMD pipeline / K-stage chain (runtime/stage.py, PR 14) ----------- #
+# chrome-trace metadata event name (ph:"M", the MESH_META precedent):
+# the per-stage pipeline sidecar the runner's trace_metadata() emits —
+# bubble fraction (idle ticks / total ticks, GPipe T = M + S - 1),
+# per-hop reply p50, deferred-apply depth — and trace_report.py's
+# pipeline section reads. NOT in the phase tuples: metadata events have
+# no duration to tile a timeline with.
+STAGE_META = "stage_meta"
+
 # XLA compile events surfaced by obs/dispatch_debug.py under
 # SLT_DISPATCH_DEBUG=1 — a recompile storm shows up on the timeline and
 # in trace_report.py's compile summary; deliberately NOT in SERVER_PHASES
@@ -112,6 +121,14 @@ FL_RECV = "fl_recv"                      # party received a request/reply
 FL_CLOSE = "fl_close"                    # runtime close entered
 FL_WATCHDOG_TRIP = "fl_watchdog_trip"    # lock/dispatch watchdog violation
 FL_FATAL = "fl_fatal"                    # SIGTERM / fatal exception dump
+# MPMD pipeline hops (PR 14): every event carries ``stage`` (the
+# receiving/replying stage index), ``mb`` (microbatch id) and ``dir``
+# ("fwd"/"bwd"), so a multi-dump postmortem merge can order one
+# microbatch's journey causally across parties and detect per-(stage,
+# step) microbatch-order inversions (anomaly ``hop_out_of_order``).
+FL_HOP_SEND = "fl_hop_send"              # pipeline hop posted toward a stage
+FL_HOP_RECV = "fl_hop_recv"              # pipeline hop delivered/acknowledged
+FL_STAGE_REPLY = "fl_stage_reply"        # stage replied (cut grad / acts)
 
 FLIGHT_EVENTS = (
     FL_ADMIT, FL_REJECT, FL_CLAIM_BEGIN, FL_CLAIM_RESOLVE, FL_CLAIM_FAIL,
@@ -119,7 +136,8 @@ FLIGHT_EVENTS = (
     FL_DISPATCH, FL_REPLY, FL_DEFER_ENQ, FL_DEFER_APPLY, FL_DEFER_FLUSH,
     FL_BREAKER, FL_CHAOS, FL_CKPT_CAPTURE, FL_CKPT_COMMIT,
     FL_CKPT_LINEAGE, FL_GATHER, FL_SEND, FL_RECV, FL_CLOSE,
-    FL_WATCHDOG_TRIP, FL_FATAL)
+    FL_WATCHDOG_TRIP, FL_FATAL, FL_HOP_SEND, FL_HOP_RECV,
+    FL_STAGE_REPLY)
 
 # the client-level phases that tile a step — the denominator of the
 # compute-vs-wire fraction (encode/wire are sub-phases of transport and

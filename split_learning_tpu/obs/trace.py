@@ -138,7 +138,8 @@ class Tracer:
         return totals.get(name, 0.0) / denom if denom > 0 else 0.0
 
     # -------------------------------------------------------------- #
-    def chrome_events(self, metadata: Optional[Dict[str, Any]] = None
+    def chrome_events(self, metadata: Optional[Dict[str, Any]] = None,
+                      stage_metadata: Optional[Dict[str, Any]] = None
                       ) -> List[Dict[str, Any]]:
         """Chrome trace event objects (``ph: "X"`` complete events, µs
         timestamps relative to tracer start, one pid per party).
@@ -147,7 +148,9 @@ class Tracer:
         shape + per-program MFU) is emitted as one extra ``ph: "M"``
         event named ``spans.MESH_META`` so viewers ignore it and
         ``scripts/trace_report.py`` can pick it up without a schema
-        change to the span lines."""
+        change to the span lines. ``stage_metadata``
+        (``PipelineRunner.trace_metadata()`` — per-stage bubble/reply
+        accounting) rides the same way under ``spans.STAGE_META``."""
         events: List[Dict[str, Any]] = [
             {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
              "args": {"name": f"slt-{party}"}}
@@ -156,6 +159,9 @@ class Tracer:
         if metadata is not None:
             events.append({"name": spans.MESH_META, "ph": "M",
                            "pid": 0, "tid": 0, "args": metadata})
+        if stage_metadata is not None:
+            events.append({"name": spans.STAGE_META, "ph": "M",
+                           "pid": 0, "tid": 0, "args": stage_metadata})
         for sp in self.spans():
             events.append({
                 "name": sp["name"], "cat": sp["party"], "ph": "X",
@@ -167,14 +173,17 @@ class Tracer:
         return events
 
     def export_chrome(self, path: str,
-                      metadata: Optional[Dict[str, Any]] = None) -> str:
+                      metadata: Optional[Dict[str, Any]] = None,
+                      stage_metadata: Optional[Dict[str, Any]] = None
+                      ) -> str:
         """Write the Chrome-trace JSON array, one event per line (valid
         JSON and line-parseable; Perfetto/chrome://tracing load it
-        directly). ``metadata`` rides as a ``ph:"M"`` event (see
-        :meth:`chrome_events`). Returns ``path``."""
+        directly). ``metadata``/``stage_metadata`` ride as ``ph:"M"``
+        events (see :meth:`chrome_events`). Returns ``path``."""
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
-        events = self.chrome_events(metadata=metadata)
+        events = self.chrome_events(metadata=metadata,
+                                    stage_metadata=stage_metadata)
         with open(path, "w") as f:
             f.write("[\n")
             for i, ev in enumerate(events):

@@ -1,0 +1,392 @@
+"""PipelineRunner — the client-side GPipe driver of the K-stage MPMD
+chain (PR 14).
+
+`parallel/pipeline.py` is pipeline parallelism INSIDE one jitted SPMD
+program: every stage lives on one mesh, cuts are ``ppermute`` hops, one
+party owns everything. The MPMD chain is the same schedule pulled apart
+across parties (arXiv:2412.14374): stage 0 runs here (the data owner —
+split learning's privacy boundary), stages 1..S-1 are remote
+:class:`~split_learning_tpu.runtime.stage.StageRuntime` parties reached
+through one :class:`Transport` each, and the cut tensors cross real
+wires. The driver is the hub: it relays each microbatch's activations
+stage-to-stage (hub-and-spoke MPMD — the Transport abstraction is
+client↔party, and the data owner stays the only party that sees every
+cut, exactly as in the 2-party protocol).
+
+Schedule: GPipe with M microbatches in flight. Each wire gets TWO
+dedicated worker threads — one forward, one backward — fed by FIFO
+queues, so (a) microbatch m+1's forward overlaps microbatch m's
+backward on the same wire (full duplex), (b) per (stage, direction)
+the hops leave in microbatch order (the strict-seq handshake and
+invariant SLT113 both key on that), and (c) middle stages never idle
+while the chain is full. The tick math is `parallel/pipeline.py`'s:
+T = M + S - 1 clock ticks per step, bubble fraction (S-1)/(M+S-1) —
+``stage_report()`` carries both the theoretical number and the
+measured one (1 - wire-busy/wall).
+
+Weight updates: the last stage's loss hop replies per-microbatch
+cut-cotangents pre-scaled by 1/M (see StageRuntime._build_jitted), so
+summing the M per-microbatch stage-0 vjp contributions reproduces the
+batch-mean gradient; one optimizer apply per step, after the step's
+last cotangent returns. Cotangents are accumulated in microbatch
+order, not arrival order, so a run is deterministic regardless of
+wire jitter. Remote stages defer their own applies under their own
+``apply_lag`` (staleness bounds compose per stage, arXiv:1910.05104).
+
+Fault policy: transient wire faults (TransportError — chaos drop/dup,
+a 5xx, a lost reply) retry with bounded backoff; the stages' replay
+caches make the retry exactly-once. Backpressure honors the advised
+delay. ProtocolError is permanent and propagates.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from split_learning_tpu.core.stage import SplitPlan
+from split_learning_tpu.obs import dispatch_debug as obs_dispatch
+from split_learning_tpu.runtime.server import ProtocolError
+from split_learning_tpu.runtime.state import (
+    TrainState, apply_grads, make_state, make_tx)
+from split_learning_tpu.transport.base import (
+    Backpressure, Transport, TransportError)
+from split_learning_tpu.utils.config import Config
+
+# bounded retry of one hop delivery: covers chaos's max_faults_per_key
+# (2) with room for a real transient on top
+DEFAULT_HOP_RETRIES = 4
+
+
+def pipeline_ticks(microbatches: int, num_stages: int) -> int:
+    """GPipe clock length per step (parallel/pipeline.py: T = M + S - 1)."""
+    return int(microbatches) + int(num_stages) - 1
+
+
+def bubble_fraction(microbatches: int, num_stages: int) -> float:
+    """Idle ticks / total ticks of the ideal schedule: (S-1)/(M+S-1)."""
+    s = int(num_stages)
+    return (s - 1) / float(pipeline_ticks(microbatches, s))
+
+
+class _HopWorker(threading.Thread):
+    """One direction of one wire: pops (step, mb, payload...) jobs in
+    FIFO order, runs the hop with bounded retry, pushes downstream.
+    A failed job parks the exception on the runner; the sentinel it
+    forwards unblocks whoever is waiting at the chain's end."""
+
+    def __init__(self, name: str, runner: "PipelineRunner", fn) -> None:
+        super().__init__(name=name, daemon=True)
+        self.q: "queue.Queue" = queue.Queue()
+        self._runner = runner
+        self._fn = fn
+        self.busy_s = 0.0
+        self.calls = 0
+        self.durations: List[float] = []
+
+    def run(self) -> None:
+        while True:
+            job = self.q.get()
+            if job is None:
+                return
+            try:
+                t0 = time.perf_counter()
+                self._fn(*job)
+                dt = time.perf_counter() - t0
+                self.busy_s += dt
+                self.calls += 1
+                self.durations.append(dt)
+            except BaseException as exc:  # noqa: BLE001 — parked, re-raised
+                self._runner._park_error(exc)
+
+
+class PipelineRunner:
+    """Drives stage 0 locally and S-1 remote stages through their
+    transports, M microbatches in flight per step."""
+
+    def __init__(self, plan: SplitPlan, cfg: Config, rng: jax.Array,
+                 sample_input: np.ndarray,
+                 transports: Sequence[Transport],
+                 microbatches: int = 1,
+                 client_id: int = 0,
+                 hop_retries: int = DEFAULT_HOP_RETRIES,
+                 step_timeout_s: float = 300.0) -> None:
+        """``transports[i]`` reaches stage ``i + 1`` (LocalTransport
+        around an in-process StageRuntime, HttpTransport to a
+        ``serve --role stage`` process, ChaosTransport around either).
+        ``rng``/``sample_input`` are the shared plan-level seed all
+        parties initialize from — stage 0's params here agree with the
+        chain's by construction, no weights ship."""
+        if plan.num_stages < 2:
+            raise ValueError("a pipeline chain needs >= 2 stages")
+        if len(transports) != plan.num_stages - 1:
+            raise ValueError(
+                f"need one transport per remote stage "
+                f"({plan.num_stages - 1}; got {len(transports)})")
+        self.plan = plan
+        self.cfg = cfg
+        self.microbatches = int(microbatches)
+        if self.microbatches < 1:
+            raise ValueError(
+                f"microbatches must be >= 1 (got {microbatches})")
+        self.client_id = int(client_id)
+        self.transports = list(transports)
+        self.hop_retries = int(hop_retries)
+        self.step_timeout_s = float(step_timeout_s)
+
+        self._tx = make_tx(cfg)
+        params0 = plan.init(rng, jnp.asarray(sample_input))[0]
+        self.state: TrainState = make_state(params0, self._tx)
+        self._dd = obs_dispatch.attach()
+        self._ddtok = obs_dispatch.token()
+        self._build_jitted()
+
+        self._err_lock = threading.Lock()
+        self._errs: List[BaseException] = []
+        self._losses: Dict[Tuple[int, int], float] = {}
+        self._done_q: "queue.Queue" = queue.Queue()
+        self._workers: List[_HopWorker] = []
+        self._fwd_workers: List[_HopWorker] = []
+        self._bwd_workers: List[_HopWorker] = []
+        self._spawn_workers()
+        self.steps_done = 0
+        self._wall_s = 0.0
+
+    # ------------------------------------------------------------------ #
+    def _build_jitted(self) -> None:
+        stage0 = self.plan.stages[0]
+        tx = self._tx
+
+        def fwd0_fn(params, x):
+            return stage0.apply(params, x)
+
+        def bwd_acc_fn(params, x, g, acc):
+            _, vjp = jax.vjp(lambda p: stage0.apply(p, x), params)
+            (gp,) = vjp(g)
+            return jax.tree_util.tree_map(jnp.add, acc, gp)
+
+        def zeros_fn(params):
+            return jax.tree_util.tree_map(jnp.zeros_like, params)
+
+        def apply_fn(state, grads):
+            return apply_grads(tx, state, grads)
+
+        # fixed microbatch shapes => each compiles once; the dispatch
+        # watchdog's steady_state_recompiles gauge pins that
+        self._fwd0 = jax.jit(fwd0_fn)
+        self._bwd_acc = jax.jit(bwd_acc_fn)
+        self._zeros = jax.jit(zeros_fn)
+        self._apply = jax.jit(apply_fn)
+
+    # ------------------------------------------------------------------ #
+    def _park_error(self, exc: BaseException) -> None:
+        with self._err_lock:
+            self._errs.append(exc)
+        # unblock the step loop; the payload slot flags the failure
+        self._done_q.put(("err", exc))
+
+    def _wire(self, fn, *args):
+        """Bounded-retry delivery of one hop. Transient faults retry
+        (the stage's replay cache makes redelivery exactly-once);
+        ProtocolError is permanent and propagates."""
+        delay = 0.05
+        for attempt in range(self.hop_retries + 1):
+            try:
+                return fn(*args)
+            except Backpressure as bp:
+                if attempt >= self.hop_retries:
+                    raise
+                time.sleep(bp.retry_after_s or delay)
+            except ProtocolError:
+                raise
+            except TransportError:
+                if attempt >= self.hop_retries:
+                    raise
+                time.sleep(delay)
+                delay = min(delay * 2, 1.0)
+
+    def _spawn_workers(self) -> None:
+        W = len(self.transports)
+        self._fwd_workers = []
+        self._bwd_workers = [None] * max(W - 1, 0)
+
+        def make_fwd(i: int):
+            t = self.transports[i]
+            if i == W - 1:
+                def last_hop(step, mb, x, labels):
+                    g, loss = self._wire(t.hop_loss, x, labels, step, mb,
+                                         self.client_id)
+                    loss_host = float(loss)  # host scalar before the lock
+                    with self._err_lock:
+                        self._losses[(step, mb)] = loss_host
+                    if W == 1:
+                        self._done_q.put((step, mb, g))
+                    else:
+                        self._bwd_workers[W - 2].q.put((step, mb, g))
+                return last_hop
+
+            def mid_hop(step, mb, x, labels):
+                y = self._wire(t.hop_forward, x, step, mb, self.client_id)
+                self._fwd_workers[i + 1].q.put((step, mb, y, labels))
+            return mid_hop
+
+        def make_bwd(i: int):
+            t = self.transports[i]
+
+            def bwd_hop(step, mb, g):
+                g_in = self._wire(t.hop_backward, g, step, mb,
+                                  self.client_id)
+                if i == 0:
+                    self._done_q.put((step, mb, g_in))
+                else:
+                    self._bwd_workers[i - 1].q.put((step, mb, g_in))
+            return bwd_hop
+
+        for i in range(W):
+            w = _HopWorker(f"pipe-fwd-{i + 1}", self, make_fwd(i))
+            self._fwd_workers.append(w)
+        for i in range(W - 1):
+            self._bwd_workers[i] = _HopWorker(
+                f"pipe-bwd-{i + 1}", self, make_bwd(i))
+        self._workers = self._fwd_workers + list(self._bwd_workers)
+        for w in self._workers:
+            w.start()
+
+    # ------------------------------------------------------------------ #
+    def step(self, x: np.ndarray, y: np.ndarray,
+             step: Optional[int] = None) -> float:
+        """One training step: M microbatches pipelined through the
+        chain, one stage-0 apply. Returns the batch loss (mean of the
+        per-microbatch CE means — equal-size microbatches)."""
+        M = self.microbatches
+        x = np.asarray(x)
+        y = np.asarray(y)
+        if x.shape[0] % M != 0:
+            raise ValueError(
+                f"batch {x.shape[0]} not divisible by microbatches {M}")
+        step_i = self.steps_done if step is None else int(step)
+        with self._err_lock:
+            if self._errs:
+                raise self._errs[0]
+        t_wall0 = time.perf_counter()
+        mbsz = x.shape[0] // M
+        x_dev: Dict[int, jax.Array] = {}
+        # fill the pipe: stage-0 forwards stream out in mb order; the
+        # hop workers keep M in flight across the chain from here on
+        for m in range(M):
+            xs = jnp.asarray(x[m * mbsz:(m + 1) * mbsz])
+            with obs_dispatch.step_scope(
+                    self._dd, (self._ddtok, "pipe_fwd0"),
+                    sig_fn=lambda: (xs.shape, str(xs.dtype))):
+                y0 = self._fwd0(self.state.params, xs)
+            x_dev[m] = xs
+            with obs_dispatch.expected_d2h(self._dd):
+                y0_host = np.asarray(y0)
+            self._fwd_workers[0].q.put(
+                (step_i, m, y0_host, y[m * mbsz:(m + 1) * mbsz]))
+        # drain: the step's M cotangents, arrival order
+        cts: Dict[int, np.ndarray] = {}
+        deadline = time.monotonic() + self.step_timeout_s
+        while len(cts) < M:
+            try:
+                item = self._done_q.get(
+                    timeout=max(deadline - time.monotonic(), 0.01))
+            except queue.Empty:
+                raise TransportError(
+                    f"pipeline stalled: step {step_i} got "
+                    f"{len(cts)}/{M} cotangents within "
+                    f"{self.step_timeout_s:.0f}s") from None
+            if item[0] == "err":
+                raise item[1]
+            s, m, g = item
+            if s != step_i:  # stale sentinel from an aborted step
+                continue
+            cts[m] = g
+        # accumulate in MICROBATCH order (determinism), apply once
+        acc = self._zeros(self.state.params)
+        for m in range(M):
+            g_dev = jnp.asarray(cts[m])
+            with obs_dispatch.step_scope(
+                    self._dd, (self._ddtok, "pipe_bwd0"),
+                    sig_fn=lambda: (g_dev.shape, str(g_dev.dtype))):
+                acc = self._bwd_acc(self.state.params, x_dev[m], g_dev,
+                                    acc)
+        with obs_dispatch.step_scope(
+                self._dd, (self._ddtok, "pipe_apply0"),
+                sig_fn=lambda: ()):
+            self.state = self._apply(self.state, acc)
+        with self._err_lock:
+            losses = [self._losses.pop((step_i, m)) for m in range(M)]
+        self.steps_done += 1
+        self._wall_s += time.perf_counter() - t_wall0
+        return float(np.mean(losses))
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        """Forward-only through the whole chain (each stage's predict
+        sits behind its own flush barrier)."""
+        y0 = self._fwd0(self.state.params, jnp.asarray(x))
+        with obs_dispatch.expected_d2h(self._dd):
+            out = np.asarray(y0)
+        for t in self.transports:
+            out = t.predict(out, self.client_id)
+        return np.asarray(out)
+
+    # -- accounting ----------------------------------------------------- #
+    def stage_report(self) -> List[Dict[str, Any]]:
+        """Per remote stage: measured bubble fraction (1 - wire-busy /
+        driver wall), theoretical GPipe bubble, hop-reply p50, and the
+        stage's deferred-apply depth (over its own health endpoint —
+        transport-agnostic)."""
+        S = self.plan.num_stages
+        theo = bubble_fraction(self.microbatches, S)
+        out = []
+        for i, t in enumerate(self.transports):
+            fwd = self._fwd_workers[i]
+            bwd = (self._bwd_workers[i]
+                   if i < len(self._bwd_workers) else None)
+            busy = fwd.busy_s + (bwd.busy_s if bwd is not None else 0.0)
+            durs = sorted(fwd.durations
+                          + (bwd.durations if bwd is not None else []))
+            p50 = durs[len(durs) // 2] if durs else 0.0
+            depth = None
+            try:
+                h = t.health()
+                depth = h.get("counters", {}).get("deferred_apply_depth")
+            except Exception:  # noqa: BLE001 — report stays best-effort
+                pass
+            out.append({
+                "stage": i + 1,
+                "bubble_fraction": (max(0.0, 1.0 - busy / self._wall_s)
+                                    if self._wall_s > 0 else None),
+                "bubble_theoretical": theo,
+                "reply_p50_ms": p50 * 1e3,
+                "hop_calls": fwd.calls + (bwd.calls if bwd else 0),
+                "deferred_apply_depth": depth,
+            })
+        return out
+
+    def trace_metadata(self) -> Dict[str, Any]:
+        """The STAGE_META sidecar payload (obs/spans.py): what
+        scripts/trace_report.py's pipeline section renders."""
+        return {
+            "num_stages": self.plan.num_stages,
+            "microbatches": self.microbatches,
+            "ticks_per_step": pipeline_ticks(self.microbatches,
+                                             self.plan.num_stages),
+            "steps": self.steps_done,
+            "stages": self.stage_report(),
+        }
+
+    def close(self) -> None:
+        """Stop the hop workers (transports stay the caller's to
+        close)."""
+        for w in self._workers:
+            w.q.put(None)
+        for w in self._workers:
+            w.join(timeout=5)

@@ -1,0 +1,630 @@
+"""StageRuntime — one party of the K-stage MPMD split pipeline (PR 14).
+
+The 2-party split (`runtime/server.py` ServerRuntime) hard-codes ONE cut:
+client bottom, server top, one blocking round trip per step. MPMD
+pipeline parallelism (arXiv:2412.14374) generalizes the same
+decomposition to K stages — each stage is its own program, its own
+party, its own optimizer — and PiPar (arXiv:2302.12803) shows the
+bubble cost is what microbatching must fill. A StageRuntime owns exactly
+one ``SplitPlan`` stage ``i`` (0 < i < K) and serves three hop ops to
+the pipeline driver (`runtime/pipeline_runner.py`):
+
+- ``hop_forward(x, step, mb)``   — run the stage forward on one
+  microbatch, pin the (params, x) residual for the backward.
+- ``hop_backward(g, step, mb)``  — 2BP reply (PR 10): the cut-layer
+  cotangent ``d(loss)/d(x)`` is computed and returned IMMEDIATELY from
+  the pinned residual; the grad-of-weights + optimizer apply for the
+  whole step is deferred onto a :class:`_DeferredApply` queue bounded
+  by this stage's own ``apply_lag``.
+- ``hop_loss(x, labels, step, mb)`` — the LAST stage's fused hop:
+  forward + per-microbatch CE + immediate cut-gradient reply (scaled by
+  1/M so the M per-stage weight-gradient contributions sum to exactly
+  the batch-mean gradient), weight update deferred like above.
+
+Weight-update unit is one STEP, not one microbatch: all M microbatches
+of a step run on the SAME pinned params snapshot (GPipe semantics —
+required for the deferred vjp to be the gradient of the forward the
+driver saw), and when the step's last cotangent lands the stage queues
+ONE deferred entry holding the M stacked residuals; the jitted deferred
+program recomputes and sums the M per-microbatch weight gradients and
+applies once. At ``apply_lag=0`` that apply lands inside the last
+microbatch's backward call — sequential-equivalent, which is what the
+M=1 bit-identity test pins.
+
+Exactly-once per hop rides the same replay-claim machinery as the
+server (runtime/replay.py): each (client, op, step, mb) is claimed once
+under the composite key ``step * MB_STRIDE + mb``; duplicate deliveries
+(chaos dup, retried drop_resp) lose the claim and are served the one
+materialized reply — a cotangent is never recomputed, a weight update
+never double-queued (slt-check scenario ``pipeline_hop_chain``,
+invariant SLT113).
+
+Optional per-stage admission gating (runtime/admission.py) and a
+per-stage mesh (PR 11: the forward/reply programs compile with
+NamedSharding specs over ``parallel.distributed.server_state_layout``)
+ride along exactly as on ServerRuntime.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from split_learning_tpu.core.losses import cross_entropy
+from split_learning_tpu.core.stage import SplitPlan
+from split_learning_tpu.obs import dispatch_debug as obs_dispatch
+from split_learning_tpu.obs import flight as obs_flight
+from split_learning_tpu.obs import locks as obs_locks
+from split_learning_tpu.obs import spans
+from split_learning_tpu.obs import trace as obs_trace
+from split_learning_tpu.parallel.distributed import server_state_layout
+from split_learning_tpu.runtime.admission import AdmissionController
+from split_learning_tpu.runtime.replay import ReplayCache
+from split_learning_tpu.runtime.server import ProtocolError, _DeferredApply
+from split_learning_tpu.runtime.state import (
+    TrainState, apply_grads, make_state, make_tx)
+from split_learning_tpu.utils.config import Config
+
+# composite replay/chaos key: one monotonic sequence per (step, mb) so
+# the bounded replay window and the strict-monotonicity handshake both
+# see hops in delivery order. 2**16 microbatches per step is far above
+# any real M; the key stays an int so every existing keyed mechanism
+# (ReplayCache, ChaosPolicy draws, _AttemptCounter) works unchanged.
+MB_STRIDE = 1 << 16
+
+# pending per-step residual records (params snapshot + microbatch
+# activations/cotangents) kept before the step's deferred entry forms —
+# the u_residual discipline: bounded FIFO, a backward for an evicted
+# step is a protocol error, not an OOM
+MAX_PENDING_STEPS = 8
+
+
+def hop_seq(step: int, mb: int) -> int:
+    """The composite (step, microbatch) ordinal every hop is keyed by."""
+    return int(step) * MB_STRIDE + int(mb)
+
+
+class StageRuntime:
+    """One middle/last stage of the MPMD chain. Thread-safe: HTTP
+    handler threads and the in-process driver's hop workers may call
+    concurrently; all state transitions happen under one reentrant
+    lock, materialization runs off it (the async-dispatch discipline)."""
+
+    def __init__(self, plan: SplitPlan, stage_index: int, cfg: Config,
+                 rng: jax.Array, sample_input: np.ndarray,
+                 strict_steps: bool = True,
+                 microbatches: int = 1,
+                 apply_lag: int = 0,
+                 replay_window: int = 8,
+                 tenants: int = 1,
+                 quota: Optional[Any] = None,
+                 slo_ms: Optional[Any] = None,
+                 mesh: Optional[Any] = None) -> None:
+        """``rng``/``sample_input`` are the SHARED plan-level seed and
+        stage-0 sample every party initializes the full plan from
+        (keeping only its own stage) — the same convention the client
+        and server runtimes use, so a chain's parties agree on every
+        stage's init without shipping weights.
+
+        ``microbatches`` must match the driver's M: it fixes the 1/M
+        loss-hop scaling and the deferred entry's stacked-residual
+        arity. ``apply_lag`` is this stage's OWN staleness bound in
+        steps (bounds compose per stage across the chain, arXiv:
+        1910.05104)."""
+        if not 0 < stage_index < plan.num_stages:
+            raise ValueError(
+                f"stage_index must be in [1, {plan.num_stages - 1}] "
+                f"(stage 0 is the client's; got {stage_index})")
+        self.plan = plan
+        self.stage_index = int(stage_index)
+        self.cfg = cfg
+        self.strict_steps = strict_steps
+        self.microbatches = int(microbatches)
+        if self.microbatches < 1:
+            raise ValueError(
+                f"microbatches must be >= 1 (got {microbatches})")
+        self.apply_lag = int(apply_lag)
+        if self.apply_lag < 0:
+            raise ValueError(f"apply_lag must be >= 0 (got {apply_lag})")
+        self.is_last = self.stage_index == plan.num_stages - 1
+        self.party = f"stage{self.stage_index}"
+
+        self._lock = obs_locks.make_lock("StageRuntime._lock")
+        self._dd = obs_dispatch.attach()
+        self._ddtok = obs_dispatch.token()
+
+        # a 1-device mesh IS the legacy layout (ServerRuntime precedent)
+        if mesh is not None and mesh.size <= 1:
+            mesh = None
+        self._mesh = mesh
+        self._layout = None
+
+        all_params = plan.init(rng, jnp.asarray(sample_input))
+        self._tx = make_tx(cfg)
+        self.state = make_state(all_params[self.stage_index], self._tx)
+        if self._mesh is not None:
+            self._layout = server_state_layout(self._mesh)
+            self._state_sharding = self._layout.state(self.state)
+            self._params_sharding = self._state_sharding.params
+            self._batch_sharding = self._layout.batch()
+            self.state = jax.device_put(self.state, self._state_sharding)
+        self._build_jitted()
+
+        self._deferred = _DeferredApply(
+            self._apply_deferred_entry, self.apply_lag, self._lock)
+        self.replay: Optional[ReplayCache] = (
+            ReplayCache(window=replay_window) if replay_window > 0
+            else None)
+        self._admission: Optional[AdmissionController] = None
+        if tenants > 1 or quota is not None or slo_ms is not None:
+            self._admission = AdmissionController(
+                tenants=tenants, quota=quota, slo_ms=slo_ms)
+
+        # per-(client, step) residual records: the pinned params
+        # snapshot + per-microbatch device arrays, until the step's
+        # deferred entry forms. FIFO-bounded like the u_residual store.
+        self._recs: "OrderedDict[Tuple[int, int], Dict[str, Any]]" = (
+            OrderedDict())
+        # strict hop handshake: per (client, op) last composite seq
+        self._last_seq: Dict[Tuple[int, str], int] = {}
+        self._seq_floor = -1
+        self._hops = {"hop_fwd": 0, "hop_bwd": 0, "hop_loss": 0}
+        self._ckpt_lineage = 0
+        self._t_start = time.monotonic()
+
+    # ------------------------------------------------------------------ #
+    def _build_jitted(self) -> None:
+        stage = self.plan.stages[self.stage_index]
+        tx = self._tx
+        M = self.microbatches
+        # 1/M on the loss hop's reply: the driver sums M per-stage
+        # weight-gradient contributions per step, so scaling the
+        # per-microbatch CE-mean cotangent here makes that sum exactly
+        # the batch-mean gradient — one apply per step, sequential
+        # parity. M=1 skips the multiply so the lag=0 chain is
+        # BIT-identical to chained sequential steps, not just equal.
+        inv_m = 1.0 / float(M)
+
+        if self._mesh is not None:
+            batch = self._batch_sharding
+            params_sh = self._params_sharding
+            repl = self._layout.replicated()
+
+            def _jit(fn, in_sh, out_sh):
+                return jax.jit(fn, in_shardings=in_sh, out_shardings=out_sh)
+        else:
+            batch = params_sh = repl = None
+
+            def _jit(fn, in_sh, out_sh):
+                return jax.jit(fn)
+
+        def fwd_fn(params, x):
+            return stage.apply(params, x)
+
+        self._fwd = _jit(fwd_fn, (params_sh, batch), batch)
+
+        if self.is_last:
+            def loss_reply_fn(params, x, labels):
+                def fwd(x):
+                    return cross_entropy(stage.apply(params, x), labels)
+                loss, g_x = jax.value_and_grad(fwd)(x)
+                if M > 1:
+                    g_x = g_x * inv_m
+                return g_x, loss
+
+            self._loss_reply = _jit(
+                loss_reply_fn, (params_sh, batch, batch), (batch, repl))
+
+            def deferred_apply_fn(state: TrainState, fwd_params, xs, ys):
+                g_sum = None
+                for x, y in zip(xs, ys):
+                    def loss_fn(p, x=x, y=y):
+                        ce = cross_entropy(stage.apply(p, x), y)
+                        return ce * inv_m if M > 1 else ce
+                    gp = jax.grad(loss_fn)(fwd_params)
+                    g_sum = gp if g_sum is None else jax.tree_util.tree_map(
+                        jnp.add, g_sum, gp)
+                return apply_grads(tx, state, g_sum)
+        else:
+            def bwd_reply_fn(params, x, g_out):
+                _, vjp = jax.vjp(lambda x: stage.apply(params, x), x)
+                (g_x,) = vjp(g_out)
+                return g_x
+
+            self._bwd_reply = _jit(
+                bwd_reply_fn, (params_sh, batch, batch), batch)
+
+            def deferred_apply_fn(state: TrainState, fwd_params, xs, gs):
+                g_sum = None
+                for x, g in zip(xs, gs):
+                    _, vjp = jax.vjp(
+                        lambda p: stage.apply(p, x), fwd_params)
+                    (gp,) = vjp(g)
+                    g_sum = gp if g_sum is None else jax.tree_util.tree_map(
+                        jnp.add, g_sum, gp)
+                return apply_grads(tx, state, g_sum)
+
+        # tuples of M same-shaped microbatch arrays ride in as pytrees,
+        # so the deferred program's signature is stable for a fixed M —
+        # one compile, zero steady-state recompiles. No donation: with
+        # lag > 0 queued entries still hold the params snapshot.
+        self._deferred_apply_fn = jax.jit(deferred_apply_fn)
+
+    # ------------------------------------------------------------------ #
+    def _to_dev(self, x: Any) -> jax.Array:
+        if self._mesh is not None:
+            return jax.device_put(np.asarray(x), self._batch_sharding)
+        return jnp.asarray(x)
+
+    def _check_seq(self, op: str, seq: int, client_id: int) -> None:
+        last = max(self._last_seq.get((client_id, op), -1),
+                   self._seq_floor)
+        if self.strict_steps and seq <= last:
+            raise ProtocolError(
+                f"non-monotonic hop seq {seq} for {op} from client "
+                f"{client_id} at stage {self.stage_index} (last seen "
+                f"{last}); duplicate outside the replay window — "
+                "refusing to desync")
+
+    def _rec_for(self, client_id: int, step: int) -> Dict[str, Any]:
+        """The step's residual record, pinning the params snapshot on
+        first touch (all M microbatches of a step MUST run on the same
+        weights — GPipe semantics, and what makes the deferred vjp the
+        gradient of the forward the driver saw)."""
+        key = (int(client_id), int(step))
+        rec = self._recs.get(key)
+        if rec is None:
+            with self._lock:  # reentrant: hop ops already hold it
+                rec = {"params": self.state.params, "xs": {}, "gs": {},
+                       "ys": {}}
+            self._recs[key] = rec
+            while len(self._recs) > MAX_PENDING_STEPS:
+                self._recs.popitem(last=False)
+        return rec
+
+    def _maybe_queue_apply(self, rec: Dict[str, Any], key_done: str,
+                           client_id: int, step: int) -> None:
+        """When the step's last microbatch residual lands, queue ONE
+        deferred weight update holding the M stacked residuals and
+        drain the over-lag tail (still under the lock — the drain only
+        dispatches, SLT001-clean)."""
+        done = rec[key_done]
+        if len(done) != self.microbatches:
+            return
+        mbs = range(self.microbatches)
+        entry = {
+            "kind": "stage", "step": int(step),
+            "client_id": int(client_id),
+            "fwd_params": rec["params"],
+            "xs": tuple(rec["xs"][m] for m in mbs),
+            "cts": tuple(done[m] for m in mbs),
+        }
+        self._recs.pop((int(client_id), int(step)), None)
+        self._deferred.push(entry)
+        self._deferred.drain_over_lag()
+
+    def _apply_deferred_entry(self, entry: Dict[str, Any]) -> None:
+        tr = obs_trace.get_tracer()
+        t0 = time.perf_counter() if tr is not None else 0.0
+        xs, cts = entry["xs"], entry["cts"]
+        with obs_dispatch.step_scope(
+                self._dd, (self._ddtok, f"stage{self.stage_index}_apply"),
+                sig_fn=lambda: tuple((x.shape, str(x.dtype))
+                                     for x in xs + cts)):
+            self.state = self._deferred_apply_fn(
+                self.state, entry["fwd_params"], xs, cts)
+        if tr is not None:
+            dw = time.perf_counter() - t0
+            tr.record(spans.DEFERRED_APPLY, t0, dw,
+                      trace_id=obs_trace.CTX.trace_id, party=self.party,
+                      tid=entry["client_id"], step=entry["step"])
+        fl = obs_flight.get_recorder()
+        if fl is not None:
+            fl.record(spans.FL_DEFER_APPLY, step=entry["step"],
+                      client_id=entry["client_id"], party=self.party,
+                      kind=entry["kind"])
+
+    # -- the three hop ops --------------------------------------------- #
+    def hop_forward(self, x: np.ndarray, step: int, mb: int = 0,
+                    client_id: int = 0) -> np.ndarray:
+        """Forward one microbatch through this stage; the (params, x)
+        residual is pinned for the step's backward. On the last stage
+        this is a residual-free plain forward (the loss hop is the
+        stateful one) — the chain's predict path."""
+        seq = hop_seq(step, mb)
+        entry = None
+        if self.replay is not None:
+            entry, owner = self.replay.begin(client_id, "hop_fwd", seq)
+            if not owner:
+                return self.replay.wait(entry)
+        admitted = False
+        try:
+            if self._admission is not None:
+                self._admission.admit(client_id)
+                admitted = True
+            with self._lock:
+                self._check_seq("hop_fwd", seq, client_id)
+                x_dev = self._to_dev(x)
+                if not self.is_last:
+                    rec = self._rec_for(client_id, step)
+                    params = rec["params"]
+                else:
+                    params = self.state.params
+                with obs_dispatch.step_scope(
+                        self._dd,
+                        (self._ddtok, f"stage{self.stage_index}_fwd"),
+                        sig_fn=lambda: (np.shape(x), str(x_dev.dtype))):
+                    y = self._fwd(params, x_dev)
+                if not self.is_last:
+                    rec["xs"][int(mb)] = x_dev
+                self._last_seq[(client_id, "hop_fwd")] = seq
+                self._hops["hop_fwd"] += 1
+            y_host = np.asarray(y)  # off the lock: overlap discipline
+            if entry is not None:
+                self.replay.resolve(entry, y_host)
+            if admitted:
+                admitted = False
+                self._admission.complete(client_id)
+            fl = obs_flight.get_recorder()
+            if fl is not None:
+                fl.record(spans.FL_STAGE_REPLY, step=int(step),
+                          client_id=int(client_id), party=self.party,
+                          op="hop_fwd", stage=self.stage_index,
+                          mb=int(mb))
+            return y_host
+        except BaseException as exc:
+            # pair the admit before releasing the claim; fail() is the
+            # last replay-visible act on the path (SLT002)
+            if admitted:
+                self._admission.complete(client_id)
+            if entry is not None:
+                self.replay.fail(entry, exc)
+            raise
+
+    def hop_backward(self, g_out: np.ndarray, step: int, mb: int = 0,
+                     client_id: int = 0) -> np.ndarray:
+        """2BP reply: return ``d(loss)/d(x)`` for one microbatch
+        immediately from the pinned residual; queue the step's weight
+        update once its last cotangent lands."""
+        if self.is_last:
+            raise ProtocolError(
+                f"hop_backward on the last stage {self.stage_index}; "
+                "the loss hop already returned its cotangent",
+                status=400)
+        seq = hop_seq(step, mb)
+        entry = None
+        if self.replay is not None:
+            entry, owner = self.replay.begin(client_id, "hop_bwd", seq)
+            if not owner:
+                return self.replay.wait(entry)
+        tr = obs_trace.get_tracer()
+        try:
+            with self._lock:
+                t0 = time.perf_counter() if tr is not None else 0.0
+                self._check_seq("hop_bwd", seq, client_id)
+                rec = self._recs.get((int(client_id), int(step)))
+                if rec is None or int(mb) not in rec["xs"]:
+                    raise ProtocolError(
+                        f"unknown pipeline residual for step {step} "
+                        f"mb {mb} at stage {self.stage_index} (evicted "
+                        "or never forwarded)")
+                g_dev = self._to_dev(g_out)
+                x_dev = rec["xs"][int(mb)]
+                with obs_dispatch.step_scope(
+                        self._dd,
+                        (self._ddtok, f"stage{self.stage_index}_bwd"),
+                        sig_fn=lambda: (np.shape(g_out),
+                                        str(g_dev.dtype))):
+                    g_in = self._bwd_reply(rec["params"], x_dev, g_dev)
+                rec["gs"][int(mb)] = g_dev
+                self._maybe_queue_apply(rec, "gs", client_id, step)
+                self._last_seq[(client_id, "hop_bwd")] = seq
+                self._hops["hop_bwd"] += 1
+            g_host = np.asarray(g_in)  # off the lock
+            if tr is not None:
+                rw = time.perf_counter() - t0
+                tr.record(spans.REPLY_GRAD, t0, rw,
+                          trace_id=obs_trace.CTX.trace_id,
+                          party=self.party, tid=client_id, step=step)
+            if entry is not None:
+                self.replay.resolve(entry, g_host)
+            fl = obs_flight.get_recorder()
+            if fl is not None:
+                fl.record(spans.FL_STAGE_REPLY, step=int(step),
+                          client_id=int(client_id), party=self.party,
+                          op="hop_bwd", stage=self.stage_index,
+                          mb=int(mb))
+            return g_host
+        except BaseException as exc:
+            if entry is not None:
+                self.replay.fail(entry, exc)
+            raise
+
+    def hop_loss(self, x: np.ndarray, labels: np.ndarray, step: int,
+                 mb: int = 0,
+                 client_id: int = 0) -> Tuple[np.ndarray, float]:
+        """Last stage's fused hop: forward + per-microbatch CE; the
+        (1/M-scaled) cut cotangent and the microbatch loss reply
+        immediately, the weight update defers."""
+        if not self.is_last:
+            raise ProtocolError(
+                f"hop_loss on non-last stage {self.stage_index}; only "
+                f"stage {self.plan.num_stages - 1} owns the loss",
+                status=400)
+        seq = hop_seq(step, mb)
+        entry = None
+        if self.replay is not None:
+            entry, owner = self.replay.begin(client_id, "hop_loss", seq)
+            if not owner:
+                return self.replay.wait(entry)
+        tr = obs_trace.get_tracer()
+        admitted = False
+        try:
+            if self._admission is not None:
+                self._admission.admit(client_id)
+                admitted = True
+            with self._lock:
+                t0 = time.perf_counter() if tr is not None else 0.0
+                self._check_seq("hop_loss", seq, client_id)
+                rec = self._rec_for(client_id, step)
+                x_dev = self._to_dev(x)
+                y_dev = self._to_dev(labels)
+                with obs_dispatch.step_scope(
+                        self._dd,
+                        (self._ddtok, f"stage{self.stage_index}_loss"),
+                        sig_fn=lambda: (np.shape(x), str(x_dev.dtype),
+                                        np.shape(labels),
+                                        str(y_dev.dtype))):
+                    g_x, loss = self._loss_reply(rec["params"], x_dev,
+                                                 y_dev)
+                rec["xs"][int(mb)] = x_dev
+                rec["ys"][int(mb)] = y_dev
+                self._maybe_queue_apply(rec, "ys", client_id, step)
+                self._last_seq[(client_id, "hop_loss")] = seq
+                self._hops["hop_loss"] += 1
+            g_host = np.asarray(g_x)  # off the lock
+            loss_f = float(loss)
+            if tr is not None:
+                rw = time.perf_counter() - t0
+                tr.record(spans.REPLY_GRAD, t0, rw,
+                          trace_id=obs_trace.CTX.trace_id,
+                          party=self.party, tid=client_id, step=step)
+            res = (g_host, loss_f)
+            if entry is not None:
+                self.replay.resolve(entry, res)
+            if admitted:
+                admitted = False
+                self._admission.complete(client_id)
+            fl = obs_flight.get_recorder()
+            if fl is not None:
+                fl.record(spans.FL_STAGE_REPLY, step=int(step),
+                          client_id=int(client_id), party=self.party,
+                          op="hop_loss", stage=self.stage_index,
+                          mb=int(mb))
+            return res
+        except BaseException as exc:
+            if admitted:
+                self._admission.complete(client_id)
+            if entry is not None:
+                self.replay.fail(entry, exc)
+            raise
+
+    def predict(self, x: np.ndarray, client_id: int = 0) -> np.ndarray:
+        """Forward-only, no residual, no handshake — but behind the
+        flush barrier: a read of the stage's params must see every
+        update whose reply already shipped."""
+        with self._lock:
+            self._deferred.flush()
+            y = self._fwd(self.state.params, self._to_dev(x))
+        return np.asarray(y)
+
+    # -- barriers / durability (the ServerRuntime surface) -------------- #
+    def flush_deferred(self) -> int:
+        return self._deferred.flush()
+
+    def export_state(self) -> TrainState:
+        with self._lock:
+            self._deferred.flush()
+            return self.state
+
+    def export_runtime_extras(self, step: int) -> Dict[str, Any]:
+        """Checksummed sidecar: replay cache (post-restart duplicates
+        served bit-identically) under the same lock-held flush as the
+        state snapshot (SLT112 flush-before-save)."""
+        from split_learning_tpu.runtime import checkpoint as _ckpt
+        with self._lock:
+            self._deferred.flush()
+            self._ckpt_lineage += 1
+            payload = _ckpt.build_extras(
+                step, self._ckpt_lineage,
+                replay=(self.replay.export_state()
+                        if self.replay is not None else None))
+        fl = obs_flight.get_recorder()
+        if fl is not None:
+            fl.record(spans.FL_CKPT_CAPTURE, step=int(step),
+                      party=self.party, lineage=payload["lineage"])
+        return payload
+
+    def resume_from(self, state: TrainState, step: int,
+                    extras: Optional[Dict[str, Any]] = None) -> None:
+        """Adopt a restored TrainState; next hop must be step >= `step`.
+        Pending deferred applies are DROPPED (pre-restore lineage), the
+        replay cache restores from a valid matching sidecar or clears."""
+        from split_learning_tpu.runtime import checkpoint as _ckpt
+        use_extras = (extras is not None and _ckpt.extras_valid(extras)
+                      and extras["step"] == int(step))
+        with self._lock:
+            self._deferred.clear()
+            if self._mesh is not None:
+                state = jax.device_put(state, self._state_sharding)
+            self.state = state
+            self._recs.clear()
+            self._last_seq = {}
+            self._seq_floor = int(step) * MB_STRIDE - 1
+            if self.replay is not None:
+                if use_extras and "replay" in extras:
+                    self.replay.restore_state(
+                        _ckpt.decode_obj(extras["replay"]))
+                else:
+                    self.replay.clear()
+            if use_extras:
+                self._ckpt_lineage = max(self._ckpt_lineage,
+                                         int(extras["lineage"]))
+        fl = obs_flight.get_recorder()
+        if fl is not None:
+            fl.record(spans.FL_CKPT_LINEAGE, step=int(step),
+                      party=self.party, use_extras=use_extras,
+                      lineage=self._ckpt_lineage)
+
+    # ------------------------------------------------------------------ #
+    def counters(self) -> Dict[str, Any]:
+        with self._lock:
+            out: Dict[str, Any] = dict(self._hops)
+            out["pending_steps"] = len(self._recs)
+        out.update(self._deferred.counters())
+        if self.replay is not None:
+            out.update(self.replay.counters())
+        return out
+
+    def health(self) -> Dict[str, Any]:
+        return {
+            "status": "ok",
+            "role": "stage",
+            "stage_index": self.stage_index,
+            "stage_name": self.plan.stages[self.stage_index].name,
+            "is_last": self.is_last,
+            "microbatches": self.microbatches,
+            "apply_lag": self.apply_lag,
+            "uptime_s": time.monotonic() - self._t_start,
+            "counters": self.counters(),
+        }
+
+    # -- wire-server replay hooks (transport/http.py) ------------------- #
+    def replay_lookup(self, client_id: int, op: str,
+                      seq: int) -> Tuple[Optional[bytes], Optional[Any]]:
+        """Cached reply for a duplicate hop delivery, keyed by the
+        composite ``hop_seq(step, mb)`` ordinal (the wire server passes
+        the composite, never the bare step)."""
+        if self.replay is None:
+            return None, None
+        return self.replay.lookup(client_id, op, seq)
+
+    def attach_reply_body(self, client_id: int, op: str, seq: int,
+                          body: bytes) -> None:
+        """Pin the encoded wire reply so a replay ships the original
+        frame byte-for-byte."""
+        if self.replay is not None:
+            self.replay.attach_body(client_id, op, seq, body)
+
+    def close(self) -> None:
+        """Drain, never drop: replies for queued steps already shipped,
+        so a clean shutdown must land their updates (SLT108)."""
+        fl = obs_flight.get_recorder()
+        if fl is not None:
+            fl.record(spans.FL_CLOSE, party=self.party)
+        self._deferred.flush()

@@ -182,6 +182,32 @@ class Transport(abc.ABC):
                    client_id: int = 0) -> np.ndarray:
         """Hop 2: d(loss)/d(features) -> d(loss)/d(activations)."""
 
+    # -- K-stage MPMD pipeline hops (PR 14): per-microbatch exchanges ----
+    # Non-abstract like predict: only transports with a StageRuntime
+    # peer (runtime/stage.py) serve them; the 2-party transports keep
+    # their exact legacy surface.
+    def hop_forward(self, x: np.ndarray, step: int, mb: int = 0,
+                    client_id: int = 0) -> np.ndarray:
+        """One microbatch forward through the peer stage: acts in,
+        next cut's acts out. Keyed (step, mb) for exactly-once."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not serve pipeline hops")
+
+    def hop_backward(self, g_out: np.ndarray, step: int, mb: int = 0,
+                     client_id: int = 0) -> np.ndarray:
+        """One microbatch cotangent through the peer stage (2BP reply:
+        d(loss)/d(x) back immediately, weight update deferred)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not serve pipeline hops")
+
+    def hop_loss(self, x: np.ndarray, labels: np.ndarray, step: int,
+                 mb: int = 0,
+                 client_id: int = 0) -> Tuple[np.ndarray, float]:
+        """The LAST stage's fused hop: acts + labels in, (scaled cut
+        cotangent, microbatch loss) out."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not serve pipeline hops")
+
     # -- split-party inference: one forward-only round trip --------------
     def predict(self, activations: np.ndarray,
                 client_id: int = 0) -> np.ndarray:

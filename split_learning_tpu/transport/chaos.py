@@ -66,8 +66,12 @@ DEFAULT_RATE = 0.05
 DEFAULT_DELAY_MS = 50.0
 # ops that carry a step handshake — chaos targets the step exchange;
 # predict/aggregate/health pass through untouched (a faulted FedAvg
-# round would block its whole cohort, which is a different experiment)
-CHAOS_OPS = ("/forward_pass", "/u_forward", "/u_backward")
+# round would block its whole cohort, which is a different experiment).
+# The pipeline hop ops (PR 14) are keyed by the composite
+# ``step * MB_STRIDE + mb`` ordinal, so chaos composes PER HOP: each
+# (stage wire, microbatch, direction) draws its own fault schedule.
+CHAOS_OPS = ("/forward_pass", "/u_forward", "/u_backward",
+             "/hop_forward", "/hop_backward", "/hop_loss")
 
 
 def parse_chaos_spec(spec: str) -> "OrderedDict[str, Tuple[float, float]]":
@@ -221,6 +225,28 @@ class ChaosTransport(Transport):
         return self._do(
             "/u_backward", step,
             lambda: self.inner.u_backward(feat_grads, step, client_id))
+
+    # pipeline hops (PR 14): the schedule keys on the composite
+    # (step, microbatch) ordinal — the replay key — so a dup/drop of
+    # one microbatch's hop never aliases another's draw, and the
+    # bounded-faults guarantee holds per hop
+    def hop_forward(self, x, step, mb=0, client_id=0):
+        from split_learning_tpu.runtime.stage import hop_seq
+        return self._do(
+            "/hop_forward", hop_seq(step, mb),
+            lambda: self.inner.hop_forward(x, step, mb, client_id))
+
+    def hop_backward(self, g_out, step, mb=0, client_id=0):
+        from split_learning_tpu.runtime.stage import hop_seq
+        return self._do(
+            "/hop_backward", hop_seq(step, mb),
+            lambda: self.inner.hop_backward(g_out, step, mb, client_id))
+
+    def hop_loss(self, x, labels, step, mb=0, client_id=0):
+        from split_learning_tpu.runtime.stage import hop_seq
+        return self._do(
+            "/hop_loss", hop_seq(step, mb),
+            lambda: self.inner.hop_loss(x, labels, step, mb, client_id))
 
     def predict(self, activations, client_id=0):
         return self.inner.predict(activations, client_id)

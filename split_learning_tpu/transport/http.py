@@ -44,7 +44,15 @@ CRC_HEADER = "X-SLT-CRC32"
 _TRACED_PATHS = ("/forward_pass", "/u_forward", "/u_backward")
 # wire path -> ServerRuntime replay-cache op (runtime/replay.py)
 _OP_BY_PATH = {"/forward_pass": "split_step", "/u_forward": "u_forward",
-               "/u_backward": "u_backward"}
+               "/u_backward": "u_backward", "/hop_forward": "hop_fwd",
+               "/hop_backward": "hop_bwd", "/hop_loss": "hop_loss"}
+# MPMD pipeline hops (PR 14): served by a StageRuntime behind the same
+# handler. Every per-step keyed mechanism (chaos schedule, replay
+# lookup, attach_reply_body) uses the composite hop_seq(step, mb)
+# ordinal for these paths. Hops always travel lossless — the cut
+# tensors cross two wires per step and compression residual/EF ledgers
+# are per-(client, op); composing them across a chain is future work.
+_HOP_PATHS = ("/hop_forward", "/hop_backward", "/hop_loss")
 
 
 class SplitHTTPServer:
@@ -177,17 +185,29 @@ class SplitHTTPServer:
                     tree = codec.decode(raw)
                     req = codec.decompress_tree(tree)
                     cid = int(req.get("client_id", 0))
+                    # the key every per-(client, step) mechanism below
+                    # uses: the bare step, except hops where it is the
+                    # composite (step, microbatch) ordinal — one replay
+                    # entry and one chaos schedule PER HOP
+                    key_seq = None
+                    if "step" in req:
+                        key_seq = int(req["step"])
+                        if self.path in _HOP_PATHS:
+                            from split_learning_tpu.runtime.stage import (
+                                hop_seq)
+                            key_seq = hop_seq(key_seq,
+                                              int(req.get("mb", 0)))
                     # server-side chaos: one seeded draw per delivery
                     # attempt of a step op. Pre-apply kinds act here;
                     # drop_resp/corrupt ride to _send_200 so they fire
                     # AFTER the runtime has applied the update.
                     fault = None
                     if (outer.chaos is not None and self.path in CHAOS_OPS
-                            and "step" in req):
+                            and key_seq is not None):
                         attempt = outer._chaos_attempts.next(
-                            (cid, self.path, int(req["step"])))
+                            (cid, self.path, key_seq))
                         fault = outer.chaos.draw(self.path,
-                                                 int(req["step"]), attempt)
+                                                 key_seq, attempt)
                     fl = obs_flight.get_recorder()
                     if fl is not None:
                         # CTX adoption happens below; pass the client's
@@ -237,6 +257,8 @@ class SplitHTTPServer:
                     mode = req.get("compress") or outer.default_compress
                     density = float(req.get("density",
                                             outer.default_density))
+                    if self.path in _HOP_PATHS:
+                        mode = "none"  # hops travel lossless (above)
                     if mode == "topk8":
                         # per-(client, op) error feedback on the reply
                         # direction — handler threads serving a coalesced
@@ -262,11 +284,10 @@ class SplitHTTPServer:
                     # reply its original apply produced, never
                     # re-dispatched into the runtime
                     op = _OP_BY_PATH.get(self.path)
-                    if (op is not None and "step" in req
+                    if (op is not None and key_seq is not None
                             and hasattr(outer.runtime, "replay_lookup")):
-                        step_i = int(req["step"])
                         cached_body, cached = outer.runtime.replay_lookup(
-                            cid, op, step_i)
+                            cid, op, key_seq)
                         if cached_body is not None:
                             # the original frame, byte-for-byte: same
                             # payload, same CRC, EF ledger untouched
@@ -288,11 +309,23 @@ class SplitHTTPServer:
                                         "step": req["step"]}
                             elif op == "u_forward":
                                 resp = {"features": pack(cached)}
+                            elif op == "hop_fwd":
+                                resp = {"y": cached, "step": req["step"],
+                                        "mb": req.get("mb", 0)}
+                            elif op == "hop_loss":
+                                resp = {"grads": cached[0],
+                                        "loss": cached[1],
+                                        "step": req["step"],
+                                        "mb": req.get("mb", 0)}
+                            elif op == "hop_bwd":
+                                resp = {"grads": cached,
+                                        "step": req["step"],
+                                        "mb": req.get("mb", 0)}
                             else:
                                 resp = {"grads": pack(cached)}
                             body = codec.encode(resp)
                             outer.runtime.attach_reply_body(
-                                cid, op, step_i, body)
+                                cid, op, key_seq, body)
                             self._send_200(body, fault)
                             return
                     if self.path == "/forward_pass":
@@ -309,6 +342,25 @@ class SplitHTTPServer:
                         g = outer.runtime.u_backward(
                             req["feat_grads"], int(req["step"]), cid)
                         resp = {"grads": pack(g)}
+                    elif self.path == "/hop_forward":
+                        y = outer.runtime.hop_forward(
+                            req["x"], int(req["step"]),
+                            int(req.get("mb", 0)), cid)
+                        resp = {"y": y, "step": req["step"],
+                                "mb": req.get("mb", 0)}
+                    elif self.path == "/hop_backward":
+                        g = outer.runtime.hop_backward(
+                            req["g"], int(req["step"]),
+                            int(req.get("mb", 0)), cid)
+                        resp = {"grads": g, "step": req["step"],
+                                "mb": req.get("mb", 0)}
+                    elif self.path == "/hop_loss":
+                        g, loss = outer.runtime.hop_loss(
+                            req["x"], req["labels"], int(req["step"]),
+                            int(req.get("mb", 0)), cid)
+                        resp = {"grads": g, "loss": loss,
+                                "step": req["step"],
+                                "mb": req.get("mb", 0)}
                     elif self.path == "/predict":
                         out = outer.runtime.predict(req["activations"], cid)
                         resp = {"outputs": pack(out)}
@@ -333,13 +385,13 @@ class SplitHTTPServer:
                         outer.runtime.note_wire_compression(
                             in_raw + out_raw, in_wire + out_wire)
                     body = codec.encode(resp)
-                    if (op is not None and "step" in req and hasattr(
+                    if (op is not None and key_seq is not None and hasattr(
                             outer.runtime, "attach_reply_body")):
                         # pin the exact frame to the replay entry BEFORE
                         # sending: even a reply lost in flight leaves
                         # the retry a bit-identical copy to collect
                         outer.runtime.attach_reply_body(
-                            cid, op, int(req["step"]), body)
+                            cid, op, key_seq, body)
                     self._send_200(body, fault)
                 except Backpressure as exc:
                     # admission refused the step: the canonical wire form
@@ -584,6 +636,70 @@ class HttpTransport(Transport):
             except Exception:
                 self._rollback("u_grads")
                 raise
+
+    # -- MPMD pipeline hops (PR 14): peer serves a StageRuntime --------- #
+    def _hop_flight(self, send: bool, op: str, step: int, mb: int,
+                    client_id: int) -> None:
+        fl = obs_flight.get_recorder()
+        if fl is None:
+            return
+        kw = dict(step=int(step), client_id=int(client_id),
+                  party="client", op=op, mb=int(mb), stage=-1)
+        if send:
+            fl.record(spans.FL_HOP_SEND, **kw)
+        else:
+            fl.record(spans.FL_HOP_RECV, **kw)
+
+    def _check_hop_echo(self, path: str, out: Dict[str, Any], step: int,
+                        mb: int) -> None:
+        # hops multiplex M in-flight exchanges per step over one
+        # session: the echoed (step, mb) is the only routing check
+        if int(out.get("step", step)) != int(step) or int(
+                out.get("mb", mb)) != int(mb):
+            raise TransportError(
+                f"{path} reply (step={out.get('step')}, "
+                f"mb={out.get('mb')}) does not echo request "
+                f"(step={step}, mb={mb})")
+
+    def hop_forward(self, x: np.ndarray, step: int, mb: int = 0,
+                    client_id: int = 0) -> np.ndarray:
+        self._hop_flight(True, "hop_fwd", step, mb,
+                         client_id)
+        with timed(self.stats):
+            out = self._post("/hop_forward", {
+                "x": np.asarray(x), "step": step, "mb": int(mb),
+                "client_id": client_id})
+        self._check_hop_echo("/hop_forward", out, step, mb)
+        self._hop_flight(False, "hop_fwd", step, mb,
+                         client_id)
+        return out["y"]
+
+    def hop_backward(self, g_out: np.ndarray, step: int, mb: int = 0,
+                     client_id: int = 0) -> np.ndarray:
+        self._hop_flight(True, "hop_bwd", step, mb,
+                         client_id)
+        with timed(self.stats):
+            out = self._post("/hop_backward", {
+                "g": np.asarray(g_out), "step": step, "mb": int(mb),
+                "client_id": client_id})
+        self._check_hop_echo("/hop_backward", out, step, mb)
+        self._hop_flight(False, "hop_bwd", step, mb,
+                         client_id)
+        return out["grads"]
+
+    def hop_loss(self, x: np.ndarray, labels: np.ndarray, step: int,
+                 mb: int = 0,
+                 client_id: int = 0) -> Tuple[np.ndarray, float]:
+        self._hop_flight(True, "hop_loss", step, mb,
+                         client_id)
+        with timed(self.stats):
+            out = self._post("/hop_loss", {
+                "x": np.asarray(x), "labels": np.asarray(labels),
+                "step": step, "mb": int(mb), "client_id": client_id})
+        self._check_hop_echo("/hop_loss", out, step, mb)
+        self._hop_flight(False, "hop_loss", step, mb,
+                         client_id)
+        return out["grads"], float(out["loss"])
 
     def predict(self, activations: np.ndarray,
                 client_id: int = 0) -> np.ndarray:

@@ -248,6 +248,61 @@ class LocalTransport(Transport):
                 self._roundtrip(np.asarray(feat_grads)), step, client_id)
             return self._roundtrip(g)
 
+    # -- MPMD pipeline hops (PR 14): peer is a StageRuntime ------------- #
+    def _hop_flight(self, send: bool, op: str, step: int, mb: int,
+                    client_id: int) -> None:
+        fl = obs_flight.get_recorder()
+        if fl is None:
+            return
+        kw = dict(step=int(step), client_id=int(client_id),
+                  party="client", op=op, mb=int(mb),
+                  stage=getattr(self.server, "stage_index", -1))
+        if send:
+            fl.record(spans.FL_HOP_SEND, **kw)
+        else:
+            fl.record(spans.FL_HOP_RECV, **kw)
+
+    def hop_forward(self, x: np.ndarray, step: int, mb: int = 0,
+                    client_id: int = 0) -> np.ndarray:
+        self._hop_flight(True, "hop_fwd", step, mb,
+                         client_id)
+        with timed(self.stats):
+            y = self._call(self.server.hop_forward,
+                           self._roundtrip(np.asarray(x)), step, mb,
+                           client_id)
+            res = self._roundtrip(y)
+        self._hop_flight(False, "hop_fwd", step, mb,
+                         client_id)
+        return res
+
+    def hop_backward(self, g_out: np.ndarray, step: int, mb: int = 0,
+                     client_id: int = 0) -> np.ndarray:
+        self._hop_flight(True, "hop_bwd", step, mb,
+                         client_id)
+        with timed(self.stats):
+            g = self._call(self.server.hop_backward,
+                           self._roundtrip(np.asarray(g_out)), step, mb,
+                           client_id)
+            res = self._roundtrip(g)
+        self._hop_flight(False, "hop_bwd", step, mb,
+                         client_id)
+        return res
+
+    def hop_loss(self, x: np.ndarray, labels: np.ndarray, step: int,
+                 mb: int = 0,
+                 client_id: int = 0) -> Tuple[np.ndarray, float]:
+        self._hop_flight(True, "hop_loss", step, mb,
+                         client_id)
+        with timed(self.stats):
+            g, loss = self._call(self.server.hop_loss,
+                                 self._roundtrip(np.asarray(x)),
+                                 self._roundtrip(np.asarray(labels)),
+                                 step, mb, client_id)
+            res = self._roundtrip(g), float(loss)
+        self._hop_flight(False, "hop_loss", step, mb,
+                         client_id)
+        return res
+
     def aggregate(self, params: Any, epoch: int, loss: float, step: int,
                   num_examples: int | None = None) -> Any:
         with timed(self.stats):

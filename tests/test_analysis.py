@@ -1148,6 +1148,7 @@ def test_trace_report_fallback_matches_registry():
     assert fallback["REPLY_GRAD"] == spans.REPLY_GRAD
     assert fallback["DEFERRED_APPLY"] == spans.DEFERRED_APPLY
     assert fallback["MESH_META"] == spans.MESH_META
+    assert fallback["STAGE_META"] == spans.STAGE_META
 
 
 def test_postmortem_fallback_matches_registry():
