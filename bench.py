@@ -1002,6 +1002,197 @@ def measure_fleet_soak(quick: bool) -> dict:
     return out
 
 
+def measure_replica_failover(quick: bool) -> dict:
+    """Horizontal replication under a mid-run chaos kill
+    (runtime/replica.py): the same seeded bursty fleet is offered to
+    two 3-replica twin groups — one untouched, one whose busiest
+    replica is breaker-killed halfway through — and the leg gates that
+    the sticky router's exactly-once handoff keeps the killed twin
+    whole: every scheduled step completes, zero dropped, the handoff
+    counters actually engaged (death, migration, reroutes), zero
+    steady-state recompiles, and the killed twin's mean loss within an
+    ABSOLUTE nats bound of the clean twin (same rationale as
+    fleet_soak: both converge low, a ratio would flap). A serial
+    bit-identity pin rides along: ``maybe_replicate(n=1)`` must be the
+    plain runtime, loss-for-loss."""
+    import jax
+    import numpy as np
+
+    from split_learning_tpu.models import get_plan
+    from split_learning_tpu.obs import dispatch_debug
+    from split_learning_tpu.runtime.fleet import (
+        FleetConfig, run_fleet, warm_fleet)
+    from split_learning_tpu.runtime.replica import maybe_replicate
+    from split_learning_tpu.runtime.server import ServerRuntime
+    from split_learning_tpu.transport.local import LocalTransport
+    from split_learning_tpu.utils import Config
+
+    n_clients = 24 if quick else 96
+    steps_pc = 2
+    batch = 8
+    # sub-critical bursty load (the fleet_soak regime): policy, not
+    # saturation, sets the tail — and the kill lands mid-queue, not
+    # mid-collapse
+    rate_hz = 0.05 if quick else 0.008
+    n_replicas = 3
+    expected = n_clients * steps_pc
+    kill_at = expected // 2
+    plan = get_plan(mode="split")
+    cfg = Config(mode="split", batch_size=batch, num_clients=1 << 20)
+    sample = np.zeros((batch, 28, 28, 1), np.float32)
+    dd = dispatch_debug.tracker()
+
+    def make_replica(_idx: int) -> ServerRuntime:
+        # shared init (same plan/cfg/key): the group is statistically
+        # one model
+        return ServerRuntime(plan, cfg, jax.random.PRNGKey(0), sample,
+                             strict_steps=True, coalesce_max=4,
+                             coalesce_window_ms=50.0,
+                             batching="continuous")
+
+    def group_compiles(group) -> int:
+        # sum over ALL replicas: the group's own health() sums live
+        # ones only, so a kill would make the delta go negative
+        total = 0
+        for r in group.replicas:
+            try:
+                total += r.health().get("coalescing", {}).get(
+                    "compile_count", 0)
+            except Exception:
+                pass
+        return total
+
+    def run(kill: bool) -> dict:
+        fcfg = FleetConfig(n_clients=n_clients, tenants=1,
+                           steps_per_client=steps_pc, arrival="burst",
+                           rate_hz=rate_hz, burst_size=2, seed=1,
+                           workers=16, batch=batch,
+                           kill_replica_at=(kill_at if kill else 0))
+        dispatch_debug.force(True)
+        try:
+            group = maybe_replicate(make_replica, n_replicas)
+
+            def factory(cid):
+                return LocalTransport(group)
+            try:
+                warm_rounds = warm_fleet(group, factory, fcfg)
+                c0 = group_compiles(group)
+                g0 = dd.gauges()
+                res = run_fleet(fcfg, factory, group=group)
+                g1 = dd.gauges()
+                c1 = group_compiles(group)
+                counters = group.counters()
+                live = group.live_replicas()
+            finally:
+                group.close()
+        finally:
+            dispatch_debug.force(False)
+        return {
+            "killed": kill, "warm_rounds": warm_rounds,
+            "wall_s": res.wall_s,
+            "steps_completed": int(res.counters["fleet_steps_total"]),
+            "dropped_steps": int(res.counters["fleet_dropped_steps"]),
+            "kills": int(res.counters.get("fleet_replica_kills", 0)),
+            "mean_loss": res.mean_loss,
+            "compiles_in_run": c1 - c0,
+            "steady_state_recompiles": (g1["steady_state_recompiles"]
+                                        - g0["steady_state_recompiles"]),
+            "live_replicas": live,
+            "replica_handoffs": int(counters["replica_handoffs"]),
+            "replica_deaths": int(counters["replica_deaths"]),
+            "replica_reroutes": int(counters["replica_reroutes"]),
+            "handoff_replay_entries": int(
+                counters["handoff_replay_entries"]),
+            "overall": res.overall,
+        }
+
+    # serial bit-identity pin: --replicas 1 IS the plain runtime. The
+    # fleet's concurrent apply order is timing-dependent, so the pin
+    # runs serially where loss equality is exact, not approximate.
+    plain = make_replica(0)
+    solo = maybe_replicate(make_replica, 1)
+    rs = np.random.RandomState(7)
+    solo_match = True
+    try:
+        for step in range(1, 4):
+            acts = rs.randn(batch, 26, 26, 32).astype(np.float32)
+            labels = rs.randint(0, 10, (batch,)).astype(np.int64)
+            _, lp = plain.split_step(acts, labels, step, 0)
+            _, ls = solo.split_step(acts, labels, step, 0)
+            if lp != ls:
+                solo_match = False
+    finally:
+        plain.close()
+        solo.close()
+
+    clean = run(kill=False)
+    killed = run(kill=True)
+    loss_parity = abs(killed["mean_loss"] - clean["mean_loss"])
+    out = {
+        "leg": "replica_failover", "platform": "cpu+local-loopback",
+        "host_cores": os.cpu_count(),
+        "clients": n_clients, "steps_per_client": steps_pc,
+        "per_client_batch": batch, "replicas": n_replicas,
+        "kill_replica_at": kill_at,
+        "arrival": "burst", "rate_hz": rate_hz, "burst_size": 2,
+        "note": ("twin 3-replica groups over one seeded arrival "
+                 "schedule; the killed twin loses its busiest replica "
+                 "mid-run and must finish whole through the "
+                 "exactly-once handoff"),
+        "clean": clean, "killed": killed,
+        "loss_parity": loss_parity,
+        "replicas_one_bit_identical": solo_match,
+        "valid": True, "invalid_reason": None,
+    }
+    problems = []
+    for rec in (clean, killed):
+        tag = "killed" if rec["killed"] else "clean"
+        if rec["steps_completed"] != expected:
+            problems.append(f"{tag}: steps_completed="
+                            f"{rec['steps_completed']} != {expected}")
+        if rec["dropped_steps"] != 0:
+            problems.append(
+                f"{tag}: dropped_steps={rec['dropped_steps']} != 0")
+        if rec["steady_state_recompiles"] != 0:
+            problems.append(
+                f"{tag}: steady_state_recompiles="
+                f"{rec['steady_state_recompiles']} != 0")
+    if clean["replica_deaths"] != 0 or clean["kills"] != 0:
+        problems.append("clean twin saw a death/kill it should not have")
+    if killed["kills"] != 1 or killed["replica_deaths"] != 1 or \
+            killed["replica_handoffs"] != 1:
+        problems.append(
+            f"killed twin handoff counters off: kills={killed['kills']} "
+            f"deaths={killed['replica_deaths']} "
+            f"handoffs={killed['replica_handoffs']} (want 1/1/1)")
+    if killed["handoff_replay_entries"] == 0:
+        problems.append("handoff migrated 0 replay entries: the "
+                        "exactly-once merge went untested")
+    if killed["replica_reroutes"] == 0:
+        problems.append("0 reroutes after the kill: the victim owned "
+                        "no clients, the failover went untested")
+    if len(killed["live_replicas"]) != n_replicas - 1:
+        problems.append(f"killed twin ended with live replicas "
+                        f"{killed['live_replicas']}")
+    if not solo_match:
+        problems.append("maybe_replicate(n=1) diverged from the plain "
+                        "runtime: the zero-overhead-off pin broke")
+    # the killed twin's migrated clients finish their remaining steps
+    # on successors whose params drifted from the victim's (replicas
+    # train independently between syncs), so the trajectories differ
+    # by migration noise — ~0.1 nats at this scale with a third of the
+    # fleet rerouted after one step. The absolute bound is sized to
+    # catch corruption-scale divergence (a double-apply or lost merge
+    # shows up as whole nats), not to forbid the migration itself.
+    if loss_parity > 0.25:
+        problems.append(f"loss_parity={loss_parity:.4f} > 0.25 nats: "
+                        "the killed twin diverged from its clean twin")
+    if problems:
+        out["valid"] = False
+        out["invalid_reason"] = "; ".join(problems)
+    return out
+
+
 def measure_pipelined(quick: bool) -> dict:
     """The PiPar-style in-flight window (runtime/pipelined_client.py) vs
     the reference's lock-step loop, both over HTTP loopback: steady-state
@@ -2609,7 +2800,8 @@ def main() -> None:
     ap.add_argument("--role",
                     choices=["baseline", "fused", "dp", "wire", "topk8",
                              "pipelined", "coalesced", "reply_latency_2bp",
-                             "chaos_soak", "fleet_soak", "decode",
+                             "chaos_soak", "fleet_soak",
+                             "replica_failover", "decode",
                              "flash_micro", "sharded_server",
                              "mpmd_pipeline"],
                     default=None)
@@ -2626,6 +2818,7 @@ def main() -> None:
               "reply_latency_2bp": measure_reply_latency_2bp,
               "chaos_soak": measure_chaos_soak,
               "fleet_soak": measure_fleet_soak,
+              "replica_failover": measure_replica_failover,
               "decode": measure_decode,
               "flash_micro": measure_flash_micro,
               "sharded_server": measure_sharded_server,
@@ -2824,6 +3017,13 @@ def main() -> None:
                                 timeout=900)
         if fleet is not None:
             detail["fleet_soak"] = fleet
+        # horizontal replication: twin 3-replica groups, one losing its
+        # busiest replica mid-run — exactly-once handoff, zero dropped,
+        # loss parity vs the unkilled twin
+        repl = _run_subprocess("replica_failover", args.quick, CPU_ENV,
+                               timeout=900)
+        if repl is not None:
+            detail["replica_failover"] = repl
         # sharded server (pjit over the virtual host mesh): mesh-aware
         # coalesced dispatch; batch-ceiling-relative throughput gate,
         # mesh=1 bit-identity, zero steady-state recompiles

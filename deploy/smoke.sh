@@ -119,3 +119,34 @@ $K -n "$NS_APP" logs job/split-client --tail=10
 $K -n "$NS_APP" logs job/split-client | grep -q "loss" || {
   echo "[smoke] FAIL: no loss lines in client output" >&2; exit 1; }
 echo "[smoke] OK: in-cluster split training ran end-to-end"
+
+# --- replica-kill smoke ----------------------------------------------------
+# The replicated variant: 3 server pods behind a ClientIP-affinity
+# Service, each pod an in-process 2-replica failover group. Kill one
+# pod mid-run; the client must still complete (affinity re-pins it to a
+# survivor, the strict-step handshake re-arms there).
+echo "[smoke] replica-kill: waiting for split-server-replicated"
+$K -n "$NS_APP" rollout status deploy/split-server-replicated --timeout=600s
+$K -n "$NS_APP" delete pod replica-client --ignore-not-found
+$K -n "$NS_APP" run replica-client --image "$IMG" --restart=Never \
+  --image-pull-policy=IfNotPresent \
+  --env LEARNING_MODE=split --env SLT_DATASET=synthetic \
+  --env SLT_TRACKING=jsonl -- \
+  python -m split_learning_tpu.launch.run train \
+  --transport http --server-url http://split-server-replicated:8000 \
+  --dataset synthetic --steps 30 --batch-size 8
+sleep 15
+VICTIM=$($K -n "$NS_APP" get pods -l app=split-server-replicated \
+  -o jsonpath='{.items[0].metadata.name}')
+echo "[smoke] replica-kill: deleting server pod $VICTIM mid-run"
+$K -n "$NS_APP" delete pod "$VICTIM" --wait=false
+$K -n "$NS_APP" wait --for=jsonpath='{.status.phase}'=Succeeded \
+    pod/replica-client --timeout=600s || {
+  echo "[smoke] replica-kill FAIL: client did not complete; logs:" >&2
+  $K -n "$NS_APP" logs replica-client --tail=50 >&2 || true
+  exit 1
+}
+$K -n "$NS_APP" logs replica-client | grep -q "loss" || {
+  echo "[smoke] replica-kill FAIL: no loss lines" >&2; exit 1; }
+echo "[smoke] OK: client survived a server-pod kill on the" \
+     "replicated topology"

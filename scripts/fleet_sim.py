@@ -21,6 +21,10 @@ was dropped) and by hand for regime exploration:
 
     # chaos-composed twin of the same run
     python scripts/fleet_sim.py --clients 64 --tenants 4 --chaos
+
+    # 3 replicas, kill the busiest one after 20 completed steps
+    python scripts/fleet_sim.py --clients 64 --replicas 3 \
+        --kill-replica-at 20 --gate-dropped-steps
 """
 
 from __future__ import annotations
@@ -40,8 +44,10 @@ import numpy as np  # noqa: E402
 
 from split_learning_tpu.models import get_plan  # noqa: E402
 from split_learning_tpu.obs import dispatch_debug  # noqa: E402
+from split_learning_tpu.obs.metrics import histogram_percentile  # noqa: E402
 from split_learning_tpu.runtime.fleet import (  # noqa: E402
     FleetConfig, run_fleet, warm_fleet)
+from split_learning_tpu.runtime.replica import maybe_replicate  # noqa: E402
 from split_learning_tpu.runtime.server import ServerRuntime  # noqa: E402
 from split_learning_tpu.transport.chaos import (  # noqa: E402
     ChaosPolicy, ChaosTransport)
@@ -49,20 +55,28 @@ from split_learning_tpu.transport.local import LocalTransport  # noqa: E402
 from split_learning_tpu.utils import Config  # noqa: E402
 
 
-def build_server(args: argparse.Namespace) -> ServerRuntime:
+def build_server(args: argparse.Namespace):
     cfg = Config(mode="split", batch_size=args.batch,
                  num_clients=args.num_client_slots)
     plan = get_plan(mode="split")
     sample = np.zeros((args.batch, 28, 28, 1), np.float32)
-    return ServerRuntime(
-        plan, cfg, jax.random.PRNGKey(args.seed), sample,
-        strict_steps=True,
-        coalesce_max=args.coalesce_max,
-        coalesce_window_ms=args.window_ms,
-        batching=args.batching,
-        tenants=args.tenants,
-        quota=args.quota,
-        slo_ms=args.slo_ms)
+    key = jax.random.PRNGKey(args.seed)
+
+    def make_replica(_idx: int) -> ServerRuntime:
+        # every replica shares the init (same plan/cfg/key) so the
+        # group is statistically one model
+        return ServerRuntime(
+            plan, cfg, key, sample,
+            strict_steps=True,
+            coalesce_max=args.coalesce_max,
+            coalesce_window_ms=args.window_ms,
+            batching=args.batching,
+            tenants=args.tenants,
+            quota=args.quota,
+            slo_ms=args.slo_ms)
+
+    # --replicas 1 returns the bare runtime (zero-overhead-off)
+    return maybe_replicate(make_replica, args.replicas, seed=args.seed)
 
 
 def make_factory(server: ServerRuntime, args: argparse.Namespace):
@@ -76,6 +90,102 @@ def make_factory(server: ServerRuntime, args: argparse.Namespace):
                              seed=args.chaos_seed * 1_000_003 + cid)
         return ChaosTransport(LocalTransport(server), policy)
     return factory
+
+
+def compile_count(server, group):
+    """Group-wide compile counter over ALL replicas — the group's
+    health() sums only live ones, so a chaos-kill mid-run would make
+    ``compiles_in_run`` go negative as the victim's compiles leave
+    the sum."""
+    if group is None:
+        return server.health().get("coalescing", {}).get(
+            "compile_count", 0)
+    total = 0
+    for r in group.replicas:
+        try:
+            total += r.health().get("coalescing", {}).get(
+                "compile_count", 0)
+        except Exception:
+            pass
+    return total
+
+
+def replay_counters(server, group):
+    """Replay-cache integrity counters; group runs sum over every
+    replica (the dead one's counters stay readable after close)."""
+    if group is None:
+        return (server.replay.counters()
+                if server.replay is not None else None)
+    total: dict = {}
+    for r in group.replicas:
+        try:
+            sub = r.replay.counters() if r.replay is not None else None
+        except Exception:
+            sub = None
+        for k, v in (sub or {}).items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                total[k] = total.get(k, 0) + v
+    return total or None
+
+
+def _hist_ms(snap, name):
+    """p50/p99 of a group-registry histogram, seconds -> ms; null arm
+    when the histogram never fired (no reroute happened)."""
+    hist = snap.get("histograms", {}).get(name)
+    if not hist or not hist.get("count"):
+        return {"p50_ms": None, "p99_ms": None}
+    return {"p50_ms": round(histogram_percentile(hist, 50) * 1e3, 3),
+            "p99_ms": round(histogram_percentile(hist, 99) * 1e3, 3)}
+
+
+def replication_summary(args, group, res):
+    """The ``replication`` block: router/handoff counters, re-route
+    latency tails, and per-replica admission/replay detail. Schema is
+    stable across arms — a ``--replicas 1`` run reports the same keys
+    with zeroed counters, null latencies and an empty per-replica list,
+    so twin-run diffing and the bench contract never branch on shape."""
+    handoff_keys = ("replica_routes", "replica_reroutes",
+                    "replica_deaths", "replica_handoffs",
+                    "handoff_replay_entries", "handoff_ef_entries",
+                    "handoff_deferred_flushed", "replica_syncs",
+                    "replica_fenced_waits")
+    block = {
+        "replicas": args.replicas,
+        "kill_replica_at": args.kill_replica_at,
+        "kills": int(res.counters.get("fleet_replica_kills", 0)),
+        "live_replicas": [0],
+        "handoff": {k: 0 for k in handoff_keys},
+        "reroute_wait": {"p50_ms": None, "p99_ms": None},
+        "handoff_latency": {"p50_ms": None, "p99_ms": None},
+        "per_replica": [],
+    }
+    if group is None:
+        return block
+    counters = group.counters()
+    block["live_replicas"] = group.live_replicas()
+    block["handoff"] = {k: int(counters.get(k, 0)) for k in handoff_keys}
+    snap = group.registry.snapshot()
+    block["reroute_wait"] = _hist_ms(snap, "replica_reroute_wait")
+    block["handoff_latency"] = _hist_ms(snap, "replica_handoff_latency")
+    live = set(block["live_replicas"])
+    assigned: dict = {}
+    for cid in range(args.clients):
+        rid = group.assignment(cid)
+        assigned[rid] = assigned.get(rid, 0) + 1
+    for i, r in enumerate(group.replicas):
+        row = {"replica": i, "alive": i in live,
+               "assigned_clients": assigned.get(i, 0)}
+        try:
+            row["replay"] = (r.replay.counters()
+                             if r.replay is not None else None)
+        except Exception:
+            row["replay"] = None
+        try:
+            row["admission"] = r.health().get("admission")
+        except Exception:
+            row["admission"] = None
+        block["per_replica"].append(row)
+    return block
 
 
 def main() -> int:
@@ -109,31 +219,44 @@ def main() -> int:
     ap.add_argument("--chaos-spec", default="drop_resp=0.05,dup=0.02",
                     help="ChaosPolicy spec for --chaos")
     ap.add_argument("--chaos-seed", type=int, default=7)
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="horizontal server replicas behind the sticky "
+                         "router (1 = plain ServerRuntime)")
+    ap.add_argument("--kill-replica-at", type=int, default=0,
+                    help="chaos-kill the busiest replica once the fleet "
+                         "has completed this many steps (0 = never; "
+                         "needs --replicas > 1)")
     ap.add_argument("--gate-dropped-steps", action="store_true",
                     help="exit 1 unless dropped_steps == 0 and every "
                          "scheduled step completed")
     args = ap.parse_args()
+    if args.kill_replica_at > 0 and args.replicas < 2:
+        print("[fleet_sim] --kill-replica-at needs --replicas > 1",
+              file=sys.stderr)
+        return 2
 
     server = build_server(args)
+    group = server if args.replicas > 1 else None
     factory = make_factory(server, args)
     fcfg = FleetConfig(
         n_clients=args.clients, tenants=args.tenants,
         steps_per_client=args.steps, arrival=args.arrival,
         rate_hz=args.rate, burst_size=args.burst_size,
-        seed=args.seed, workers=args.workers, batch=args.batch)
+        seed=args.seed, workers=args.workers, batch=args.batch,
+        kill_replica_at=args.kill_replica_at)
 
     dispatch_debug.force(True)
     try:
         warm_rounds = 0
         if not args.no_warm:
             warm_rounds = warm_fleet(server, factory, fcfg)
-        coalescing = server.health().get("coalescing", {})
-        compiles_before = coalescing.get("compile_count", 0)
-        res = run_fleet(fcfg, factory)
+        compiles_before = compile_count(server, group)
+        res = run_fleet(fcfg, factory, group=group)
         health = server.health()
         coalescing = health.get("coalescing", {})
-        replay = (server.replay.counters()
-                  if server.replay is not None else None)
+        compiles_after = compile_count(server, group)
+        replay = replay_counters(server, group)
+        replication = replication_summary(args, group, res)
     finally:
         dispatch_debug.force(False)
         server.close()
@@ -176,6 +299,8 @@ def main() -> int:
             "window_ms": args.window_ms, "quota": args.quota,
             "slo_ms": args.slo_ms, "seed": args.seed,
             "chaos": bool(args.chaos),
+            "replicas": args.replicas,
+            "kill_replica_at": args.kill_replica_at,
         },
         "warm_rounds": warm_rounds,
         "wall_s": round(res.wall_s, 3),
@@ -186,8 +311,7 @@ def main() -> int:
             res.counters.get("fleet_backpressure_total", 0)),
         "retries_total": int(res.counters.get("fleet_retries_total", 0)),
         "mean_loss": None if completed == 0 else round(res.mean_loss, 6),
-        "compiles_in_run": (coalescing.get("compile_count", 0)
-                            - compiles_before),
+        "compiles_in_run": compiles_after - compiles_before,
         "overall": {k: round(v, 3) for k, v in res.overall.items()},
         "per_tenant": {
             str(t): {k: (round(v, 3) if isinstance(v, float) else v)
@@ -196,6 +320,7 @@ def main() -> int:
         "admission": adm,
         "utilization": utilization,
         "replay": replay,
+        "replication": replication,
     }
     print(json.dumps(summary, indent=1))
 

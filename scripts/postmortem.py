@@ -38,7 +38,13 @@ Output, in ``scripts/trace_report.py`` section style:
       workers are FIFO and the stage rejects non-monotonic hop
       sequence numbers, so a merged 3-dump journal where mb goes
       backwards means a duplicate was materialized twice or a relay
-      reordered the stream.
+      reordered the stream;
+    - ``step_applied_on_two_replicas``: on a replicated run, two
+      ``fl_claim_resolve`` events for the same (client, op, step) with
+      no intervening ``fl_claim_fail`` — merging per-replica dumps
+      into one failover timeline, a key materialized twice means the
+      handoff rerouted the client without migrating its replay entry,
+      so the successor re-ran a step the dead replica already applied.
 
 Run:    python scripts/postmortem.py client.json server.json
 Also:   --json (machine-readable), --step N (timeline for one step),
@@ -61,8 +67,9 @@ try:
     from split_learning_tpu.obs.spans import (
         FL_ADMIT, FL_CHAOS, FL_CLAIM_BEGIN, FL_CLAIM_FAIL,
         FL_CLAIM_RESOLVE, FL_CLAIM_WAIT, FL_CLOSE, FL_DEFER_APPLY,
-        FL_FATAL, FL_HOP_RECV, FL_HOP_SEND, FL_REPLAY_HIT, FL_REPLY,
-        FL_STAGE_REPLY, FL_WATCHDOG_TRIP)
+        FL_FATAL, FL_HANDOFF_BEGIN, FL_HANDOFF_COMMIT, FL_HOP_RECV,
+        FL_HOP_SEND, FL_REPLAY_HIT, FL_REPLICA_DEATH, FL_REPLY,
+        FL_ROUTE, FL_STAGE_REPLY, FL_WATCHDOG_TRIP)
 except ImportError:
     FL_ADMIT = "fl_admit"
     FL_CLAIM_BEGIN = "fl_claim_begin"
@@ -79,6 +86,10 @@ except ImportError:
     FL_HOP_SEND = "fl_hop_send"
     FL_HOP_RECV = "fl_hop_recv"
     FL_STAGE_REPLY = "fl_stage_reply"
+    FL_ROUTE = "fl_route"
+    FL_REPLICA_DEATH = "fl_replica_death"
+    FL_HANDOFF_BEGIN = "fl_handoff_begin"
+    FL_HANDOFF_COMMIT = "fl_handoff_commit"
 
 Key = Tuple[int, Optional[str], int]  # (client_id, op, step)
 
@@ -145,6 +156,14 @@ def detect_anomalies(events: List[Dict[str, Any]],
     # stays armed even when a ring overflowed.
     hop_high: Dict[Tuple[str, str, int, Optional[str], int],
                    Tuple[int, int]] = {}
+    # replicated runs: who materialized each key — attributed by the
+    # event's ``replica`` field when the router journaled one, else by
+    # source-dump index (per-replica dumps merged into one timeline).
+    # A SECOND resolve for a live key is presence-based evidence (both
+    # events are in the journal), so the check stays armed under
+    # truncation; an intervening fl_claim_fail releases the key (a
+    # legitimate retry re-owns it).
+    materialized: Dict[Key, Any] = {}
     admission_armed = any(e.get("name") == FL_ADMIT for e in events)
     for i, ev in enumerate(events):
         name = ev.get("name")
@@ -155,6 +174,24 @@ def detect_anomalies(events: List[Dict[str, Any]],
             k = _key(ev)
             resolved.setdefault(k, i)
             owned.pop(k, None)
+            if name == FL_CLAIM_FAIL:
+                materialized.pop(k, None)
+            else:
+                where = fields.get("replica", ev.get("src"))
+                prior = materialized.get(k)
+                if prior is not None and prior != where:
+                    anomalies.append({
+                        "kind": "step_applied_on_two_replicas",
+                        "client_id": k[0], "op": k[1], "step": k[2],
+                        "message": (
+                            f"client {k[0]} op {k[1]!r} step {k[2]} "
+                            f"resolved on replica/dump {prior} AND "
+                            f"again on {where} with no fl_claim_fail "
+                            "between — the failover handoff rerouted "
+                            "the client without migrating its replay "
+                            "entry, so the step double-applied"),
+                    })
+                materialized.setdefault(k, where)
         elif name in (FL_CLAIM_WAIT, FL_REPLAY_HIT):
             k = _key(ev)
             if not truncated and k not in resolved:

@@ -472,6 +472,54 @@ def replay_recovery_bit_identical(run: Any) -> None:
                 f"bit-identical")
 
 
+def handoff_exactly_once(run: Any) -> None:
+    """Failover-handoff discipline (PR 15): with a replica dying at any
+    point of the claim lifecycle, every (client, op, step) is applied
+    exactly once GROUP-WIDE — the dead replica's migrated replay entries
+    must make its clients' successors serve duplicates from cache, never
+    re-run them — and every duplicate's wait returns a value some
+    replica actually resolved (one materialized reply per key, wherever
+    the client was routed).
+
+    Notes read: ``begin(key, owner, replica)``, ``apply(key,
+    replica)``, ``resolve(key, value, replica)``, ``wait_return(key,
+    value, replica)``."""
+    applies: Dict[Any, List[Any]] = {}
+    for f in _notes(run, "apply"):
+        applies.setdefault(f["key"], []).append(f.get("replica"))
+    resolved: Dict[Any, List[Any]] = {}
+    for f in _notes(run, "resolve"):
+        resolved.setdefault(f["key"], []).append(f.get("value"))
+    for key, replicas in applies.items():
+        if len(replicas) > 1:
+            where = sorted(set(r for r in replicas if r is not None))
+            if len(where) > 1:
+                raise Violation(
+                    "handoff_exactly_once", run.schedule_id,
+                    f"step {key} applied on replicas {where} — the "
+                    f"handoff rerouted the client but its claim did not "
+                    f"migrate, so the step re-ran on the successor")
+            raise Violation(
+                "handoff_exactly_once", run.schedule_id,
+                f"step {key} applied {len(replicas)} times on one "
+                f"replica")
+    for key in {f["key"] for f in _notes(run, "begin")}:
+        n = len(applies.get(key, []))
+        if n != 1:
+            raise Violation(
+                "handoff_exactly_once", run.schedule_id,
+                f"step {key} applied {n} times group-wide (want exactly "
+                f"1 across the death and the re-route)")
+    for f in _notes(run, "wait_return"):
+        vals = resolved.get(f["key"], [])
+        if f.get("value") not in vals:
+            raise Violation(
+                "handoff_exactly_once", run.schedule_id,
+                f"duplicate of {f['key']} was served {f.get('value')!r} "
+                f"on replica {f.get('replica')}, which no replica ever "
+                f"resolved — not the one materialized reply")
+
+
 def flush_before_save(run: Any) -> None:
     """Checkpoint capture happens only after the deferred-apply queue
     drained: a snapshot taken with updates still queued persists params
@@ -502,6 +550,7 @@ INVARIANTS: Dict[str, Callable[[Any], None]] = {
     "checkpoint_atomicity": checkpoint_atomicity,
     "replay_recovery_bit_identical": replay_recovery_bit_identical,
     "flush_before_save": flush_before_save,
+    "handoff_exactly_once": handoff_exactly_once,
 }
 
 # --check findings flow through slt-lint's waiver/exit-code machinery;
@@ -522,6 +571,7 @@ RULE_OF_INVARIANT: Dict[str, str] = {
     "replay_recovery_bit_identical": "SLT111",
     "flush_before_save": "SLT112",
     "pipeline_hops_exactly_once": "SLT113",
+    "handoff_exactly_once": "SLT114",
 }
 
 

@@ -611,6 +611,102 @@ def pipeline_hop_chain(ctx: Ctx) -> Dict[str, Any]:
 
 
 # --------------------------------------------------------------------- #
+# replica failover handoff: kill across the claim lifecycle (PR 15)
+# --------------------------------------------------------------------- #
+
+@scenario("replica_death_handoff",
+          invariants=("handoff_exactly_once", "exactly_once_claims"),
+          budget=300, bound=2, requires="jax")
+def replica_death_handoff(ctx: Ctx) -> Dict[str, Any]:
+    """A 2-replica group under a mid-run chaos kill: clients deliver
+    (and re-deliver) steps through the REAL ReplicaGroup router —
+    sticky rendezvous routing, the handoff fence, quiesce, extras
+    capture, replay migration — while the victim dies at every explored
+    schedule point across the claim lifecycle: before the claim, inside
+    the claim window, after resolve, during the duplicate's retransmit,
+    and after the re-route. Exactly-once must hold group-wide: the
+    migrated entries make the successor serve the duplicate the
+    original materialized reply instead of re-running the step."""
+    from split_learning_tpu.runtime.replay import ReplayCache
+    from split_learning_tpu.runtime.replica import ReplicaGroup
+
+    class _StubReplica:
+        """The claim lifecycle of ServerRuntime.split_step, minus jax:
+        a real ReplayCache decides ownership, only the owner 'runs the
+        program' (notes apply), duplicates block on the entry — the
+        surface _fail_over captures and migrates is the real one."""
+
+        def __init__(self, idx: int) -> None:
+            self.idx = idx
+            self.replay = ReplayCache(window=8)
+            self._steps = 0
+
+        def health(self) -> Dict[str, Any]:
+            return {"step": self._steps, "status": "serving"}
+
+        def split_step(self, acts: Any, labels: Any, step: int,
+                       client_id: int = 0) -> Any:
+            key = (client_id, "split_step", step)
+            entry, owner = self.replay.begin(client_id, "split_step",
+                                             step)
+            ctx.note("begin", key=key, owner=owner, replica=self.idx)
+            if not owner:
+                value = self.replay.wait(entry, timeout=30.0)
+                ctx.note("wait_return", key=key, value=value,
+                         replica=self.idx)
+                return value
+            ctx.step("claim")  # the kill can land inside the window
+            self._steps += 1
+            ctx.note("apply", key=key, replica=self.idx)
+            value = ("reply", client_id, step, self.idx)
+            self.replay.resolve(entry, value)
+            ctx.note("resolve", key=key, value=value, replica=self.idx)
+            return value
+
+        def flush_deferred(self) -> int:
+            return 0
+
+        def export_runtime_extras(self, step: int) -> Dict[str, Any]:
+            from split_learning_tpu.runtime import checkpoint as _ckpt
+            return _ckpt.build_extras(
+                step, 1, replay=self.replay.export_state(), wire_ef=[])
+
+        def close(self) -> None:
+            pass
+
+    group = ReplicaGroup([_StubReplica(i) for i in range(2)])
+    victim = group.assignment(0)  # the replica client 0 lives on
+    # a bystander client on the OTHER replica: its route must survive
+    # the handoff unmoved (sticky routing is minimal-churn)
+    other = next(c for c in range(1, 8)
+                 if group.assignment(c) != victim)
+
+    def deliver(cid: int, step: int, tag: str) -> None:
+        if tag == "dup":
+            ctx.step("wire")  # the retransmit window
+        group.split_step(None, None, step, cid)
+
+    def killer() -> None:
+        ctx.step("kill")  # explored against every lifecycle point
+        group.kill(victim)
+
+    workers = [ctx.spawn(deliver, 0, 1, "orig", name="c0-orig"),
+               ctx.spawn(deliver, 0, 1, "dup", name="c0-dup"),
+               ctx.spawn(deliver, other, 1, "orig", name="c-other"),
+               ctx.spawn(killer, name="killer")]
+    for w in workers:
+        w.join()
+    counters = group.counters()
+    assert counters["replica_handoffs"] == 1, counters
+    assert group.live_replicas() == [1 - victim]
+    # stickiness: the bystander never moved off its surviving replica
+    assert group.assignment(other) == 1 - victim
+    return {"handoffs": int(counters["replica_handoffs"]),
+            "migrated": int(counters["handoff_replay_entries"]),
+            "fenced_waits": int(counters["replica_fenced_waits"])}
+
+
+# --------------------------------------------------------------------- #
 # crash–restart scenarios (slt-crash, SLT109–112)
 # --------------------------------------------------------------------- #
 

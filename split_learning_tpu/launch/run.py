@@ -844,15 +844,25 @@ def cmd_train(args) -> int:
         else:
             # in-process server: out-of-order arrival is part of the deal
             # for a depth-W window, so strictness follows the depth
-            server = ServerRuntime(plan, cfg, jax.random.PRNGKey(cfg.seed),
-                                   sample, strict_steps=depth <= 1,
-                                   overlap=not getattr(
-                                       args, "no_overlap", False),
-                                   decouple_bwd=getattr(
-                                       args, "decouple_bwd", False),
-                                   apply_lag=getattr(
-                                       args, "apply_lag", 0) or 0,
-                                   mesh=_server_mesh(args))
+            def _make_replica(_idx: int) -> ServerRuntime:
+                # every replica from the SAME PRNGKey: the group starts
+                # as one model, and FedAvg sync keeps it one
+                return ServerRuntime(plan, cfg,
+                                     jax.random.PRNGKey(cfg.seed),
+                                     sample, strict_steps=depth <= 1,
+                                     overlap=not getattr(
+                                         args, "no_overlap", False),
+                                     decouple_bwd=getattr(
+                                         args, "decouple_bwd", False),
+                                     apply_lag=getattr(
+                                         args, "apply_lag", 0) or 0,
+                                     mesh=_server_mesh(args))
+            from split_learning_tpu.runtime.replica import maybe_replicate
+            server = maybe_replicate(
+                _make_replica, getattr(args, "replicas", 1) or 1,
+                sync_every=getattr(args, "replica_sync_every", 0) or 0,
+                handoff=getattr(args, "handoff", "live") or "live",
+                seed=cfg.seed)
             # --compress plumbs here too (wire emulation through the real
             # codec) so compressed-path runs don't need sockets; None
             # keeps the legacy direct path bit-for-bit
@@ -1149,21 +1159,38 @@ def cmd_serve(args) -> int:
             print(f"[error] {e}", file=sys.stderr)
             return 2
     else:
+        n_replicas = getattr(args, "replicas", 1) or 1
+        if n_replicas > 1 and cfg.checkpoint_dir:
+            # the group's checkpoint story is the handoff sidecar, not N
+            # interleaved Orbax trees in one directory — refuse the
+            # ambiguous layout instead of writing it
+            print("[error] --replicas > 1 does not compose with "
+                  "--checkpoint-dir yet (per-replica save/resume layout "
+                  "is ambiguous); drop one of them", file=sys.stderr)
+            return 2
         try:
-            runtime = ServerRuntime(
-                plan, cfg, jax.random.PRNGKey(cfg.seed),
-                sample,
-                strict_steps=not args.allow_out_of_order,
-                coalesce_max=args.coalesce_max,
-                coalesce_window_ms=args.coalesce_window_ms,
-                overlap=not args.no_overlap,
-                batching=args.batching,
-                tenants=args.tenants,
-                quota=args.quota,
-                slo_ms=args.slo_ms,
-                decouple_bwd=args.decouple_bwd,
-                apply_lag=args.apply_lag,
-                mesh=_server_mesh(args))
+            def _make_replica(_idx: int) -> ServerRuntime:
+                # same PRNGKey for every replica: one model, N servers
+                return ServerRuntime(
+                    plan, cfg, jax.random.PRNGKey(cfg.seed),
+                    sample,
+                    strict_steps=not args.allow_out_of_order,
+                    coalesce_max=args.coalesce_max,
+                    coalesce_window_ms=args.coalesce_window_ms,
+                    overlap=not args.no_overlap,
+                    batching=args.batching,
+                    tenants=args.tenants,
+                    quota=args.quota,
+                    slo_ms=args.slo_ms,
+                    decouple_bwd=args.decouple_bwd,
+                    apply_lag=args.apply_lag,
+                    mesh=_server_mesh(args))
+            from split_learning_tpu.runtime.replica import maybe_replicate
+            runtime = maybe_replicate(
+                _make_replica, n_replicas,
+                sync_every=getattr(args, "replica_sync_every", 0) or 0,
+                handoff=getattr(args, "handoff", "live") or "live",
+                seed=cfg.seed)
         except ValueError as e:  # e.g. --coalesce-max outside split mode
             print(f"[error] {e}", file=sys.stderr)
             return 2
@@ -1745,6 +1772,22 @@ def main(argv: Optional[list] = None) -> int:
     pt.add_argument("--chaos-seed", dest="chaos_seed", type=int, default=0,
                     help="seed for the --chaos schedule (same spec + "
                          "seed = the same faults at the same steps)")
+    pt.add_argument("--replicas", dest="replicas", type=int, default=1,
+                    help="local transport only: run N same-init server "
+                         "replicas behind the sticky failover router "
+                         "(runtime/replica.py); 1 = no router, the plain "
+                         "in-process server, bit-identical")
+    pt.add_argument("--replica-sync-every", dest="replica_sync_every",
+                    type=int, default=0,
+                    help="FedAvg the replicas' server tops every K group "
+                         "steps (0 = never; with one client only its own "
+                         "replica trains, so sync propagates the updates)")
+    pt.add_argument("--handoff", dest="handoff",
+                    choices=["live", "checkpoint"], default="live",
+                    help="how a dead replica's step state reaches its "
+                         "successors: live (in-memory extras payload) or "
+                         "checkpoint (round-trip through the durable "
+                         "sidecar on disk)")
     pt.add_argument("--failure-policy", dest="failure_policy",
                     choices=["raise", "retry", "skip"], default=None,
                     help="what a split client does when the wire fails: "
@@ -1874,6 +1917,21 @@ def main(argv: Optional[list] = None) -> int:
                          "lost-response case the replay cache recovers)")
     ps.add_argument("--chaos-seed", dest="chaos_seed", type=int, default=0,
                     help="seed for the --chaos schedule")
+    ps.add_argument("--replicas", dest="replicas", type=int, default=1,
+                    help="serve N same-init server replicas behind the "
+                         "sticky failover router on one HTTP port "
+                         "(runtime/replica.py); 1 = the plain runtime, "
+                         "no router on the step path. Does not compose "
+                         "with --checkpoint-dir yet")
+    ps.add_argument("--replica-sync-every", dest="replica_sync_every",
+                    type=int, default=0,
+                    help="FedAvg the replicas' server tops every K group "
+                         "steps (0 = never)")
+    ps.add_argument("--handoff", dest="handoff",
+                    choices=["live", "checkpoint"], default="live",
+                    help="failover handoff path: live (in-memory extras "
+                         "payload) or checkpoint (durable sidecar "
+                         "round-trip)")
     ps.add_argument("--trace", default=None, metavar="PATH",
                     help="per-step span tracing (obs/): serve live "
                          "queue-wait/dispatch histograms on GET /metrics "

@@ -129,6 +129,22 @@ FL_FATAL = "fl_fatal"                    # SIGTERM / fatal exception dump
 FL_HOP_SEND = "fl_hop_send"              # pipeline hop posted toward a stage
 FL_HOP_RECV = "fl_hop_recv"              # pipeline hop delivered/acknowledged
 FL_STAGE_REPLY = "fl_stage_reply"        # stage replied (cut grad / acts)
+# horizontal replication (PR 15): the router's sticky-routing and
+# failover-handoff lifecycle. Every event carries ``replica`` (the
+# replica index the event is about) so a merged multi-dump postmortem
+# can attribute applies per replica and detect a (client, op, step)
+# materialized on two replicas (anomaly ``step_applied_on_two_replicas``).
+FL_ROUTE = "fl_route"                    # client -> replica assignment made
+FL_REPLICA_DEATH = "fl_replica_death"    # replica declared dead (breaker open)
+FL_HANDOFF_BEGIN = "fl_handoff_begin"    # failover handoff started (quiesce)
+FL_HANDOFF_COMMIT = "fl_handoff_commit"  # state merged; clients rerouted
+
+# metrics-histogram-only names for the replica router (never trace
+# spans — both windows sit inside a client's ``transport`` span and
+# would double-cover it on a timeline): the client-visible stall while
+# a handoff fence commits, and the router-side quiesce->commit latency.
+REPLICA_REROUTE_WAIT = "replica_reroute_wait"
+REPLICA_HANDOFF_LATENCY = "replica_handoff_latency"
 
 FLIGHT_EVENTS = (
     FL_ADMIT, FL_REJECT, FL_CLAIM_BEGIN, FL_CLAIM_RESOLVE, FL_CLAIM_FAIL,
@@ -137,7 +153,8 @@ FLIGHT_EVENTS = (
     FL_BREAKER, FL_CHAOS, FL_CKPT_CAPTURE, FL_CKPT_COMMIT,
     FL_CKPT_LINEAGE, FL_GATHER, FL_SEND, FL_RECV, FL_CLOSE,
     FL_WATCHDOG_TRIP, FL_FATAL, FL_HOP_SEND, FL_HOP_RECV,
-    FL_STAGE_REPLY)
+    FL_STAGE_REPLY, FL_ROUTE, FL_REPLICA_DEATH, FL_HANDOFF_BEGIN,
+    FL_HANDOFF_COMMIT)
 
 # the client-level phases that tile a step — the denominator of the
 # compute-vs-wire fraction (encode/wire are sub-phases of transport and

@@ -16,6 +16,8 @@ from split_learning_tpu.runtime.evaluate import evaluate, evaluate_remote
 from split_learning_tpu.runtime.multi_client import MultiClientSplitRunner
 from split_learning_tpu.runtime.pipelined_client import PipelinedSplitClientTrainer
 from split_learning_tpu.runtime.replay import ReplayCache
+from split_learning_tpu.runtime.replica import (
+    ReplicaGroup, maybe_replicate, rendezvous_pick)
 from split_learning_tpu.runtime.server import (
     FedAvgAggregator,
     ProtocolError,
@@ -33,5 +35,6 @@ __all__ = [
     "PipelinedSplitClientTrainer", "greedy_generate", "sample_generate",
     "evaluate", "evaluate_remote", "generate_remote",
     "CircuitBreaker", "ReplayCache",
+    "ReplicaGroup", "maybe_replicate", "rendezvous_pick",
     "AdmissionController", "ContinuousBatcher", "RequestCoalescer",
 ]

@@ -82,6 +82,12 @@ class FleetConfig:
     max_retries: int = 6              # transient TransportError budget
     backpressure_budget_s: float = 30.0  # max cumulative 429 waiting/step
     trace: bool = True                # per-request server queue-wait
+    # replica chaos (PR 15): with a ReplicaGroup handed to the harness,
+    # kill one replica after N completed fleet steps (0 = never) — the
+    # failover happens mid-load, under the router's fence, while the
+    # rest of the fleet keeps arriving
+    kill_replica_at: int = 0
+    kill_replica: int = -1            # index; -1 = busiest by assignment
 
     def __post_init__(self) -> None:
         if self.arrival not in ("poisson", "burst", "diurnal"):
@@ -150,9 +156,15 @@ class FleetHarness:
     """
 
     def __init__(self, cfg: FleetConfig,
-                 make_transport: Callable[[int], Any]) -> None:
+                 make_transport: Callable[[int], Any],
+                 group: Any = None) -> None:
         self.cfg = cfg
         self._make_transport = make_transport
+        # the ReplicaGroup behind the transports, when the caller runs
+        # one — only needed for the kill_replica_at chaos hook
+        self._group = group
+        self._killed = False
+        self._steps_done = 0
         self.registry = Registry()
         rs = np.random.RandomState(cfg.seed)
         self._acts = rs.randn(cfg.batch, *CUT_SHAPE).astype(np.float32)
@@ -248,6 +260,33 @@ class FleetHarness:
         loss_f = float(loss)  # materialize outside the scheduler lock
         with self._cond:
             self._losses[(client_id, step)] = loss_f
+        if self._group is not None and cfg.kill_replica_at > 0:
+            self._maybe_kill_replica()
+
+    def _maybe_kill_replica(self) -> None:
+        """The chaos trigger: once the fleet has completed
+        ``kill_replica_at`` steps, kill one replica — on this worker
+        thread, holding no scheduler lock, so the handoff's quiesce can
+        drain the other workers' in-flight calls."""
+        with self._cond:
+            self._steps_done += 1
+            due = (not self._killed
+                   and self._steps_done >= self.cfg.kill_replica_at)
+            if due:
+                self._killed = True
+        if not due:
+            return
+        victim = self.cfg.kill_replica
+        if victim < 0:
+            # the busiest replica: the one most measured clients are
+            # assigned to — deterministic given the rendezvous routes
+            counts: Dict[int, int] = {}
+            for c in self._schedules:
+                r = self._group.assignment(c)
+                counts[r] = counts.get(r, 0) + 1
+            victim = max(sorted(counts), key=lambda r: counts[r])
+        self.registry.incr("fleet_replica_kills")
+        self._group.kill(victim)
 
     def _worker(self) -> None:
         transports: Dict[int, Any] = {}
@@ -332,9 +371,10 @@ class FleetHarness:
 
 
 def run_fleet(cfg: FleetConfig,
-              make_transport: Callable[[int], Any]) -> FleetResult:
+              make_transport: Callable[[int], Any],
+              group: Any = None) -> FleetResult:
     """One-call wrapper: build the harness, run it, return the result."""
-    return FleetHarness(cfg, make_transport).run()
+    return FleetHarness(cfg, make_transport, group=group).run()
 
 
 def _pow2(n: int) -> int:

@@ -1,0 +1,654 @@
+"""Horizontal server replication — a replica group behind a sticky router.
+
+Every guarantee the serve path accumulated (exactly-once replay claims,
+EF residual ledgers, deferred 2BP applies, checkpoint lineage) lives
+inside ONE server process — a single point of failure and a hard
+ceiling at the ROADMAP's millions-of-clients scale. This module turns
+that one hardened server into a fleet of them:
+
+- :class:`ReplicaGroup` owns N independent ``ServerRuntime`` replicas
+  and presents the SAME duck-typed server surface transports already
+  speak (split_step / u_forward / u_backward / predict / aggregate /
+  health / metrics / replay hooks), so ``LocalTransport`` fleets and
+  the HTTP wire route identically — the router seam is the server
+  object itself, not a new protocol.
+- **Sticky routing**: clients map to replicas by rendezvous (HRW)
+  hashing over the *routable* set — deterministic across processes
+  (blake2b, not the salted builtin ``hash``), minimal-churn on
+  membership change (only the dead replica's clients move), and sticky
+  by construction (a surviving replica's clients never reassign).
+- **Liveness**: each replica gets a PR-4 :class:`CircuitBreaker` over
+  its health probe. A replica is dead when its breaker is OPEN — the
+  router's verdict is the breaker's, not an ad-hoc flag, so the
+  failure-detection semantics (threshold of consecutive probe
+  failures) are exactly the ones clients already reason about.
+- **Failover handoff**: on death the router (1) fences the replica —
+  no new dispatches enter, and the dead replica's clients BLOCK on the
+  handoff instead of landing elsewhere early (the exactly-once fence);
+  (2) quiesces in-flight calls; (3) captures the replica's
+  externalized step state — the PR-12 extras sidecar payload: resolved
+  replay entries + attached wire bodies, the topk8 EF residual ledger,
+  with the deferred 2BP queue flushed first and the checkpoint lineage
+  stamped; in ``handoff="checkpoint"`` mode the payload additionally
+  round-trips through ``write_extras``/``read_latest_extras`` on disk,
+  so the durable path is what the successor actually reads; (4) merges
+  that state into each client's successor (replay via ``put`` +
+  ``attach_body`` — born resolved, never clobbering the successor's
+  own entries; EF via ``TopK8EF.merge_state``); (5) commits — reroutes
+  the clients and wakes the fenced waiters. A duplicate or in-flight
+  retry that lands post-handoff is served the original reply
+  bit-identically, and no (client, op, step) is ever applied twice
+  group-wide (slt-check ``replica_death_handoff``, SLT114).
+- **Statistical oneness**: replicas start from the same init (the
+  caller constructs them with the same rng) and ``sync_every`` steps a
+  FedAvg mean over the live replicas' server tops is installed back
+  (``runtime/state.py fedavg_mean`` — whose N=1 identity keeps a
+  single-replica group bit-identical).
+
+Zero-overhead-off: :func:`maybe_replicate` with ``n<=1`` returns the
+factory's bare ``ServerRuntime`` — no group, no router, no extra lock
+on the step path (tests/test_replica.py pins this).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from split_learning_tpu.obs import flight as obs_flight
+from split_learning_tpu.obs import locks as obs_locks
+from split_learning_tpu.obs import spans
+from split_learning_tpu.obs.metrics import Registry
+from split_learning_tpu.runtime.breaker import OPEN, CircuitBreaker
+from split_learning_tpu.transport.base import TransportError
+
+HANDOFF_MODES = ("live", "checkpoint")
+
+# how long a fenced client waits for a handoff to commit, and how long
+# the handoff waits for the dying replica's in-flight calls to drain
+_HANDOFF_TIMEOUT_S = float(os.environ.get("SLT_HANDOFF_TIMEOUT_S", "30"))
+
+
+def rendezvous_pick(client_id: int, replica_ids: Sequence[int]) -> int:
+    """Highest-random-weight (rendezvous) hash: the replica whose
+    blake2b((client, replica)) digest is largest. Deterministic across
+    processes and runs (the salted builtin ``hash`` is neither), and
+    removing a replica only moves THAT replica's clients — the property
+    that makes failover churn proportional to the failure."""
+    if not replica_ids:
+        raise ValueError("no live replicas to route to")
+    best: Optional[Tuple[int, int]] = None
+    for rid in replica_ids:
+        digest = hashlib.blake2b(
+            f"{int(client_id)}:{int(rid)}".encode(), digest_size=8).digest()
+        weight = int.from_bytes(digest, "big")
+        if best is None or (weight, -rid) > (best[0], -best[1]):
+            best = (weight, rid)
+    return best[1]
+
+
+class _ReplicaSlot:
+    """Router-side bookkeeping for one replica."""
+
+    __slots__ = ("idx", "runtime", "breaker", "alive", "routable",
+                 "inflight", "drained", "handoff_done")
+
+    def __init__(self, idx: int, runtime: Any) -> None:
+        self.idx = idx
+        self.runtime = runtime
+        self.breaker: Optional[CircuitBreaker] = None
+        # alive: accepting new dispatches. routable: still the
+        # rendezvous target for its clients — stays True through the
+        # handoff window so fenced clients wait instead of rerouting
+        # before the merged state is in place.
+        self.alive = True
+        self.routable = True
+        self.inflight = 0
+        # via obs.locks so slt-check can explore the fence/quiesce races
+        # and SLT_LOCK_DEBUG polices the waits
+        self.drained = obs_locks.make_event(f"ReplicaSlot[{idx}].drained")
+        self.drained.set()
+        self.handoff_done = obs_locks.make_event(
+            f"ReplicaSlot[{idx}].handoff_done")
+
+
+class ReplicaGroup:
+    """N ``ServerRuntime`` replicas behind a sticky, failover-aware
+    router. Duck-types the server surface, so it drops in anywhere a
+    ``ServerRuntime`` does (``LocalTransport(group)``,
+    ``SplitHTTPServer(group)``).
+
+    ``replicas`` must share an init (same plan/cfg/rng) for the group
+    to be statistically one model; ``sync_every`` > 0 installs a
+    FedAvg mean over the live replicas' params every that many
+    completed group steps. ``handoff`` picks how a dead replica's
+    externalized state reaches its successors: ``"live"`` hands the
+    captured extras payload over in memory; ``"checkpoint"`` commits
+    it through the durable sidecar path (tmp+fsync+rename under
+    ``ckpt_dir``) and restores from what disk actually holds."""
+
+    def __init__(self, replicas: Sequence[Any], sync_every: int = 0,
+                 handoff: str = "live",
+                 ckpt_dir: Optional[str] = None,
+                 failure_threshold: int = 3,
+                 seed: int = 0) -> None:
+        if not replicas:
+            raise ValueError("ReplicaGroup needs at least one replica")
+        if handoff not in HANDOFF_MODES:
+            raise ValueError(
+                f"handoff must be one of {HANDOFF_MODES} (got {handoff!r})")
+        self.replicas: List[Any] = list(replicas)
+        self.sync_every = int(sync_every)
+        self.handoff_mode = handoff
+        self._ckpt_dir = ckpt_dir
+        self._slots = [_ReplicaSlot(i, r)
+                       for i, r in enumerate(self.replicas)]
+        for slot in self._slots:
+            # the PR-4 breaker IS the liveness verdict; probes are free
+            # in-process so the backoff sleep is a no-op injectable
+            slot.breaker = CircuitBreaker(
+                self._make_probe(slot.idx),
+                failure_threshold=int(failure_threshold),
+                seed=seed * 1_000_003 + slot.idx,
+                sleep=lambda _s: None)
+        self._lock = obs_locks.make_lock("ReplicaGroup._lock")
+        self._route_cache: Dict[int, int] = {}
+        self.registry = Registry()
+        self._counters: Dict[str, float] = {
+            "replica_routes": 0.0, "replica_reroutes": 0.0,
+            "replica_deaths": 0.0, "replica_handoffs": 0.0,
+            "handoff_replay_entries": 0.0, "handoff_ef_entries": 0.0,
+            "handoff_deferred_flushed": 0.0, "replica_syncs": 0.0,
+            "replica_fenced_waits": 0.0}
+        self._steps_since_sync = 0
+        self._ckpt_lineage = 0
+
+    # -- liveness (PR-4 breaker machinery) ------------------------------ #
+    def _make_probe(self, idx: int) -> Callable[[], Any]:
+        def probe() -> Any:
+            slot = self._slots[idx]
+            if not slot.alive:
+                raise TransportError(f"replica {idx} is down")
+            return slot.runtime.health()
+        return probe
+
+    def probe(self, idx: int) -> bool:
+        """One health probe through the replica's breaker; True if it
+        answered. A replica whose breaker reaches OPEN is declared dead
+        and failed over (callers loop this as their liveness sweep —
+        the readiness-probe contract deploy/ mirrors)."""
+        slot = self._slots[idx]
+        try:
+            slot.breaker._probe()  # the breaker's own probe callable
+        except TransportError:
+            slot.breaker.record_failure()
+            if slot.breaker.state == OPEN and slot.routable:
+                self._declare_dead(slot)
+            return False
+        slot.breaker.record_success()
+        return True
+
+    def check_liveness(self) -> List[int]:
+        """Probe every routable replica once; returns the indices still
+        live. Dead replicas (breaker OPEN) are failed over inline."""
+        return [s.idx for s in self._slots
+                if s.routable and self.probe(s.idx)]
+
+    def kill(self, idx: int) -> None:
+        """Chaos entry point: fence replica ``idx`` (its probes now
+        fail), drive its breaker to OPEN through the normal
+        consecutive-failure path, and fail it over. Raises on the last
+        live replica — a group with nowhere to hand off to cannot honor
+        exactly-once."""
+        with self._lock:
+            slot = self._slots[idx]
+            if not slot.alive:
+                return
+            if sum(1 for s in self._slots if s.alive) <= 1:
+                raise RuntimeError(
+                    "cannot kill the last live replica (no successor "
+                    "to hand its step state to)")
+            slot.alive = False
+        # the breaker, not this method, declares death: the same
+        # threshold-of-consecutive-probe-failures clients reason about
+        while slot.breaker.state != OPEN:
+            self.probe(idx)
+
+    def _declare_dead(self, slot: _ReplicaSlot) -> None:
+        with self._lock:
+            if not slot.routable:
+                return
+            slot.alive = False
+            self._counters["replica_deaths"] += 1
+            live = sum(1 for s in self._slots if s.alive)
+        fl = obs_flight.get_recorder()
+        if fl is not None:
+            fl.record(spans.FL_REPLICA_DEATH, party="router",
+                      replica=slot.idx, live=live)
+        self._fail_over(slot)
+
+    # -- failover handoff ----------------------------------------------- #
+    def _fail_over(self, slot: _ReplicaSlot) -> None:
+        """Quiesce -> capture -> merge -> commit. Runs on the thread
+        that observed the death (probe/kill caller); fenced clients of
+        the dead replica block in :meth:`_route` until the commit."""
+        t0 = time.perf_counter()
+        fl = obs_flight.get_recorder()
+        if fl is not None:
+            fl.record(spans.FL_HANDOFF_BEGIN, party="router",
+                      replica=slot.idx)
+        # quiesce: alive=False already blocks new entries; wait for
+        # in-flight calls to resolve so the capture below sees every
+        # reply that actually reached a client (a resolved-after-capture
+        # entry would let a duplicate re-apply on the successor)
+        if not slot.drained.wait(timeout=_HANDOFF_TIMEOUT_S):
+            raise TimeoutError(
+                f"replica {slot.idx}: in-flight calls did not drain "
+                f"within {_HANDOFF_TIMEOUT_S}s; cannot hand off safely")
+        runtime = slot.runtime
+        flushed = int(runtime.flush_deferred())
+        step = int(runtime.health().get("step", -1))
+        payload = runtime.export_runtime_extras(max(step, 0))
+        if self.handoff_mode == "checkpoint":
+            payload = self._durable_roundtrip(payload)
+        n_replay, n_ef = self._merge_into_successors(payload)
+        with self._lock:
+            self._ckpt_lineage = max(self._ckpt_lineage,
+                                     int(payload.get("lineage", 0)))
+            # commit: only now does the dead replica stop being the
+            # rendezvous target — its fenced clients reroute onto
+            # successors that already hold the merged state
+            slot.routable = False
+            stale = [cid for cid, rid in self._route_cache.items()
+                     if rid == slot.idx]
+            for cid in stale:
+                del self._route_cache[cid]
+            self._counters["replica_reroutes"] += len(stale)
+            self._counters["replica_handoffs"] += 1
+            self._counters["handoff_replay_entries"] += n_replay
+            self._counters["handoff_ef_entries"] += n_ef
+            self._counters["handoff_deferred_flushed"] += flushed
+        slot.handoff_done.set()
+        self.registry.observe(spans.REPLICA_HANDOFF_LATENCY,
+                              time.perf_counter() - t0)
+        if fl is not None:
+            fl.record(spans.FL_HANDOFF_COMMIT, step=max(step, 0),
+                      party="router", replica=slot.idx,
+                      replay_entries=n_replay, ef_entries=n_ef,
+                      rerouted=len(stale))
+        # the replica object is ours to reap (in a real deployment the
+        # process is gone); close() joins its coalescer threads
+        runtime.close()
+
+    def _durable_roundtrip(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """checkpoint-mode handoff: the successor restores from what the
+        durable sidecar path actually committed, not from memory."""
+        from split_learning_tpu.runtime.checkpoint import (
+            read_latest_extras, write_extras)
+        directory = self._handoff_dir()
+        write_extras(directory, payload)
+        stored = read_latest_extras(directory, step=payload["step"])
+        if stored is None:  # unreadable disk — fall back to the capture
+            return payload
+        return stored
+
+    def _handoff_dir(self) -> str:
+        if self._ckpt_dir is None:
+            import tempfile
+            self._ckpt_dir = tempfile.mkdtemp(prefix="slt-handoff-")
+        os.makedirs(self._ckpt_dir, exist_ok=True)
+        return self._ckpt_dir
+
+    def _merge_into_successors(self,
+                               payload: Dict[str, Any]) -> Tuple[int, int]:
+        from split_learning_tpu.runtime.checkpoint import decode_obj
+        with self._lock:
+            survivors = [s.idx for s in self._slots if s.alive]
+        n_replay = 0
+        for rec in decode_obj(payload.get("replay")) or []:
+            cid, op, st = rec["key"]
+            succ = self._slots[rendezvous_pick(int(cid), survivors)].runtime
+            if succ.replay is None:
+                continue
+            # put(): born resolved, first-apply-wins — never clobbers an
+            # entry the successor already owns for this key
+            succ.replay.put(int(cid), str(op), int(st), rec.get("result"))
+            body = rec.get("body")
+            if body is not None:
+                succ.replay.attach_body(int(cid), str(op), int(st),
+                                        bytes(body))
+            n_replay += 1
+        # EF residual ledger: server-side keys are (client_id, route) —
+        # route each migrated stream to its client's successor
+        buckets: Dict[int, list] = {}
+        for rec in decode_obj(payload.get("wire_ef")) or []:
+            key = rec["key"]
+            cid = key[0] if isinstance(key, (list, tuple)) else key
+            try:
+                target = rendezvous_pick(int(cid), survivors)
+            except (TypeError, ValueError):
+                target = survivors[0]
+            buckets.setdefault(target, []).append(rec)
+        n_ef = 0
+        for target, recs in buckets.items():
+            ledger = getattr(self._slots[target].runtime, "wire_ef", None)
+            if ledger is not None:
+                n_ef += int(ledger.merge_state(recs))
+        return n_replay, n_ef
+
+    # -- sticky routing -------------------------------------------------- #
+    def _route(self, client_id: int) -> _ReplicaSlot:
+        """The client's replica, in-flight-counted. Blocks while the
+        client's assigned replica is mid-handoff (the exactly-once
+        fence) and reroutes only after the commit."""
+        cid = int(client_id)
+        fl = obs_flight.get_recorder()
+        while True:
+            decision = None
+            wait_on = None
+            with self._lock:
+                targets = [s.idx for s in self._slots if s.routable]
+                idx = self._route_cache.get(cid)
+                if idx is None or not self._slots[idx].routable:
+                    new = rendezvous_pick(cid, targets)
+                    decision = (new, idx is not None)
+                    self._route_cache[cid] = new
+                    self._counters["replica_routes"] += 1
+                    idx = new
+                slot = self._slots[idx]
+                if slot.alive:
+                    slot.inflight += 1
+                    if slot.inflight == 1:
+                        slot.drained.clear()
+                else:
+                    wait_on = slot.handoff_done
+                    self._counters["replica_fenced_waits"] += 1
+            if decision is not None and fl is not None:
+                fl.record(spans.FL_ROUTE, client_id=cid, party="router",
+                          replica=decision[0], reroute=decision[1])
+            if wait_on is None:
+                return slot
+            t0 = time.perf_counter()
+            if not wait_on.wait(timeout=_HANDOFF_TIMEOUT_S):
+                raise TransportError(
+                    f"client {cid}: replica {slot.idx} handoff did not "
+                    f"commit within {_HANDOFF_TIMEOUT_S}s")
+            self.registry.observe(spans.REPLICA_REROUTE_WAIT,
+                                  time.perf_counter() - t0)
+
+    def _release(self, slot: _ReplicaSlot) -> None:
+        with self._lock:
+            slot.inflight -= 1
+            if slot.inflight == 0:
+                slot.drained.set()
+
+    def _acquire_first_live(self) -> _ReplicaSlot:
+        """In-flight-counted handle on the first live replica, for group
+        surface calls that carry no client identity (aggregate, byte
+        accounting)."""
+        deadline = time.monotonic() + _HANDOFF_TIMEOUT_S
+        while True:
+            with self._lock:
+                for slot in self._slots:
+                    if slot.alive:
+                        slot.inflight += 1
+                        if slot.inflight == 1:
+                            slot.drained.clear()
+                        return slot
+                pending = [s for s in self._slots
+                           if s.routable and not s.alive]
+            if not pending or time.monotonic() >= deadline:
+                raise TransportError("no live replicas in the group")
+            pending[0].handoff_done.wait(timeout=_HANDOFF_TIMEOUT_S)
+
+    def assignment(self, client_id: int) -> int:
+        """The replica index ``client_id`` currently routes to, without
+        dispatching (tests, fleet reporting)."""
+        with self._lock:
+            targets = [s.idx for s in self._slots if s.routable]
+        return rendezvous_pick(int(client_id), targets)
+
+    def live_replicas(self) -> List[int]:
+        with self._lock:
+            return [s.idx for s in self._slots if s.alive]
+
+    # -- the duck-typed server surface ----------------------------------- #
+    def split_step(self, activations: np.ndarray, labels: np.ndarray,
+                   step: int, client_id: int = 0) -> Tuple[np.ndarray, float]:
+        slot = self._route(client_id)
+        try:
+            result = slot.runtime.split_step(activations, labels, step,
+                                             client_id)
+        finally:
+            self._release(slot)
+        self._note_group_step()
+        return result
+
+    def u_forward(self, activations: np.ndarray, step: int,
+                  client_id: int = 0) -> np.ndarray:
+        slot = self._route(client_id)
+        try:
+            return slot.runtime.u_forward(activations, step, client_id)
+        finally:
+            self._release(slot)
+
+    def u_backward(self, feat_grads: np.ndarray, step: int,
+                   client_id: int = 0) -> np.ndarray:
+        slot = self._route(client_id)
+        try:
+            result = slot.runtime.u_backward(feat_grads, step, client_id)
+        finally:
+            self._release(slot)
+        self._note_group_step()
+        return result
+
+    def predict(self, activations: np.ndarray,
+                client_id: int = 0) -> np.ndarray:
+        slot = self._route(client_id)
+        try:
+            return slot.runtime.predict(activations, client_id)
+        finally:
+            self._release(slot)
+
+    def aggregate(self, params: Any, epoch: int, loss: float, step: int,
+                  num_examples: Optional[int] = None) -> Any:
+        # federated aggregation has no client identity on this surface;
+        # it runs on the first live replica (replication targets the
+        # split serve path — ISSUE 15)
+        slot = self._acquire_first_live()
+        try:
+            return slot.runtime.aggregate(params, epoch, loss, step,
+                                          num_examples)
+        finally:
+            self._release(slot)
+
+    def replay_lookup(self, client_id: int, op: str,
+                      step: int) -> Tuple[Optional[bytes], Optional[Any]]:
+        slot = self._route(client_id)
+        try:
+            return slot.runtime.replay_lookup(client_id, op, step)
+        finally:
+            self._release(slot)
+
+    def attach_reply_body(self, client_id: int, op: str, step: int,
+                          body: bytes) -> None:
+        slot = self._route(client_id)
+        try:
+            slot.runtime.attach_reply_body(client_id, op, step, body)
+        finally:
+            self._release(slot)
+
+    def note_wire_compression(self, raw_bytes: int, wire_bytes: int) -> None:
+        # per-request byte accounting with no client identity on this
+        # surface: fold into the first live replica's registry
+        slot = self._acquire_first_live()
+        try:
+            slot.runtime.note_wire_compression(raw_bytes, wire_bytes)
+        finally:
+            self._release(slot)
+
+    def health(self) -> Dict[str, Any]:
+        """First live replica's health, plus a ``replicas`` block (the
+        router's view) and group-summed coalescing counters — so
+        ``warm_fleet``'s compile-count convergence reads group-wide
+        compiles, not one replica's."""
+        live = self.live_replicas()
+        info = dict(self._slots[live[0]].runtime.health())
+        coalescing: Dict[str, Any] = {}
+        for idx in live:
+            sub = self._slots[idx].runtime.health().get("coalescing")
+            if not sub:
+                continue
+            for k, v in sub.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    coalescing[k] = coalescing.get(k, 0) + v
+                else:
+                    coalescing.setdefault(k, v)
+        if coalescing:
+            info["coalescing"] = coalescing
+        info["replicas"] = {
+            "n": len(self._slots), "live": live,
+            "handoff": self.handoff_mode,
+            "sync_every": self.sync_every,
+            **{k: v for k, v in self.counters().items()}}
+        return info
+
+    def metrics(self) -> Dict[str, Any]:
+        """Group registry snapshot (re-route/handoff histograms + router
+        counters) with every replica's counters summed in. Per-replica
+        detail stays on ``replicas[i].metrics()`` (fleet_sim reports
+        it)."""
+        snap = self.registry.snapshot()
+        for name, value in self.counters().items():
+            snap["counters"][f"{name}_total"] = float(value)
+        for idx in self.live_replicas():
+            sub = self._slots[idx].runtime.metrics()
+            for k, v in sub.get("counters", {}).items():
+                snap["counters"][k] = snap["counters"].get(k, 0.0) + v
+        return snap
+
+    def counters(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._counters)
+
+    def flush_deferred(self) -> int:
+        return sum(self._slots[i].runtime.flush_deferred()
+                   for i in self.live_replicas())
+
+    def export_state(self) -> Any:
+        """The group's checkpointable model state: FedAvg-sync the live
+        replicas first (after which they share one params tree), then
+        export the first live replica's caught-up TrainState."""
+        self.sync_now()
+        return self._slots[self.live_replicas()[0]].runtime.export_state()
+
+    def export_runtime_extras(self, step: int) -> Dict[str, Any]:
+        """One group-wide extras payload: every live replica's resolved
+        replay entries and EF residuals concatenated (client streams are
+        disjoint under sticky routing), lineage monotonic across the
+        group's own commits and any adopted handoffs."""
+        from split_learning_tpu.runtime import checkpoint as _ckpt
+        replay: list = []
+        wire_ef: list = []
+        for idx in self.live_replicas():
+            sub = self._slots[idx].runtime.export_runtime_extras(step)
+            replay.extend(_ckpt.decode_obj(sub.get("replay")) or [])
+            wire_ef.extend(_ckpt.decode_obj(sub.get("wire_ef")) or [])
+            with self._lock:
+                self._ckpt_lineage = max(self._ckpt_lineage,
+                                         int(sub.get("lineage", 0)))
+        with self._lock:
+            self._ckpt_lineage += 1
+            lineage = self._ckpt_lineage
+        return _ckpt.build_extras(step, lineage, replay=replay,
+                                  wire_ef=wire_ef)
+
+    def resume_from(self, state: Any, step: int,
+                    extras: Optional[Dict[str, Any]] = None) -> None:
+        """Restore every live replica from the same checkpoint: one
+        TrainState for all (the group is one model), and the full extras
+        sidecar into each — replay entries are born resolved so a
+        duplicate is served from cache on whichever replica its client
+        routes to, and the handshake step re-arms group-wide."""
+        for idx in self.live_replicas():
+            self._slots[idx].runtime.resume_from(state, step, extras)
+        if extras is not None:
+            with self._lock:
+                self._ckpt_lineage = max(self._ckpt_lineage,
+                                         int(extras.get("lineage", 0)))
+
+    def trace_metadata(self) -> Any:
+        return self._slots[self.live_replicas()[0]].runtime.trace_metadata()
+
+    def close(self) -> None:
+        for slot in self._slots:
+            if slot.alive:
+                slot.runtime.close()
+
+    # -- FedAvg replica sync --------------------------------------------- #
+    def _note_group_step(self) -> None:
+        if self.sync_every <= 0:
+            return
+        with self._lock:
+            self._steps_since_sync += 1
+            due = self._steps_since_sync >= self.sync_every
+            if due:
+                self._steps_since_sync = 0
+        if due:
+            self.sync_now()
+
+    def sync_now(self) -> int:
+        """Install the FedAvg mean of the live replicas' server tops
+        into each of them (params only — optimizer moments stay local,
+        the same scope FedAvgAggregator has). With one live replica
+        ``fedavg_mean`` returns its params identically, so a
+        single-replica group stays bit-identical to the bare server.
+        Returns the number of replicas synced."""
+        from split_learning_tpu.runtime.state import fedavg_mean
+        runtimes = [self._slots[i].runtime for i in self.live_replicas()]
+        if len(runtimes) <= 1:
+            # fedavg_mean's N=1 identity, taken all the way: a lone
+            # replica's params are already the group mean, and skipping
+            # the install keeps the 1-replica group bit-identical to the
+            # bare server (no copy, no extra buffer)
+            with self._lock:
+                self._counters["replica_syncs"] += 1
+            return len(runtimes)
+        import jax
+        import jax.numpy as jnp
+        params = []
+        for r in runtimes:
+            # export_state flushes deferred applies under the runtime
+            # lock — the mean must average caught-up tops
+            params.append(r.export_state().params)
+        mean = fedavg_mean(params)
+        for r in runtimes:
+            with r._lock:
+                # per-replica copy: the server's jitted step donates its
+                # params buffer, so replicas must never share one
+                r.state = r.state._replace(
+                    params=jax.tree_util.tree_map(jnp.copy, mean))
+        with self._lock:
+            self._counters["replica_syncs"] += 1
+        return len(runtimes)
+
+
+def maybe_replicate(factory: Callable[[int], Any], n: int,
+                    sync_every: int = 0, handoff: str = "live",
+                    ckpt_dir: Optional[str] = None,
+                    seed: int = 0) -> Any:
+    """The one construction seam launch/fleet code uses. ``n <= 1``
+    returns ``factory(0)`` bare — the zero-overhead-off pin: a
+    single-replica deployment builds no router, no group lock, nothing
+    on the step path. ``n > 1`` builds the replicas (the factory must
+    produce same-init runtimes — same plan/cfg/rng per index) behind a
+    :class:`ReplicaGroup`."""
+    if n <= 1:
+        return factory(0)
+    return ReplicaGroup([factory(i) for i in range(n)],
+                        sync_every=sync_every, handoff=handoff,
+                        ckpt_dir=ckpt_dir, seed=seed)

@@ -399,16 +399,39 @@ class TopK8EF:
         exported as lists come back as the tuples compress() uses."""
         # materialize the arrays before taking the lock (SLT001: no
         # host-side copies inside the compressor's critical section)
-        restored = {}
-        for rec in entries:
-            key = rec["key"]
-            if isinstance(key, list):
-                key = tuple(key)
-            restored[key] = np.asarray(rec["res"], dtype=np.float32)
+        restored = self._restore_entries(entries)
         with self._lock:
             self._res.clear()
             self._prev.clear()
             self._res.update(restored)
+
+    def merge_state(self, entries: list) -> int:
+        """Graft another endpoint's exported residuals into this ledger
+        WITHOUT touching keys that already live here — the failover
+        handoff (runtime/replica.py): a dead replica's client streams
+        migrate to a successor whose own streams must keep their
+        residual mass. Keys present on both sides keep the local value
+        (the local stream is live; the import is a stale snapshot of a
+        different client set by construction). Returns how many keys
+        were adopted."""
+        restored = self._restore_entries(entries)
+        with self._lock:
+            adopted = 0
+            for key, res in restored.items():
+                if key not in self._res:
+                    self._res[key] = res
+                    adopted += 1
+            return adopted
+
+    @staticmethod
+    def _restore_entries(entries: list) -> dict:
+        out = {}
+        for rec in entries:
+            key = rec["key"]
+            if isinstance(key, list):
+                key = tuple(key)
+            out[key] = np.asarray(rec["res"], dtype=np.float32)
+        return out
 
 
 def compressed_leaf_bytes(obj: Any) -> Tuple[int, int]:

@@ -448,3 +448,97 @@ def test_fleet_sim_summary_utilization_schema(monkeypatch, capsys):
     assert util["admission_reject_rate"] is None
     assert util["step_p99_over_slo"] is None
     assert util["slo_attained"] is None
+
+
+@pytest.mark.slow
+def test_bench_replica_failover_role_quick():
+    """The replica_failover side leg (in-process, quick): twin
+    3-replica groups, one chaos-killed mid-run — the contract fields
+    the orchestrator publishes plus every gate it enforces."""
+    sys.path.insert(0, REPO)
+    from bench import measure_replica_failover
+
+    rec = measure_replica_failover(quick=True)
+    assert rec["valid"], rec["invalid_reason"]
+    assert rec["replicas_one_bit_identical"] is True
+    expected = rec["clients"] * rec["steps_per_client"]
+    for tag in ("clean", "killed"):
+        assert rec[tag]["steps_completed"] == expected
+        assert rec[tag]["dropped_steps"] == 0
+        assert rec[tag]["steady_state_recompiles"] == 0
+    assert rec["clean"]["kills"] == 0
+    assert rec["killed"]["kills"] == 1
+    assert rec["killed"]["replica_handoffs"] == 1
+    assert rec["killed"]["handoff_replay_entries"] > 0
+    assert rec["killed"]["replica_reroutes"] > 0
+    assert len(rec["killed"]["live_replicas"]) == rec["replicas"] - 1
+    assert rec["loss_parity"] <= 0.25
+
+
+REPLICATION_KEYS = {"replicas", "kill_replica_at", "kills",
+                    "live_replicas", "handoff", "reroute_wait",
+                    "handoff_latency", "per_replica"}
+HANDOFF_KEYS = {"replica_routes", "replica_reroutes", "replica_deaths",
+                "replica_handoffs", "handoff_replay_entries",
+                "handoff_ef_entries", "handoff_deferred_flushed",
+                "replica_syncs", "replica_fenced_waits"}
+
+
+def test_fleet_sim_replication_schema(monkeypatch, capsys):
+    """The ``replication`` block is schema-stable across arms: a
+    --replicas 1 run ships the same keys with zeroed handoff counters,
+    null latency tails and an empty per-replica list; a chaos-kill run
+    ships engaged counters, the surviving router view, and per-replica
+    replay detail — so a twin-run diff never branches on shape."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "fleet_sim_repl", os.path.join(REPO, "scripts", "fleet_sim.py"))
+    fleet_sim = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fleet_sim)
+
+    # null arm: plain server, nothing killed
+    monkeypatch.setattr(sys, "argv", [
+        "fleet_sim.py", "--clients", "2", "--steps", "1",
+        "--rate", "5.0", "--batch", "4", "--workers", "2"])
+    assert fleet_sim.main() == 0
+    out = capsys.readouterr().out
+    null_arm = json.loads(out[out.index("{"):])["replication"]
+    assert set(null_arm) == REPLICATION_KEYS
+    assert set(null_arm["handoff"]) == HANDOFF_KEYS
+    assert null_arm["replicas"] == 1 and null_arm["kills"] == 0
+    assert null_arm["live_replicas"] == [0]
+    assert all(v == 0 for v in null_arm["handoff"].values())
+    assert null_arm["reroute_wait"] == {"p50_ms": None, "p99_ms": None}
+    assert null_arm["handoff_latency"] == {"p50_ms": None,
+                                           "p99_ms": None}
+    assert null_arm["per_replica"] == []
+
+    # chaos-kill arm: 2 replicas, kill the busiest mid-run
+    monkeypatch.setattr(sys, "argv", [
+        "fleet_sim.py", "--clients", "6", "--steps", "2",
+        "--rate", "5.0", "--batch", "4", "--workers", "4",
+        "--replicas", "2", "--kill-replica-at", "4",
+        "--gate-dropped-steps"])
+    assert fleet_sim.main() == 0
+    out = capsys.readouterr().out
+    summary = json.loads(out[out.index("{"):])
+    kill_arm = summary["replication"]
+    assert set(kill_arm) == REPLICATION_KEYS
+    assert set(kill_arm["handoff"]) == HANDOFF_KEYS
+    assert kill_arm["replicas"] == 2 and kill_arm["kills"] == 1
+    assert len(kill_arm["live_replicas"]) == 1
+    assert kill_arm["handoff"]["replica_deaths"] == 1
+    assert kill_arm["handoff"]["replica_handoffs"] == 1
+    assert kill_arm["handoff"]["replica_routes"] > 0
+    assert kill_arm["handoff_latency"]["p50_ms"] is not None
+    rows = kill_arm["per_replica"]
+    assert [r["replica"] for r in rows] == [0, 1]
+    assert sum(r["alive"] for r in rows) == 1
+    # gate held through the kill: every scheduled step completed
+    assert summary["dropped_steps"] == 0
+    assert summary["steps_completed"] == summary["steps_expected"]
+
+    # --kill-replica-at without replication is a usage error, not a hang
+    monkeypatch.setattr(sys, "argv", [
+        "fleet_sim.py", "--clients", "2", "--kill-replica-at", "1"])
+    assert fleet_sim.main() == 2
