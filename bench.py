@@ -2133,6 +2133,200 @@ def measure_mpmd_pipeline(quick: bool) -> dict:
     }
 
 
+def measure_mpmd_colocated(quick: bool) -> dict:
+    """Device-native co-located chain + 1F1B schedule (PR 16): the same
+    3-stage chain as the mpmd_pipeline leg, but driver and StageRuntimes
+    share the process and every hop is a DeviceTransport relay — device
+    buffers end to end, no codec, no np.asarray — under the 1F1B
+    injection schedule (warmup min(S, M), then one forward per drained
+    cotangent).
+
+    Measured bubble: jax dispatches stage programs asynchronously, so
+    per-wire busy time all drains at the chain's ONE sync point — the
+    loss edge, where hop_loss floats the scalar. That worker's busy
+    fraction therefore measures whole-chain occupancy over the warm
+    window, and its complement is the pipeline's real idle fraction;
+    that is the number gated against the GPipe ideal (S-1)/(M+S-1).
+
+    Gates: (a) co-located 1F1B throughput >= 0.25x the fused
+    single-program trainer on the same arithmetic (measured ~0.5x on
+    the CPU image — the chain pays thread handoffs and per-microbatch
+    dispatch that lax.scan fuses away; the budget states how much of
+    that overhead is acceptable before the co-located path stops being
+    worth offering); (b) warm-window loss-edge bubble strictly below
+    the GPipe ideal (S-1)/(M+S-1); (c) hop-path host copies == 0 by
+    the explicit ``hop_host_copies`` counter (the CPU transfer guard
+    cannot see D2H — same-process views — so the counter is the pin),
+    while the HTTP twin counts 2 per hop; (d) the M=1 device chain's
+    loss series is bit-identical to an M=1 chain over REAL
+    SplitHTTPServer loopback wires (zero-copy relay adds no
+    arithmetic); (e) zero steady-state recompiles under the dispatch
+    watchdog."""
+    import jax
+    import numpy as np
+
+    from split_learning_tpu.models import get_plan
+    from split_learning_tpu.obs import dispatch_debug, spans
+    from split_learning_tpu.runtime.fused import FusedSplitTrainer
+    from split_learning_tpu.runtime.pipeline_runner import (
+        PipelineRunner, bubble_fraction, onefb_warmup)
+    from split_learning_tpu.runtime.stage import StageRuntime
+    from split_learning_tpu.transport.device import DeviceTransport
+    from split_learning_tpu.transport.http import (
+        HttpTransport, SplitHTTPServer)
+    from split_learning_tpu.utils import Config
+
+    batch = 32
+    microbatches = 4
+    rounds = 8 if quick else 14
+    warm = 3
+    rs = np.random.RandomState(0)
+    px = rs.rand(4, batch, 28, 28, 1).astype(np.float32)
+    py = rs.randint(0, 10, (4, batch)).astype(np.int32)
+    plan3 = get_plan(model="split_cnn_chain3", mode="split")
+    dd = dispatch_debug.tracker()
+
+    def chain_run(m, schedule, kind, n_rounds, timed_from):
+        """One fresh co-located chain (device or real HTTP-loopback
+        wires); returns (losses, steps/sec over the warm window, the
+        loss-edge warm bubble, summed hop_host_copies)."""
+        cfg = Config(mode="split", model="split_cnn_chain3",
+                     batch_size=batch, num_stages=3, microbatches=m,
+                     schedule=schedule)
+        stages = [StageRuntime(plan3, i, cfg, jax.random.PRNGKey(0),
+                               px[0], microbatches=m,
+                               apply_lag=1 if m > 1 else 0)
+                  for i in (1, 2)]
+        servers, ts = [], []
+        for s in stages:
+            if kind == "device":
+                ts.append(DeviceTransport(s))
+            else:
+                srv = SplitHTTPServer(s).start()
+                servers.append(srv)
+                ts.append(HttpTransport(srv.url))
+        runner = PipelineRunner(plan3, cfg, jax.random.PRNGKey(0),
+                                px[0], ts, microbatches=m,
+                                schedule=schedule)
+        losses = []
+        try:
+            for r in range(timed_from):
+                losses.append(runner.step(px[r % 4], py[r % 4], r))
+            # warm-window accounting: busy/wall deltas exclude compile
+            loss_edge = runner._fwd_workers[-1]
+            busy0, wall0 = loss_edge.busy_s, runner._wall_s
+            t0 = time.perf_counter()
+            for r in range(timed_from, n_rounds):
+                losses.append(runner.step(px[r % 4], py[r % 4], r))
+            dt = time.perf_counter() - t0
+            d_wall = runner._wall_s - wall0
+            edge_bubble = (1.0 - (loss_edge.busy_s - busy0) / d_wall
+                           if d_wall > 0 else None)
+        finally:
+            runner.close()
+            for s in stages:
+                s.close()
+            for srv in servers:
+                srv.stop()
+        sps = (n_rounds - timed_from) / dt if dt > 0 else float("inf")
+        copies = sum(t.stats.counters.get(spans.HOP_HOST_COPIES, 0)
+                     for t in ts)
+        return losses, sps, edge_bubble, copies
+
+    dispatch_debug.force(True)
+    try:
+        g0 = dd.gauges()
+        _, sps_dev, edge_bubble, dev_copies = chain_run(
+            microbatches, "1f1b", "device", rounds, warm)
+        g1 = dd.gauges()
+    finally:
+        dispatch_debug.force(False)
+    steady = g1["steady_state_recompiles"] - g0["steady_state_recompiles"]
+
+    # fused single-program twin: the same chain3 arithmetic as ONE jit
+    fused = FusedSplitTrainer(plan3, Config(
+        mode="split", model="split_cnn_chain3", batch_size=batch,
+        num_stages=3), jax.random.PRNGKey(0), px[0])
+    for r in range(warm):
+        fused.train_step(px[r % 4], py[r % 4])
+    t0 = time.perf_counter()
+    for r in range(warm, rounds):
+        fused.train_step(px[r % 4], py[r % 4])
+    sps_fused = (rounds - warm) / (time.perf_counter() - t0)
+    fused_ratio = sps_dev / sps_fused
+    fused_budget = 0.25
+
+    # M=1 bit-identity: device relay vs REAL HTTP loopback wires
+    id_steps = 6
+    dev_series, _, _, m1_copies = chain_run(1, "gpipe", "device",
+                                            id_steps, 0)
+    http_series, _, _, http_copies = chain_run(1, "gpipe", "http",
+                                               id_steps, 0)
+    # the HTTP twin materializes exactly 2 host buffers per hop
+    # (payload out, reply in) x 3 hops x id_steps — the contrast metric
+    want_http = 2 * 3 * id_steps
+
+    theo = bubble_fraction(microbatches, 3)
+    invalid_reason = None
+    if fused_ratio < fused_budget:
+        invalid_reason = (
+            f"co-located 1F1B chain is {fused_ratio:.2f}x the fused "
+            f"single-program trainer (< {fused_budget}): the MPMD "
+            "overhead ate the co-location win")
+    elif edge_bubble is None or edge_bubble >= theo:
+        invalid_reason = (
+            f"warm loss-edge bubble {edge_bubble} is not strictly "
+            f"below the GPipe ideal {theo:.3f}: the 1F1B chain is "
+            "bubble-bound")
+    elif dev_copies or m1_copies:
+        invalid_reason = (
+            f"hop_host_copies={dev_copies + m1_copies} != 0 on the "
+            "device path: a hop payload or reply materialized on host")
+    elif dev_series != http_series:
+        invalid_reason = (
+            "M=1 device chain loss series differs from the HTTP "
+            "loopback chain: the zero-copy relay changed arithmetic")
+    elif http_copies != want_http:
+        invalid_reason = (
+            f"HTTP twin counted {http_copies} host copies (want "
+            f"{want_http}): the contrast accounting drifted")
+    elif steady:
+        invalid_reason = (
+            f"steady_state_recompiles={steady:.0f} != 0: a stage or "
+            "shuttle program retraces per step")
+    return {
+        "leg": "mpmd_colocated",
+        "stages": 3,
+        "microbatches": microbatches,
+        "batch": batch,
+        "schedule": "1f1b",
+        "warmup_depth": onefb_warmup(microbatches, 3),
+        "model": {"family": "split_cnn_chain3",
+                  "partition": ["part_a", "trunk_b", "head_c"]},
+        "platform": "cpu+in-process",
+        "host_cores": os.cpu_count(),
+        "note": ("Device-native DeviceTransport relay, 1F1B schedule. "
+                 "Bubble is measured at the loss edge — the chain's "
+                 "one sync point under async dispatch — over the warm "
+                 "window only. The fused-trainer budget states the "
+                 "acceptable MPMD overhead on one host; the HTTP twin "
+                 "pins the copy contrast (0 vs 2/hop) and the M=1 "
+                 "bit-identity."),
+        "steps_per_sec_1f1b": sps_dev,
+        "steps_per_sec_fused": sps_fused,
+        "fused_ratio": fused_ratio,
+        "fused_budget": fused_budget,
+        "bubble_measured_loss_edge": edge_bubble,
+        "bubble_theoretical_gpipe": theo,
+        "hop_host_copies_device": dev_copies + m1_copies,
+        "hop_host_copies_http_twin": http_copies,
+        "m1_bit_identical_vs_http": dev_series == http_series,
+        "steady_state_recompiles": steady,
+        "valid": invalid_reason is None,
+        "invalid_reason": invalid_reason,
+    }
+
+
 def measure_sharded_server(quick: bool) -> dict:
     """Sharded server runtime (PR 11): the server half pjit-compiled
     over the virtual host mesh, with mesh-aware coalesced dispatch.
@@ -2803,7 +2997,7 @@ def main() -> None:
                              "chaos_soak", "fleet_soak",
                              "replica_failover", "decode",
                              "flash_micro", "sharded_server",
-                             "mpmd_pipeline"],
+                             "mpmd_pipeline", "mpmd_colocated"],
                     default=None)
     ap.add_argument("--quick", action="store_true")
     args = ap.parse_args()
@@ -2822,7 +3016,8 @@ def main() -> None:
               "decode": measure_decode,
               "flash_micro": measure_flash_micro,
               "sharded_server": measure_sharded_server,
-              "mpmd_pipeline": measure_mpmd_pipeline}[args.role]
+              "mpmd_pipeline": measure_mpmd_pipeline,
+              "mpmd_colocated": measure_mpmd_colocated}[args.role]
         print(json.dumps(fn(args.quick)))
         return
 
@@ -3040,6 +3235,13 @@ def main() -> None:
                                timeout=900)
         if mpmd is not None:
             detail["mpmd_pipeline"] = mpmd
+        # co-located device-native chain (PR 16): zero-copy hops +
+        # 1F1B schedule vs the fused single-program twin, HTTP-loopback
+        # contrast for copy accounting and M=1 bit-identity
+        coloc = _run_subprocess("mpmd_colocated", args.quick, CPU_ENV,
+                                timeout=900)
+        if coloc is not None:
+            detail["mpmd_colocated"] = coloc
 
     detail["fused"] = fused
     if fused is None:

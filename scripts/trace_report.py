@@ -338,23 +338,35 @@ def render(rep: Dict[str, Any]) -> str:
     pipe = rep.get("pipeline")
     if pipe:
         lines.append("")
+        sched = pipe.get("schedule")
+        sched_note = f", schedule {sched}" if sched else ""
         lines.append(
             f"MPMD pipeline — {pipe.get('num_stages', '?')} stages, "
             f"M={pipe.get('microbatches', '?')} microbatches, "
             f"{pipe.get('ticks_per_step', '?')} ticks/step over "
-            f"{pipe.get('steps', '?')} steps")
+            f"{pipe.get('steps', '?')} steps{sched_note}")
         stages = pipe.get("stages")
         if isinstance(stages, list) and stages:
-            lines.append(f"  {'stage':>5} {'bubble':>8} {'theo':>8} "
+            # gpipe/1f1b columns render the per-schedule ideal side by
+            # side; sidecars predating PR 16 carry neither (nor a
+            # schedule), so every new column falls back to '-'
+            lines.append(f"  {'stage':>5} {'sched':>6} {'bubble':>8} "
+                         f"{'gpipe':>8} {'1f1b':>8} "
                          f"{'reply_p50':>10} {'hops':>6} {'applyQ':>7}")
             for row in stages:
                 if not isinstance(row, dict):
                     continue
+                sched_col = f"{str(row.get('schedule') or '-'):>6}"
                 bub = row.get("bubble_fraction")
                 bub_col = f"{bub:>8.1%}" if bub is not None else f"{'-':>8}"
-                theo = row.get("bubble_theoretical")
-                theo_col = (f"{theo:>8.1%}" if theo is not None
-                            else f"{'-':>8}")
+
+                def _theo(key, row=row):
+                    # old sidecars: one 'bubble_theoretical' for both
+                    t = row.get(key, row.get("bubble_theoretical"))
+                    return f"{t:>8.1%}" if t is not None else f"{'-':>8}"
+
+                gpipe_col = _theo("bubble_theoretical_gpipe")
+                onefb_col = _theo("bubble_theoretical_1f1b")
                 p50 = row.get("reply_p50_ms")
                 p50_col = (f"{p50:>8.3f}ms" if p50 is not None
                            else f"{'-':>10}")
@@ -362,8 +374,8 @@ def render(rep: Dict[str, Any]) -> str:
                 depth_col = (f"{int(depth):>7d}" if depth is not None
                              else f"{'-':>7}")
                 lines.append(
-                    f"  {int(row.get('stage', 0)):>5d} {bub_col} "
-                    f"{theo_col} {p50_col} "
+                    f"  {int(row.get('stage', 0)):>5d} {sched_col} "
+                    f"{bub_col} {gpipe_col} {onefb_col} {p50_col} "
                     f"{int(row.get('hop_calls', 0)):>6d} {depth_col}")
     tqw = rep.get("tenant_queue_wait")
     if tqw:

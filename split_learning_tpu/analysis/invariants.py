@@ -336,6 +336,32 @@ def pipeline_hops_exactly_once(run: Any) -> None:
                     f"applied before its forward residual existed")
 
 
+def onefb_hop_order(run: Any) -> None:
+    """1F1B steady-state hop discipline (PR 16): the schedule changes
+    *when* microbatches enter the wire, never *what* the wire must
+    guarantee — so every hop still applies exactly once, in microbatch
+    order per (stage, dir, step), and no cotangent ever applies before
+    its forward residual (never backward-before-forward). On top of
+    that, 1F1B's whole point is the bounded window: after the warmup of
+    W = min(S, M) forwards, one new microbatch may enter only after a
+    cotangent drained, so the in-flight depth never exceeds W.
+
+    Notes read: everything ``pipeline_hops_exactly_once`` reads, plus
+    ``inflight(depth, bound)`` emitted by the driver at every injection
+    point (depth AFTER the inject; bound = W)."""
+    try:
+        pipeline_hops_exactly_once(run)
+    except Violation as v:
+        raise Violation("onefb_hop_order", run.schedule_id, v.message)
+    for f in _notes(run, "inflight"):
+        if f["depth"] > f["bound"]:
+            raise Violation(
+                "onefb_hop_order", run.schedule_id,
+                f"in-flight depth {f['depth']} exceeds the 1F1B window "
+                f"{f['bound']} — a forward injected before its slot's "
+                f"cotangent drained")
+
+
 # --------------------------------------------------------------------- #
 # crash–restart invariants (slt-crash) — read the ("crash", ...) marker
 # a CrashRun inserts between the killed workload and the recovery phase
@@ -546,6 +572,7 @@ INVARIANTS: Dict[str, Callable[[Any], None]] = {
     "all_resolved": all_resolved,
     "deferred_apply_exactly_once": deferred_apply_exactly_once,
     "pipeline_hops_exactly_once": pipeline_hops_exactly_once,
+    "onefb_hop_order": onefb_hop_order,
     "durable_exactly_once": durable_exactly_once,
     "checkpoint_atomicity": checkpoint_atomicity,
     "replay_recovery_bit_identical": replay_recovery_bit_identical,
@@ -572,6 +599,7 @@ RULE_OF_INVARIANT: Dict[str, str] = {
     "flush_before_save": "SLT112",
     "pipeline_hops_exactly_once": "SLT113",
     "handoff_exactly_once": "SLT114",
+    "onefb_hop_order": "SLT115",
 }
 
 

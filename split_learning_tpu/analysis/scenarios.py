@@ -610,6 +610,114 @@ def pipeline_hop_chain(ctx: Ctx) -> Dict[str, Any]:
     return {"hits_s1": caches[1].hits, "hits_s2": caches[2].hits}
 
 
+@scenario("onefb_hop_order",
+          invariants=("onefb_hop_order", "exactly_once_claims"),
+          budget=400, bound=2)
+def onefb_hop_order(ctx: Ctx) -> Dict[str, Any]:
+    """The 1F1B injection discipline (PR 16) over a 3-stage chain's hop
+    traffic (4 microbatches, warmup W = min(S, M) = 3): a driver thread
+    injects the warmup burst, then strictly one new forward per drained
+    cotangent — noting ``inflight(depth, bound)`` at every injection —
+    while the per-wire FIFO deliverers move each microbatch fwd ->
+    loss -> bwd through real per-stage ReplayCaches and a chaos thread
+    re-delivers a forward and retries a dropped backward response.
+    Through every interleaving: hops apply exactly once in mb order,
+    never a backward before its forward, and the in-flight depth never
+    exceeds W (SLT115)."""
+    from split_learning_tpu.obs import locks as obs_locks
+    from split_learning_tpu.runtime.replay import ReplayCache
+    from split_learning_tpu.runtime.stage import hop_seq
+
+    M, W, step = 4, 3, 7
+    caches = {1: ReplayCache(window=8), 2: ReplayCache(window=8)}
+    ops = {("fwd", 1): "hop_fwd", ("fwd", 2): "hop_loss",
+           ("bwd", 1): "hop_bwd"}
+
+    def deliver(stage: int, direction: str, mb: int, tag: str) -> None:
+        op = ops[(direction, stage)]
+        key = (0, op, hop_seq(step, mb))
+        if tag == "orig":
+            ctx.note("hop_sent", stage=stage, dir=direction, step=step,
+                     mb=mb)
+        else:
+            ctx.step("wire")  # the retransmit window
+        entry, owner = caches[stage].begin(*key)
+        ctx.note("begin", key=key, owner=owner, who=f"{tag}-s{stage}")
+        if owner:
+            ctx.note("hop_apply", stage=stage, dir=direction, step=step,
+                     mb=mb)
+            ctx.note("apply", key=key)
+            caches[stage].resolve(entry, f"y:{stage}:{direction}:{mb}")
+            ctx.note("resolve", key=key,
+                     value=f"y:{stage}:{direction}:{mb}")
+        else:
+            value = caches[stage].wait(entry, timeout=30.0)
+            ctx.note("wait_return", key=key, value=value)
+
+    # the 1F1B gates: inj (driver released mb onto the wire), fwd/loss
+    # (causality, as cotangents flow), drain (cotangent back at stage 0)
+    inj_ev = [obs_locks.make_event(f"inj{m}") for m in range(M)]
+    fwd_ev = [obs_locks.make_event(f"fwd{m}") for m in range(M)]
+    loss_ev = [obs_locks.make_event(f"loss{m}") for m in range(M)]
+    drain_ev = [obs_locks.make_event(f"drain{m}") for m in range(M)]
+
+    def driver() -> None:
+        # warmup burst, then one inject per drained cotangent — the
+        # runner's inject() discipline, depth noted AFTER each inject
+        depth = 0
+        for m in range(W):
+            depth += 1
+            ctx.note("inflight", depth=depth, bound=W)
+            inj_ev[m].set()
+        for m in range(M):
+            drain_ev[m].wait(timeout=30.0)
+            depth -= 1
+            nxt = W + m
+            if nxt < M:
+                depth += 1
+                ctx.note("inflight", depth=depth, bound=W)
+                inj_ev[nxt].set()
+
+    def wire1_fwd() -> None:
+        for mb in range(M):
+            inj_ev[mb].wait(timeout=30.0)
+            deliver(1, "fwd", mb, "orig")
+            fwd_ev[mb].set()
+
+    def wire2_loss() -> None:
+        for mb in range(M):
+            fwd_ev[mb].wait(timeout=30.0)
+            deliver(2, "fwd", mb, "orig")
+            loss_ev[mb].set()
+
+    def wire1_bwd() -> None:
+        for mb in range(M):
+            loss_ev[mb].wait(timeout=30.0)
+            deliver(1, "bwd", mb, "orig")
+            drain_ev[mb].set()
+
+    def chaos() -> None:
+        # a duplicated forward delivery and a dropped-response backward
+        # retry: the stage claims absorb both, the window never grows
+        fwd_ev[0].wait(timeout=30.0)
+        deliver(1, "fwd", 0, "dup")
+        drain_ev[0].wait(timeout=30.0)
+        deliver(1, "bwd", 0, "drop")
+
+    workers = [ctx.spawn(driver, name="driver"),
+               ctx.spawn(wire1_fwd, name="w1-fwd"),
+               ctx.spawn(wire2_loss, name="w2-loss"),
+               ctx.spawn(wire1_bwd, name="w1-bwd"),
+               ctx.spawn(chaos, name="chaos")]
+    for w in workers:
+        w.join()
+    for mb in range(M):
+        assert caches[1].contains(0, "hop_fwd", hop_seq(step, mb))
+        assert caches[1].contains(0, "hop_bwd", hop_seq(step, mb))
+        assert caches[2].contains(0, "hop_loss", hop_seq(step, mb))
+    return {"hits_s1": caches[1].hits, "hits_s2": caches[2].hits}
+
+
 # --------------------------------------------------------------------- #
 # replica failover handoff: kill across the claim lifecycle (PR 15)
 # --------------------------------------------------------------------- #

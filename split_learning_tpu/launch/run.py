@@ -116,7 +116,8 @@ def _config_from_args(args) -> "Config":
         if val is not None:
             overrides[field] = val
     for field in ("transport", "num_clients", "num_stages", "microbatches",
-                  "server_url", "model_parallel", "seq_parallel", "attn"):
+                  "schedule", "server_url", "model_parallel",
+                  "seq_parallel", "attn"):
         val = getattr(args, field, None)
         if val is not None:
             overrides[field] = val
@@ -455,12 +456,20 @@ def cmd_train(args) -> int:
               "reply from its weight update; the fused/pipeline paths "
               "have no server party)", file=sys.stderr)
 
+    if args.transport == "device" \
+            and not (cfg.mode == "split" and cfg.num_stages > 2):
+        print("[error] --transport device is the co-located MPMD chain "
+              "path: it needs mode=split, a chain plan and --stages > 2 "
+              "(the 2-party split has no device-native wire — use "
+              "--transport local)", file=sys.stderr)
+        return 2
     if cfg.mode == "split" and cfg.num_stages > 2 \
-            and args.transport in ("local", "http"):
+            and args.transport in ("local", "http", "device"):
         # K-stage MPMD chain (PR 14): stage 0 trains here, stages
         # 1..K-1 are StageRuntime parties — in-process behind
-        # LocalTransports, or remote `serve --role stage` processes —
-        # driven by the GPipe microbatched PipelineRunner
+        # LocalTransports (or zero-copy DeviceTransports, PR 16), or
+        # remote `serve --role stage` processes — driven by the
+        # microbatched PipelineRunner (GPipe or 1F1B schedule)
         from split_learning_tpu.runtime.pipeline_runner import (
             PipelineRunner)
         from split_learning_tpu.runtime.stage import StageRuntime
@@ -511,7 +520,15 @@ def cmd_train(args) -> int:
                                    microbatches=M, apply_lag=lag,
                                    mesh=_server_mesh(args))
                 stage_rts.append(srt)
-                transports.append(LocalTransport(srt))
+                if args.transport == "device":
+                    # zero-copy co-located wire: device buffers hand
+                    # off straight through, the loss scalar is the one
+                    # sanctioned D2H (transport/device.py)
+                    from split_learning_tpu.transport.device import (
+                        DeviceTransport)
+                    transports.append(DeviceTransport(srt))
+                else:
+                    transports.append(LocalTransport(srt))
         chaos_spec = getattr(args, "chaos", None)
         if chaos_spec:
             from split_learning_tpu.transport.chaos import (
@@ -527,7 +544,7 @@ def cmd_train(args) -> int:
                   f"(seed {chaos_policy.seed}) on every hop wire",
                   file=sys.stderr)
         runner = PipelineRunner(plan, cfg, rng, sample, transports,
-                                microbatches=M)
+                                microbatches=M, schedule=cfg.schedule)
 
         start_step = 0
         if ckptr is not None:
@@ -605,7 +622,8 @@ def cmd_train(args) -> int:
                   file=sys.stderr)
         for st in chain_meta.get("stages", []):
             bf = st.get("bubble_fraction")
-            print(f"[pipeline] stage {st['stage']}: bubble="
+            print(f"[pipeline] stage {st['stage']} "
+                  f"[{st.get('schedule', 'gpipe')}]: bubble="
                   f"{bf if bf is None else round(bf, 3)} "
                   f"(ideal {st['bubble_theoretical']:.3f}) "
                   f"reply_p50={st['reply_p50_ms']:.1f}ms",
@@ -1638,8 +1656,15 @@ def main(argv: Optional[list] = None) -> int:
     pt = sub.add_parser("train", help="run a training client (or full sim)")
     _add_common(pt)
     pt.add_argument("--transport",
-                    choices=["local", "http", "fused", "pipeline"],
+                    choices=["local", "http", "device", "fused",
+                             "pipeline"],
                     default="fused")
+    pt.add_argument("--schedule", choices=["gpipe", "1f1b"], default=None,
+                    help="MPMD chain injection schedule (PR 16): gpipe "
+                         "streams all --microbatches out up front; 1f1b "
+                         "warms up min(stages, microbatches) then runs "
+                         "strict 1-forward-1-backward — same loss bit "
+                         "for bit, bounded in-flight depth")
     pt.add_argument("--server-url", dest="server_url", default=None)
     pt.add_argument("--wait-server", dest="wait_server", type=float,
                     default=60.0,

@@ -81,6 +81,16 @@ MESH_META = "mesh_meta"
 # no duration to tile a timeline with.
 STAGE_META = "stage_meta"
 
+# -- device-native hops (transport/device.py, PR 16) ------------------- #
+# metrics-only counter (the gather_bytes precedent — never a trace
+# span): host materializations on the pipeline hop path. The device
+# transport's contract is that this stays 0 — the transfer guard is
+# inert on the CPU backend (host-platform buffers are zero-copy views),
+# so the transports count explicitly and the bench/tests gate on the
+# counter. The host-bound transports (http) increment it per hop, which
+# is the measured contrast the deploy README cites.
+HOP_HOST_COPIES = "hop_host_copies"
+
 # XLA compile events surfaced by obs/dispatch_debug.py under
 # SLT_DISPATCH_DEBUG=1 — a recompile storm shows up on the timeline and
 # in trace_report.py's compile summary; deliberately NOT in SERVER_PHASES

@@ -13,16 +13,33 @@ stage-to-stage (hub-and-spoke MPMD — the Transport abstraction is
 client↔party, and the data owner stays the only party that sees every
 cut, exactly as in the 2-party protocol).
 
-Schedule: GPipe with M microbatches in flight. Each wire gets TWO
+Schedule: GPipe with M microbatches in flight, or 1F1B (PR 16,
+PiPar arXiv:2302.12803): ``schedule="1f1b"`` injects only the warmup
+depth W = min(S, M) of stage-0 forwards up front, then exactly one new
+forward per drained cotangent — the strict 1-forward-1-backward steady
+state. Both schedules accumulate cotangents in microbatch order on the
+SAME per-step params snapshot, so the loss trajectory is bit-identical
+between them at every M (the schedule changes WHEN work is in flight,
+never the arithmetic); what 1F1B buys is the bounded in-flight depth —
+W microbatch residuals live at once instead of M. Each wire gets TWO
 dedicated worker threads — one forward, one backward — fed by FIFO
 queues, so (a) microbatch m+1's forward overlaps microbatch m's
 backward on the same wire (full duplex), (b) per (stage, direction)
 the hops leave in microbatch order (the strict-seq handshake and
-invariant SLT113 both key on that), and (c) middle stages never idle
-while the chain is full. The tick math is `parallel/pipeline.py`'s:
-T = M + S - 1 clock ticks per step, bubble fraction (S-1)/(M+S-1) —
-``stage_report()`` carries both the theoretical number and the
+invariants SLT113/SLT115 both key on that), and (c) middle stages
+never idle while the chain is full. The tick math is
+`parallel/pipeline.py`'s: T = M + S - 1 clock ticks per step for BOTH
+schedules (the per-step apply is a barrier; 1F1B's last cotangent
+still lands at tick M + S - 1), ideal bubble (S-1)/(M+S-1) —
+``stage_report()`` carries the theoretical number per schedule and the
 measured one (1 - wire-busy/wall).
+
+Transports advertising ``device_native`` (transport/device.py) flip
+the driver's stage-0 boundary to device buffers: the injected payload
+is the jitted forward's output ``jax.Array`` (no ``np.asarray``, no
+``expected_d2h`` region) and returned cotangents feed ``_bwd_acc``
+as-is — the whole hop path stays on device; the one sanctioned D2H
+left in a step is the loss scalar at the metrics edge.
 
 Weight updates: the last stage's loss hop replies per-microbatch
 cut-cotangents pre-scaled by 1/M (see StageRuntime._build_jitted), so
@@ -64,15 +81,33 @@ from split_learning_tpu.utils.config import Config
 DEFAULT_HOP_RETRIES = 4
 
 
+# hub-driver schedules: GPipe (all M in flight) or 1F1B (PiPar-style
+# warmup + strict 1-forward-1-backward steady state)
+SCHEDULES = ("gpipe", "1f1b")
+
+
 def pipeline_ticks(microbatches: int, num_stages: int) -> int:
-    """GPipe clock length per step (parallel/pipeline.py: T = M + S - 1)."""
+    """Clock length per step (parallel/pipeline.py: T = M + S - 1).
+    Identical for GPipe and 1F1B: the per-step apply is a barrier, and
+    1F1B's throttled injection still lands the last cotangent at tick
+    M + S - 1 — the schedules differ in in-flight DEPTH, not length."""
     return int(microbatches) + int(num_stages) - 1
 
 
 def bubble_fraction(microbatches: int, num_stages: int) -> float:
-    """Idle ticks / total ticks of the ideal schedule: (S-1)/(M+S-1)."""
+    """Idle ticks / total ticks of the ideal schedule: (S-1)/(M+S-1).
+    The per-step ideal coincides for GPipe and 1F1B (same T); what the
+    measured numbers separate is how far real wires fall from it."""
     s = int(num_stages)
     return (s - 1) / float(pipeline_ticks(microbatches, s))
+
+
+def onefb_warmup(microbatches: int, num_stages: int) -> int:
+    """1F1B warmup depth W = min(S, M): enough forwards to fill every
+    stage of the pipe, never more than there are microbatches. From the
+    W-th drain on, the driver is in the strict 1-forward-1-backward
+    steady state and at most W microbatch residuals exist at stage 0."""
+    return min(int(num_stages), int(microbatches))
 
 
 class _HopWorker(threading.Thread):
@@ -116,13 +151,17 @@ class PipelineRunner:
                  microbatches: int = 1,
                  client_id: int = 0,
                  hop_retries: int = DEFAULT_HOP_RETRIES,
-                 step_timeout_s: float = 300.0) -> None:
+                 step_timeout_s: float = 300.0,
+                 schedule: str = "gpipe") -> None:
         """``transports[i]`` reaches stage ``i + 1`` (LocalTransport
         around an in-process StageRuntime, HttpTransport to a
-        ``serve --role stage`` process, ChaosTransport around either).
+        ``serve --role stage`` process, DeviceTransport around a
+        co-located StageRuntime, ChaosTransport around any).
         ``rng``/``sample_input`` are the shared plan-level seed all
         parties initialize from — stage 0's params here agree with the
-        chain's by construction, no weights ship."""
+        chain's by construction, no weights ship. ``schedule`` picks
+        the injection discipline (see module docstring); the default
+        stays GPipe."""
         if plan.num_stages < 2:
             raise ValueError("a pipeline chain needs >= 2 stages")
         if len(transports) != plan.num_stages - 1:
@@ -139,10 +178,27 @@ class PipelineRunner:
         self.transports = list(transports)
         self.hop_retries = int(hop_retries)
         self.step_timeout_s = float(step_timeout_s)
+        self.schedule = str(schedule)
+        if self.schedule not in SCHEDULES:
+            raise ValueError(
+                f"unknown schedule {schedule!r} "
+                f"(expected one of {SCHEDULES})")
+        # device payloads only when EVERY wire carries them: a single
+        # host-bound transport in the chain reinstates the numpy
+        # boundary for all (its peer would np.asarray a jax.Array —
+        # correct, but a hidden D2H per hop)
+        self._device_native = all(
+            getattr(t, "device_native", False) for t in self.transports)
 
         self._tx = make_tx(cfg)
         params0 = plan.init(rng, jnp.asarray(sample_input))[0]
         self.state: TrainState = make_state(params0, self._tx)
+        if self._device_native:
+            # pin the hub's state to its device up front: device-native
+            # cotangent replies arrive committed (transport/device.py
+            # _to_hub), and a committed-ness flip after the first apply
+            # would retrace every hub program at step 2
+            self.state = jax.device_put(self.state, jax.devices()[0])
         self._dd = obs_dispatch.attach()
         self._ddtok = obs_dispatch.token()
         self._build_jitted()
@@ -277,21 +333,38 @@ class PipelineRunner:
         t_wall0 = time.perf_counter()
         mbsz = x.shape[0] // M
         x_dev: Dict[int, jax.Array] = {}
-        # fill the pipe: stage-0 forwards stream out in mb order; the
-        # hop workers keep M in flight across the chain from here on
-        for m in range(M):
+
+        def inject(m: int) -> None:
+            """Stage-0 forward of microbatch m, payload onto wire 0.
+            All injections of a step run on the same self.state.params
+            (the apply is after the drain), so 1F1B's later injections
+            see exactly the weights GPipe's up-front ones would."""
             xs = jnp.asarray(x[m * mbsz:(m + 1) * mbsz])
             with obs_dispatch.step_scope(
                     self._dd, (self._ddtok, "pipe_fwd0"),
                     sig_fn=lambda: (xs.shape, str(xs.dtype))):
                 y0 = self._fwd0(self.state.params, xs)
             x_dev[m] = xs
-            with obs_dispatch.expected_d2h(self._dd):
-                y0_host = np.asarray(y0)
+            if self._device_native:
+                payload = y0  # the device buffer IS the wire payload
+            else:
+                with obs_dispatch.expected_d2h(self._dd):
+                    payload = np.asarray(y0)
             self._fwd_workers[0].q.put(
-                (step_i, m, y0_host, y[m * mbsz:(m + 1) * mbsz]))
+                (step_i, m, payload, y[m * mbsz:(m + 1) * mbsz]))
+
+        # fill the pipe: GPipe streams all M stage-0 forwards out up
+        # front; 1F1B stops at the warmup depth W = min(S, M), then the
+        # drain loop injects exactly one forward per drained cotangent
+        # — the strict 1-forward-1-backward steady state. Injection
+        # order is 0..M-1 either way.
+        warm = M if self.schedule == "gpipe" else onefb_warmup(
+            M, self.plan.num_stages)
+        for m in range(warm):
+            inject(m)
+        next_m = warm
         # drain: the step's M cotangents, arrival order
-        cts: Dict[int, np.ndarray] = {}
+        cts: Dict[int, Any] = {}
         deadline = time.monotonic() + self.step_timeout_s
         while len(cts) < M:
             try:
@@ -308,6 +381,9 @@ class PipelineRunner:
             if s != step_i:  # stale sentinel from an aborted step
                 continue
             cts[m] = g
+            if next_m < M:  # 1F1B steady state: one fwd per bwd
+                inject(next_m)
+                next_m += 1
         # accumulate in MICROBATCH order (determinism), apply once
         acc = self._zeros(self.state.params)
         for m in range(M):
@@ -340,11 +416,15 @@ class PipelineRunner:
     # -- accounting ----------------------------------------------------- #
     def stage_report(self) -> List[Dict[str, Any]]:
         """Per remote stage: measured bubble fraction (1 - wire-busy /
-        driver wall), theoretical GPipe bubble, hop-reply p50, and the
-        stage's deferred-apply depth (over its own health endpoint —
-        transport-agnostic)."""
+        driver wall), the ideal bubble for BOTH schedules (the per-step
+        ideal coincides — see bubble_fraction — so measured-vs-ideal is
+        what separates them), the active schedule and its warmup depth,
+        hop-reply p50, and the stage's deferred-apply depth (over its
+        own health endpoint — transport-agnostic)."""
         S = self.plan.num_stages
         theo = bubble_fraction(self.microbatches, S)
+        warm = (self.microbatches if self.schedule == "gpipe"
+                else onefb_warmup(self.microbatches, S))
         out = []
         for i, t in enumerate(self.transports):
             fwd = self._fwd_workers[i]
@@ -362,9 +442,13 @@ class PipelineRunner:
                 pass
             out.append({
                 "stage": i + 1,
+                "schedule": self.schedule,
+                "warmup_depth": warm,
                 "bubble_fraction": (max(0.0, 1.0 - busy / self._wall_s)
                                     if self._wall_s > 0 else None),
                 "bubble_theoretical": theo,
+                "bubble_theoretical_gpipe": theo,
+                "bubble_theoretical_1f1b": theo,
                 "reply_p50_ms": p50 * 1e3,
                 "hop_calls": fwd.calls + (bwd.calls if bwd else 0),
                 "deferred_apply_depth": depth,
@@ -377,6 +461,12 @@ class PipelineRunner:
         return {
             "num_stages": self.plan.num_stages,
             "microbatches": self.microbatches,
+            "schedule": self.schedule,
+            "warmup_depth": (self.microbatches
+                             if self.schedule == "gpipe"
+                             else onefb_warmup(self.microbatches,
+                                               self.plan.num_stages)),
+            "device_native": self._device_native,
             "ticks_per_step": pipeline_ticks(self.microbatches,
                                              self.plan.num_stages),
             "steps": self.steps_done,

@@ -153,6 +153,12 @@ class StageRuntime:
             self._params_sharding = self._state_sharding.params
             self._batch_sharding = self._layout.batch()
             self.state = jax.device_put(self.state, self._state_sharding)
+        else:
+            # pin the stage's state to its device up front: device-native
+            # hop payloads arrive committed (transport/device.py), and a
+            # committed-ness flip after this stage's first apply would
+            # retrace every stage program on the next step
+            self.state = jax.device_put(self.state, jax.devices()[0])
         self._build_jitted()
 
         self._deferred = _DeferredApply(
@@ -257,8 +263,14 @@ class StageRuntime:
 
     # ------------------------------------------------------------------ #
     def _to_dev(self, x: Any) -> jax.Array:
+        # device-native hop payloads (transport/device.py, PR 16) arrive
+        # as jax.Arrays: device_put/jnp.asarray move or alias them
+        # device-to-device; np.asarray on one would force the very D2H
+        # the device transport exists to remove.
         if self._mesh is not None:
-            return jax.device_put(np.asarray(x), self._batch_sharding)
+            if not isinstance(x, jax.Array):
+                x = np.asarray(x)
+            return jax.device_put(x, self._batch_sharding)
         return jnp.asarray(x)
 
     def _check_seq(self, op: str, seq: int, client_id: int) -> None:
@@ -331,11 +343,19 @@ class StageRuntime:
 
     # -- the three hop ops --------------------------------------------- #
     def hop_forward(self, x: np.ndarray, step: int, mb: int = 0,
-                    client_id: int = 0) -> np.ndarray:
+                    client_id: int = 0, *,
+                    device: bool = False) -> np.ndarray:
         """Forward one microbatch through this stage; the (params, x)
         residual is pinned for the step's backward. On the last stage
         this is a residual-free plain forward (the loss hop is the
-        stateful one) — the chain's predict path."""
+        stateful one) — the chain's predict path.
+
+        ``device=True`` (the co-located DeviceTransport's calling
+        convention, PR 16) returns the reply as a jax.Array instead of
+        materializing it to host numpy: the driver relays the buffer to
+        the next stage zero-copy. Replay claims store whatever the
+        owner resolved, so duplicates are served the same device buffer
+        — exactly-once semantics are unchanged."""
         seq = hop_seq(step, mb)
         entry = None
         if self.replay is not None:
@@ -364,7 +384,9 @@ class StageRuntime:
                     rec["xs"][int(mb)] = x_dev
                 self._last_seq[(client_id, "hop_fwd")] = seq
                 self._hops["hop_fwd"] += 1
-            y_host = np.asarray(y)  # off the lock: overlap discipline
+            # off the lock: overlap discipline (device replies skip the
+            # materialization entirely — dispatch stays async)
+            y_host = y if device else np.asarray(y)
             if entry is not None:
                 self.replay.resolve(entry, y_host)
             if admitted:
@@ -387,10 +409,12 @@ class StageRuntime:
             raise
 
     def hop_backward(self, g_out: np.ndarray, step: int, mb: int = 0,
-                     client_id: int = 0) -> np.ndarray:
+                     client_id: int = 0, *,
+                     device: bool = False) -> np.ndarray:
         """2BP reply: return ``d(loss)/d(x)`` for one microbatch
         immediately from the pinned residual; queue the step's weight
-        update once its last cotangent lands."""
+        update once its last cotangent lands. ``device=True`` replies
+        the cotangent as a jax.Array (see hop_forward)."""
         if self.is_last:
             raise ProtocolError(
                 f"hop_backward on the last stage {self.stage_index}; "
@@ -425,7 +449,7 @@ class StageRuntime:
                 self._maybe_queue_apply(rec, "gs", client_id, step)
                 self._last_seq[(client_id, "hop_bwd")] = seq
                 self._hops["hop_bwd"] += 1
-            g_host = np.asarray(g_in)  # off the lock
+            g_host = g_in if device else np.asarray(g_in)  # off the lock
             if tr is not None:
                 rw = time.perf_counter() - t0
                 tr.record(spans.REPLY_GRAD, t0, rw,
@@ -447,10 +471,14 @@ class StageRuntime:
 
     def hop_loss(self, x: np.ndarray, labels: np.ndarray, step: int,
                  mb: int = 0,
-                 client_id: int = 0) -> Tuple[np.ndarray, float]:
+                 client_id: int = 0, *,
+                 device: bool = False) -> Tuple[np.ndarray, float]:
         """Last stage's fused hop: forward + per-microbatch CE; the
         (1/M-scaled) cut cotangent and the microbatch loss reply
-        immediately, the weight update defers."""
+        immediately, the weight update defers. ``device=True`` replies
+        (device cotangent, device loss scalar) — the sanctioned
+        loss-edge D2H then happens at the CALLER'S ``expected_d2h``
+        region (transport/device.py), not here."""
         if not self.is_last:
             raise ProtocolError(
                 f"hop_loss on non-last stage {self.stage_index}; only "
@@ -487,8 +515,8 @@ class StageRuntime:
                 self._maybe_queue_apply(rec, "ys", client_id, step)
                 self._last_seq[(client_id, "hop_loss")] = seq
                 self._hops["hop_loss"] += 1
-            g_host = np.asarray(g_x)  # off the lock
-            loss_f = float(loss)
+            g_host = g_x if device else np.asarray(g_x)  # off the lock
+            loss_f = loss if device else float(loss)
             if tr is not None:
                 rw = time.perf_counter() - t0
                 tr.record(spans.REPLY_GRAD, t0, rw,

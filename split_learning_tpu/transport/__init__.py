@@ -7,10 +7,12 @@ from split_learning_tpu.transport.base import (
     backoff_delays,
 )
 from split_learning_tpu.transport.chaos import ChaosPolicy, ChaosTransport
+from split_learning_tpu.transport.device import DeviceTransport
 from split_learning_tpu.transport.local import LocalTransport
 
 __all__ = [
     "Transport", "TransportError", "TransportStats",
     "FaultInjector", "FaultyTransport", "LocalTransport",
-    "ChaosPolicy", "ChaosTransport", "backoff_delays",
+    "ChaosPolicy", "ChaosTransport", "DeviceTransport",
+    "backoff_delays",
 ]

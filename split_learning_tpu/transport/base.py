@@ -158,6 +158,14 @@ class TransportStats:
 class Transport(abc.ABC):
     """Client-side handle to the server party."""
 
+    # Capability flag (PR 16): True only for transports whose pipeline
+    # hops accept and return DEVICE buffers (jax.Array) end to end —
+    # no host materialization, no codec round-trip. The PipelineRunner
+    # keeps its stage-0 payloads on device iff EVERY wire in the chain
+    # advertises it; everything else keeps the legacy host-numpy
+    # boundary documented in the module docstring.
+    device_native = False
+
     def __init__(self) -> None:
         self.stats = TransportStats()
 

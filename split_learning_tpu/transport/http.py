@@ -661,11 +661,18 @@ class HttpTransport(Transport):
                 f"mb={out.get('mb')}) does not echo request "
                 f"(step={step}, mb={mb})")
 
+    # hop payloads are host-bound by construction here (the codec
+    # frames numpy): 2 host materializations per hop — request encode +
+    # reply decode — counted under spans.HOP_HOST_COPIES so the
+    # co-located DeviceTransport's 0 has a measured contrast
+    # (device_native stays the base class's False).
+
     def hop_forward(self, x: np.ndarray, step: int, mb: int = 0,
                     client_id: int = 0) -> np.ndarray:
         self._hop_flight(True, "hop_fwd", step, mb,
                          client_id)
         with timed(self.stats):
+            self.stats.incr(spans.HOP_HOST_COPIES, 2)
             out = self._post("/hop_forward", {
                 "x": np.asarray(x), "step": step, "mb": int(mb),
                 "client_id": client_id})
@@ -679,6 +686,7 @@ class HttpTransport(Transport):
         self._hop_flight(True, "hop_bwd", step, mb,
                          client_id)
         with timed(self.stats):
+            self.stats.incr(spans.HOP_HOST_COPIES, 2)
             out = self._post("/hop_backward", {
                 "g": np.asarray(g_out), "step": step, "mb": int(mb),
                 "client_id": client_id})
@@ -693,6 +701,7 @@ class HttpTransport(Transport):
         self._hop_flight(True, "hop_loss", step, mb,
                          client_id)
         with timed(self.stats):
+            self.stats.incr(spans.HOP_HOST_COPIES, 2)
             out = self._post("/hop_loss", {
                 "x": np.asarray(x), "labels": np.asarray(labels),
                 "step": step, "mb": int(mb), "client_id": client_id})

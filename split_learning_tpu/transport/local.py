@@ -55,6 +55,18 @@ class LocalTransport(Transport):
     def _roundtrip(self, obj: Any) -> Any:
         return codec.decode(codec.encode(obj)) if self.through_codec else obj
 
+    def _hop_payload(self, obj: Any) -> Any:
+        """Hop payloads on the default path (``through_codec=False``,
+        ``compress=None``) pass through UNTOUCHED — no ``np.asarray``,
+        no codec round-trip (PR 16 satellite): the in-process peer takes
+        the caller's buffer as-is and byte accounting is unchanged
+        (hops never counted wire bytes). With ``through_codec`` the full
+        encode/decode path still runs per hop, host-materializing first
+        exactly as before."""
+        if not self.through_codec and self.compress is None:
+            return obj
+        return self._roundtrip(np.asarray(obj))
+
     # -- wire emulation (compress != None) ------------------------------
     def _pack_up(self, arr: np.ndarray, key: Any) -> Any:
         if self.compress == "int8":
@@ -268,7 +280,7 @@ class LocalTransport(Transport):
                          client_id)
         with timed(self.stats):
             y = self._call(self.server.hop_forward,
-                           self._roundtrip(np.asarray(x)), step, mb,
+                           self._hop_payload(x), step, mb,
                            client_id)
             res = self._roundtrip(y)
         self._hop_flight(False, "hop_fwd", step, mb,
@@ -281,7 +293,7 @@ class LocalTransport(Transport):
                          client_id)
         with timed(self.stats):
             g = self._call(self.server.hop_backward,
-                           self._roundtrip(np.asarray(g_out)), step, mb,
+                           self._hop_payload(g_out), step, mb,
                            client_id)
             res = self._roundtrip(g)
         self._hop_flight(False, "hop_bwd", step, mb,
@@ -295,8 +307,8 @@ class LocalTransport(Transport):
                          client_id)
         with timed(self.stats):
             g, loss = self._call(self.server.hop_loss,
-                                 self._roundtrip(np.asarray(x)),
-                                 self._roundtrip(np.asarray(labels)),
+                                 self._hop_payload(x),
+                                 self._hop_payload(labels),
                                  step, mb, client_id)
             res = self._roundtrip(g), float(loss)
         self._hop_flight(False, "hop_loss", step, mb,

@@ -44,6 +44,7 @@ _ENV_MAP = {
     "num_clients": "SLT_NUM_CLIENTS",
     "num_stages": "SLT_NUM_STAGES",
     "microbatches": "SLT_MICROBATCHES",
+    "schedule": "SLT_SCHEDULE",
     "remat": "SLT_REMAT",
     "model_parallel": "SLT_MODEL_PARALLEL",
     "seq_parallel": "SLT_SEQ_PARALLEL",
@@ -93,6 +94,9 @@ class Config:
     seq_parallel: int = 1     # context-parallel shards (mesh "seq" axis)
     attn: str = "full"        # "full"|"flash"|"auto"|"ring"|"ring_flash"|"ulysses" (transformer)
     microbatches: int = 1     # GPipe microbatches per step
+    # MPMD chain injection schedule: "gpipe" (all M in flight) |
+    # "1f1b" (warmup min(S, M) then 1-forward-1-backward steady state)
+    schedule: str = "gpipe"
     remat: bool = False       # jax.checkpoint stage forwards (FLOPs for HBM)
 
     # hot-path op implementation: "xla" (let the compiler fuse) or
@@ -147,6 +151,10 @@ class Config:
             raise ValueError("batch_size and epochs must be positive")
         if self.microbatches <= 0:
             raise ValueError("microbatches must be positive")
+        if self.schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"Unknown pipeline schedule: {self.schedule!r} "
+                "(expected 'gpipe' or '1f1b')")
         if self.batch_size % self.microbatches != 0:
             raise ValueError("batch_size must be divisible by microbatches")
         if self.kernels not in ("xla", "pallas"):
