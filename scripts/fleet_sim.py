@@ -44,6 +44,9 @@ import numpy as np  # noqa: E402
 
 from split_learning_tpu.models import get_plan  # noqa: E402
 from split_learning_tpu.obs import dispatch_debug  # noqa: E402
+from split_learning_tpu.obs import spans  # noqa: E402
+from split_learning_tpu.obs import telemetry as obs_telemetry  # noqa: E402
+from split_learning_tpu.obs import trace as obs_trace  # noqa: E402
 from split_learning_tpu.obs.metrics import histogram_percentile  # noqa: E402
 from split_learning_tpu.runtime.fleet import (  # noqa: E402
     FleetConfig, run_fleet, warm_fleet)
@@ -188,6 +191,83 @@ def replication_summary(args, group, res):
     return block
 
 
+def setup_telemetry(args, server):
+    """Install a TelemetryRing over the server's (or replica group's)
+    metrics() when ``--telemetry`` or SLT_TELEMETRY asks for one.
+    Telemetry implies tracing — the windows' percentiles come from the
+    tracer-gated histograms. Returns the ring or None (off)."""
+    cfg = obs_telemetry.env_config()
+    if cfg is None and not args.telemetry:
+        return None
+    if cfg is None:
+        cfg = {"interval_s": obs_telemetry.DEFAULT_INTERVAL_S,
+               "capacity": obs_telemetry.DEFAULT_CAPACITY}
+    if args.telemetry_interval_s is not None:
+        cfg["interval_s"] = float(args.telemetry_interval_s)
+    # --slo-ms already names the per-tenant objective the EDF scheduler
+    # chases; reuse it as the burn-rate objective so the two agree
+    if args.slo_ms and "slo_ms" not in cfg:
+        cfg["slo_ms"] = float(args.slo_ms)
+    if obs_trace.get_tracer() is None:
+        obs_trace.enable()
+    ring = obs_telemetry.enable(
+        server.metrics, party="server",
+        interval_s=cfg["interval_s"], capacity=cfg["capacity"],
+        slo=obs_telemetry.tracker_from_config(cfg, tenants=args.tenants))
+    ring.start_sampler()
+    return ring
+
+
+def telemetry_summary(args, ring):
+    """The ``telemetry`` block: windowed dispatch-p99 trajectory,
+    burn-rate peak and a phase-level bottleneck histogram (queue-wait
+    vs compute per window — the single-party analogue of the fleet
+    critical path in obs/federate.py). Schema is stable across arms:
+    a run without --telemetry reports the same keys with a false
+    ``enabled``, empty trajectory/histogram and null peak, so the
+    bench contract and twin-run diffs never branch on shape."""
+    block = {
+        "enabled": ring is not None,
+        "interval_s": None,
+        "windows": 0,
+        "p99_ms_trajectory": [],
+        "burn_peak": None,
+        "slo_alerts": [],
+        "bottleneck_histogram": {},
+    }
+    if ring is None:
+        return block
+    ring.advance(force=True)   # close the in-progress window
+    windows = ring.windows()
+    block["interval_s"] = ring.interval_s
+    block["windows"] = len(windows)
+    burn_peak = None
+    for w in windows:
+        pct = w.get("percentiles", {}).get(spans.DISPATCH)
+        block["p99_ms_trajectory"].append(
+            round(pct["p99"], 3) if pct else None)
+        for name, v in w.get("gauges", {}).items():
+            if name.startswith(spans.SLO_BURN_FAST):
+                burn_peak = v if burn_peak is None else max(burn_peak, v)
+        # phase-level bottleneck: where did this window's time go?
+        hists = w.get("histograms", {})
+        shares = {
+            "queue_wait": float(
+                hists.get(spans.QUEUE_WAIT, {}).get("sum", 0.0)),
+            "compute": float(
+                hists.get(spans.DISPATCH, {}).get("sum", 0.0)),
+        }
+        if any(v > 0 for v in shares.values()):
+            kind = max(shares, key=lambda k: shares[k])
+            block["bottleneck_histogram"][kind] = (
+                block["bottleneck_histogram"].get(kind, 0) + 1)
+    block["burn_peak"] = (None if burn_peak is None
+                          else round(burn_peak, 4))
+    if ring.slo is not None:
+        block["slo_alerts"] = ring.slo.alerts()
+    return block
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--clients", type=int, default=64)
@@ -229,6 +309,13 @@ def main() -> int:
     ap.add_argument("--gate-dropped-steps", action="store_true",
                     help="exit 1 unless dropped_steps == 0 and every "
                          "scheduled step completed")
+    ap.add_argument("--telemetry", action="store_true",
+                    help="windowed telemetry ring over the server "
+                         "(also via SLT_TELEMETRY=1); adds the "
+                         "``telemetry`` summary block")
+    ap.add_argument("--telemetry-interval-s", type=float, default=None,
+                    help="telemetry window width in seconds "
+                         "(default SLT_TELEMETRY_INTERVAL_S or 1.0)")
     args = ap.parse_args()
     if args.kill_replica_at > 0 and args.replicas < 2:
         print("[fleet_sim] --kill-replica-at needs --replicas > 1",
@@ -246,6 +333,8 @@ def main() -> int:
         kill_replica_at=args.kill_replica_at)
 
     dispatch_debug.force(True)
+    tracer_was_on = obs_trace.get_tracer() is not None
+    ring = setup_telemetry(args, server)
     try:
         warm_rounds = 0
         if not args.no_warm:
@@ -257,8 +346,13 @@ def main() -> int:
         compiles_after = compile_count(server, group)
         replay = replay_counters(server, group)
         replication = replication_summary(args, group, res)
+        telemetry = telemetry_summary(args, ring)
     finally:
         dispatch_debug.force(False)
+        if ring is not None:
+            obs_telemetry.disable()
+            if not tracer_was_on:
+                obs_trace.disable()
         server.close()
 
     expected = args.clients * args.steps
@@ -321,6 +415,7 @@ def main() -> int:
         "utilization": utilization,
         "replay": replay,
         "replication": replication,
+        "telemetry": telemetry,
     }
     print(json.dumps(summary, indent=1))
 

@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""slt_top — curses-free, pipe-friendly live fleet telemetry dashboard.
+
+Scrapes every named party's ``GET /telemetry`` (obs/telemetry.py ring
+dumps) — or reads saved dump files — through obs/federate.py's
+FleetCollector and renders one plain-text frame per interval: fleet
+rates, per-party occupancy/percentiles, SLO burn, and the critical-path
+bottleneck party. No terminal control sequences, ever: frames append,
+so ``slt_top | tee``, a CI log, or a dumb pipe all read the same thing
+a human at a TTY does.
+
+Sources (positional, any mix):
+
+* ``http://host:port``            — scraped live (``/telemetry`` added)
+* ``hub=http://host:port``        — with an explicit party name
+* ``stage2=http://host:port``     — ``stage<N>`` names set the stage
+* ``server.r1=http://host:port``  — ``.r<K>`` suffixes set the replica
+* ``dump.json``                   — a saved ``/telemetry`` response
+  body; the party name comes from the dump's own ``party`` field
+
+Usage::
+
+    python scripts/slt_top.py hub=http://127.0.0.1:9100 \\
+        stage1=http://127.0.0.1:8471 stage2=http://127.0.0.1:8472
+    python scripts/slt_top.py --once hub.json stage1.json stage2.json
+
+``--once`` renders a single frame and exits (the CI smoke gate);
+``--json`` emits the raw fleet view as one JSON object per frame
+instead of the table (machine consumers).
+
+Stdlib-only: importable and runnable on a box with no jax installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # runnable as a file, not only as a module
+    sys.path.insert(0, _REPO)
+
+from split_learning_tpu.obs import spans  # noqa: E402
+from split_learning_tpu.obs.federate import FleetCollector  # noqa: E402
+
+_NAME_RE = re.compile(
+    r"^(?P<role>hub|server|stage)(?P<stage>\d+)?(?:\.r(?P<replica>\d+))?$")
+
+# the fleet-rate counters the header line surfaces, (label, names) —
+# first matching name wins per party (server vs stage vs hub naming)
+_HEADLINE_RATES = (
+    ("steps/s", ("split_steps_total", "hub_steps_total")),
+    ("hops/s", ("hop_fwd_total", "hop_bwd_total", "hop_loss_total")),
+    ("admits/s", (spans.ADMISSION_ADMITTED,)),
+    ("rejects/s", (spans.ADMISSION_REJECTED,)),
+)
+
+
+def parse_source(src: str) -> dict:
+    """One CLI source -> a FleetCollector party spec."""
+    name = None
+    if "=" in src and not src.split("=", 1)[0].startswith("http"):
+        name, src = src.split("=", 1)
+    party: dict = {}
+    if src.startswith("http://") or src.startswith("https://"):
+        party["url"] = src
+    else:
+        with open(src) as f:
+            party["dump"] = json.load(f)
+        if name is None:
+            name = str(party["dump"].get("party", "server"))
+    role, stage, replica = "server", None, None
+    if name:
+        m = _NAME_RE.match(name.strip())
+        if m is None:
+            raise SystemExit(
+                f"bad party name {name!r} (want hub / server[.rK] / "
+                f"stage<N>[.rK])")
+        role = m.group("role")
+        stage = int(m.group("stage")) if m.group("stage") else None
+        replica = int(m.group("replica")) if m.group("replica") else None
+    party.update({"role": role, "stage": stage, "replica": replica})
+    return party
+
+
+def _fmt_rate(v) -> str:
+    return f"{v:8.2f}" if isinstance(v, (int, float)) else f"{'-':>8}"
+
+
+def _party_rate(info: dict, names: tuple) -> float:
+    return sum(float(info.get("rates", {}).get(n, 0.0)) for n in names)
+
+
+def render(view: dict, frame: int) -> str:
+    """One frame of the dashboard from a FleetCollector.collect() view."""
+    lines = [f"== slt_top frame {frame} "
+             f"({len(view.get('parties', {}))} parties) =="]
+    # fleet headline: summed rates across every party's latest window
+    head = []
+    for label, names in _HEADLINE_RATES:
+        total = sum(float(view.get("fleet_rates", {}).get(n, 0.0))
+                    for n in names)
+        head.append(f"{label}={total:.2f}")
+    lines.append("fleet: " + "  ".join(head))
+    lines.append(f"{'party':<12} {'win':>4} {'steps/s':>8} {'hops/s':>8} "
+                 f"{'p99 ms':>8} {'queue':>6} {'burn f/s':>10}")
+    for key in sorted(view.get("parties", {})):
+        info = view["parties"][key]
+        if info.get("error"):
+            lines.append(f"{key:<12} DEAD: {info['error']}")
+            continue
+        pct = info.get("percentiles", {})
+        p99 = None
+        for hist in (spans.STEP_TOTAL, spans.DISPATCH, spans.REPLY_GRAD):
+            if hist in pct:
+                p99 = pct[hist].get("p99")
+                break
+        gauges = info.get("gauges", {})
+        queue = sum(v for k, v in gauges.items()
+                    if k.startswith(spans.ADMISSION_QUEUE_DEPTH))
+        burns = [v for k, v in view.get("slo_burn", {}).items()
+                 if k.startswith(f"{key}:")]
+        burn = (f"{max(burns):.2f}" if burns else "-")
+        p99_str = f"{p99:8.2f}" if p99 is not None else f"{'-':>8}"
+        lines.append(
+            f"{key:<12} {info.get('windows', 0):>4} "
+            f"{_fmt_rate(_party_rate(info, _HEADLINE_RATES[0][1]))} "
+            f"{_fmt_rate(_party_rate(info, _HEADLINE_RATES[1][1]))} "
+            f"{p99_str} {queue:>6.0f} {burn:>10}")
+    cp = view.get("critical_path") or []
+    if cp:
+        last = cp[-1]
+        b = last["bottleneck"]
+        lines.append(
+            f"bottleneck: {b['party']} ({b['kind']}) "
+            f"share={b['share']:.2f} over {len(cp)} attributed windows")
+        counts = view.get("bottlenecks") or {}
+        if counts:
+            hist = "  ".join(f"{k}:{v}" for k, v in
+                             sorted(counts.items(),
+                                    key=lambda kv: -kv[1]))
+            lines.append(f"bottleneck histogram: {hist}")
+    firing = view.get("slo_firing") or []
+    lines.append("SLO firing: " + (json.dumps(firing) if firing
+                                   else "none"))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("sources", nargs="+",
+                    help="party sources: [name=]URL or dump.json "
+                         "(names: hub, server[.rK], stage<N>[.rK])")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit (CI mode)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="seconds between frames (default 2)")
+    ap.add_argument("--frames", type=int, default=0,
+                    help="stop after N frames (0 = until interrupted)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw fleet view as JSON per frame")
+    ap.add_argument("--timeout", type=float, default=5.0,
+                    help="per-party scrape timeout in seconds")
+    args = ap.parse_args(argv)
+
+    collector = FleetCollector([parse_source(s) for s in args.sources],
+                               timeout_s=args.timeout)
+    frame = 0
+    view: dict = {}
+    try:
+        while True:
+            view = collector.collect()
+            frame += 1
+            if args.json:
+                print(json.dumps(view))
+            else:
+                print(render(view, frame))
+            sys.stdout.flush()
+            if args.once or (args.frames and frame >= args.frames):
+                break
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    # a frame where every party failed to scrape is a failure in --once
+    # mode (the CI gate must notice a dead fleet, not print a sad table)
+    parties = view.get("parties", {})
+    if args.once and parties and all(
+            p.get("error") for p in parties.values()):
+        print("[slt_top] every party failed to scrape", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -546,6 +546,41 @@ def cmd_train(args) -> int:
         runner = PipelineRunner(plan, cfg, rng, sample, transports,
                                 microbatches=M, schedule=cfg.schedule)
 
+        # telemetry plane (PR 17): the hub is a party too — give it a
+        # windowed ring over its own step/hop registry and (with
+        # --telemetry-port) a /telemetry endpoint the FleetCollector
+        # scrapes alongside the stage parties'. Off (no SLT_TELEMETRY,
+        # no port) = zero overhead, loss series bit-for-bit legacy.
+        from split_learning_tpu.obs import telemetry as obs_telemetry
+        hub_ring = None
+        hub_tel_srv = None
+        tel_port = getattr(args, "telemetry_port", None)
+        tel_cfg = obs_telemetry.env_config()
+        if tel_cfg is None and tel_port is not None:
+            tel_cfg = {"interval_s": obs_telemetry.DEFAULT_INTERVAL_S,
+                       "capacity": obs_telemetry.DEFAULT_CAPACITY}
+        if tel_cfg is not None:
+            from split_learning_tpu import obs
+            from split_learning_tpu.obs import federate as obs_federate
+            from split_learning_tpu.obs.metrics import Registry
+            if obs.get_tracer() is None:
+                # windows derive their percentiles from the tracer-gated
+                # histograms; telemetry on implies tracing on
+                obs.enable()
+            hub_reg = Registry()
+            runner.telemetry_registry = hub_reg
+            hub_ring = obs_telemetry.enable(
+                hub_reg.snapshot, party="hub",
+                interval_s=tel_cfg["interval_s"],
+                capacity=tel_cfg["capacity"],
+                slo=obs_telemetry.tracker_from_config(tel_cfg))
+            if tel_port is not None:
+                hub_tel_srv, _ = obs_federate.serve_telemetry(
+                    hub_ring, port=int(tel_port))
+                print(f"[telemetry] hub /telemetry on port "
+                      f"{hub_tel_srv.server_address[1]}", file=sys.stderr)
+            hub_ring.start_sampler()
+
         start_step = 0
         if ckptr is not None:
             _write_ckpt_meta(cfg.checkpoint_dir, "chain", cfg, size_kw,
@@ -607,6 +642,11 @@ def cmd_train(args) -> int:
                     save_chain(step)
         finally:
             chain_meta = runner.trace_metadata()
+            if hub_ring is not None:
+                hub_ring.advance(force=True)  # close the last window
+                if hub_tel_srv is not None:
+                    hub_tel_srv.shutdown()
+                obs_telemetry.disable()
             runner.close()
             for t in transports:
                 close = getattr(t, "close", None)
@@ -1371,10 +1411,44 @@ def cmd_serve(args) -> int:
         print(f"[chaos] injecting {args.chaos!r} "
               f"(seed {chaos_policy.seed}) server-side", file=sys.stderr)
 
+    # telemetry plane (PR 17): --telemetry (or SLT_TELEMETRY) hangs a
+    # windowed ring off this party's metrics() and serves it on
+    # GET /telemetry; CLI flags win over the env knobs. Telemetry
+    # implies tracing (the windows' percentiles come from the
+    # tracer-gated histograms). Off = the legacy routes, bit-for-bit.
+    from split_learning_tpu.obs import telemetry as obs_telemetry
+    telemetry_ring = None
+    tel_cfg = obs_telemetry.env_config()
+    if tel_cfg is None and getattr(args, "telemetry", False):
+        tel_cfg = {"interval_s": obs_telemetry.DEFAULT_INTERVAL_S,
+                   "capacity": obs_telemetry.DEFAULT_CAPACITY}
+    if tel_cfg is not None:
+        if getattr(args, "telemetry_interval_s", None):
+            tel_cfg["interval_s"] = float(args.telemetry_interval_s)
+        if getattr(args, "telemetry_slo_ms", None):
+            tel_cfg["slo_ms"] = float(args.telemetry_slo_ms)
+        if step_tracer is None:
+            from split_learning_tpu import obs
+            if obs.get_tracer() is None:
+                obs.enable()
+        party = (f"stage{getattr(args, 'stage_index', 1) or 1}"
+                 if role == "stage" else "server")
+        telemetry_ring = obs_telemetry.enable(
+            runtime.metrics, party=party,
+            interval_s=tel_cfg["interval_s"],
+            capacity=tel_cfg["capacity"],
+            slo=obs_telemetry.tracker_from_config(
+                tel_cfg, tenants=getattr(args, "tenants", 1) or 1))
+        telemetry_ring.start_sampler()
+        print(f"[telemetry] windowed ring on: GET /telemetry "
+              f"(interval {tel_cfg['interval_s']}s, "
+              f"capacity {tel_cfg['capacity']})", file=sys.stderr)
+
     server = SplitHTTPServer(runtime, host=args.host, port=args.port,
                              compress=args.compress or "none",
                              density=args.compress_density,
-                             chaos=chaos_policy).start()
+                             chaos=chaos_policy,
+                             telemetry=telemetry_ring).start()
     print(f"[serve] mode={cfg.mode} role={role} listening on {server.url}")
     try:
         while True:
@@ -1383,6 +1457,9 @@ def cmd_serve(args) -> int:
         print("[serve] shutting down")
         server.stop()
     finally:
+        if telemetry_ring is not None:
+            telemetry_ring.advance(force=True)
+            obs_telemetry.disable()
         runtime.close()  # flush + join the coalescer, if one is running
         if step_tracer is not None:
             from split_learning_tpu import obs
@@ -1680,6 +1757,14 @@ def main(argv: Optional[list] = None) -> int:
                          "trace JSON here on exit (Perfetto-loadable; "
                          "summarize with scripts/trace_report.py). Off = "
                          "zero overhead")
+    pt.add_argument("--telemetry-port", dest="telemetry_port", type=int,
+                    default=None,
+                    help="MPMD chain only: serve the hub's windowed "
+                         "telemetry ring on this port's GET /telemetry "
+                         "(0 = ephemeral), so obs/federate.py's "
+                         "FleetCollector can scrape hub + stages as one "
+                         "fleet; also turns telemetry on for this run "
+                         "(SLT_TELEMETRY=1 does too, without the port)")
     pt.add_argument("--flight", default=None, metavar="PATH",
                     help="flight recorder (obs/flight.py): journal causal "
                          "runtime events into a bounded ring and dump "
@@ -1968,6 +2053,21 @@ def main(argv: Optional[list] = None) -> int:
                          "server events; dump JSON here on shutdown / "
                          "SIGTERM / watchdog trip, or fetch the live ring "
                          "via GET /debug/flight. Off = zero overhead")
+    ps.add_argument("--telemetry", action="store_true",
+                    help="telemetry plane (obs/telemetry.py): windowed "
+                         "rates/percentiles ring served on GET /telemetry "
+                         "(implies tracing; SLT_TELEMETRY=1 is the env "
+                         "twin). Off = the legacy routes, zero overhead")
+    ps.add_argument("--telemetry-interval-s", dest="telemetry_interval_s",
+                    type=float, default=None,
+                    help="telemetry window width in seconds (default "
+                         "1.0; env twin SLT_TELEMETRY_INTERVAL_S)")
+    ps.add_argument("--telemetry-slo-ms", dest="telemetry_slo_ms",
+                    type=float, default=None,
+                    help="per-tenant latency SLO for the burn-rate "
+                         "tracker (enables slt_slo_burn_rate_* gauges "
+                         "and fl_slo_alert flight events; env twin "
+                         "SLT_TELEMETRY_SLO_MS)")
     ps.set_defaults(fn=cmd_serve)
 
     pe = sub.add_parser("eval", help="evaluate a checkpoint on the test split")

@@ -122,14 +122,16 @@ def histogram_percentile(hist_snapshot: Dict[str, Any], q: float) -> float:
     the +Inf slot clamps to the last finite bound (the estimate is a
     floor there, like Prometheus's). Used by bench gates that compare
     e.g. ``lock_hold`` p50 against the old-taxonomy ``dispatch`` p50."""
-    total = int(hist_snapshot.get("count", 0))
-    if total <= 0:
-        return 0.0
     if not 0.0 <= q <= 100.0:
         raise ValueError(f"percentile must be in [0, 100] (got {q})")
+    total = int(hist_snapshot.get("count", 0))
+    buckets = tuple(hist_snapshot.get("buckets") or ())
+    cumulative = list(hist_snapshot.get("cumulative") or ())
+    # empty-delta windows (obs/telemetry.py bucket subtraction) hand us
+    # count == 0 or bare bucket arrays — the answer is 0.0, never NaN
+    if total <= 0 or not buckets or not cumulative:
+        return 0.0
     rank = q / 100.0 * total
-    buckets = hist_snapshot["buckets"]
-    cumulative = hist_snapshot["cumulative"]
     prev_cum, prev_le = 0, 0.0
     for le, cum in zip(buckets, cumulative):
         if cum >= rank:
@@ -141,6 +143,38 @@ def histogram_percentile(hist_snapshot: Dict[str, Any], q: float) -> float:
     return float(buckets[-1])  # +Inf slot: clamp to last finite bound
 
 
+def histogram_delta(cur: Dict[str, Any],
+                    prev: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Windowed difference of two cumulative histogram snapshots
+    (:meth:`Histogram.snapshot` shape) -> a snapshot-shaped dict whose
+    counts cover only the window, feedable straight back into
+    :func:`histogram_percentile` for rolling p50/p95/p99.
+
+    Counter-reset tolerant: a party restart mid-scrape makes ``cur``
+    smaller than ``prev`` in count or any bucket — the only consistent
+    window then is "everything since the restart", so the delta falls
+    back to ``cur`` itself (Prometheus ``rate()`` convention). A
+    ``prev`` of None (first window) behaves the same way."""
+    cur_cum = list(cur.get("cumulative") or ())
+    if prev is None:
+        return {"buckets": tuple(cur.get("buckets") or ()),
+                "cumulative": cur_cum,
+                "sum": float(cur.get("sum", 0.0)),
+                "count": int(cur.get("count", 0))}
+    prev_cum = list(prev.get("cumulative") or ())
+    d_count = int(cur.get("count", 0)) - int(prev.get("count", 0))
+    reset = (d_count < 0 or len(prev_cum) > len(cur_cum)
+             or any(c < p for c, p in zip(cur_cum, prev_cum)))
+    if reset:
+        return histogram_delta(cur, None)
+    prev_cum += [0] * (len(cur_cum) - len(prev_cum))
+    return {"buckets": tuple(cur.get("buckets") or ()),
+            "cumulative": [c - p for c, p in zip(cur_cum, prev_cum)],
+            "sum": max(float(cur.get("sum", 0.0))
+                       - float(prev.get("sum", 0.0)), 0.0),
+            "count": d_count}
+
+
 def _sanitize(name: str) -> str:
     out = "".join(c if (c.isalnum() or c in "_:") else "_" for c in name)
     return out if not out[:1].isdigit() else "_" + out
@@ -148,6 +182,21 @@ def _sanitize(name: str) -> str:
 
 def _fmt(v: float) -> str:
     return f"{float(v):.9g}"
+
+
+def escape_label_value(value: str) -> str:
+    """Prometheus text-exposition label-value escaping: backslash,
+    double quote and newline (in that order — escaping the backslash
+    first keeps the other two unambiguous)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt_labels(labels: Dict[str, str]) -> str:
+    inner = ",".join(
+        f'{_sanitize(k)}="{escape_label_value(v)}"'
+        for k, v in sorted(labels.items()))
+    return "{" + inner + "}" if inner else ""
 
 
 def render_prometheus(snapshot: Dict[str, Any], prefix: str = "slt") -> str:
@@ -173,12 +222,31 @@ def render_prometheus(snapshot: Dict[str, Any], prefix: str = "slt") -> str:
         for name, frac in sorted(fractions.items()):
             lines.append(
                 f'{metric}{{phase="{_sanitize(name)}"}} {_fmt(frac)}')
+    typed_seen = set()
     for name, v in sorted(snapshot.get("counters", {}).items()):
         metric = f"{prefix}_{_sanitize(name)}"
+        typed_seen.add(metric)
         lines.append(f"# TYPE {metric} counter")
         lines.append(f"{metric} {_fmt(v)}")
     for name, v in sorted(snapshot.get("gauges", {}).items()):
         metric = f"{prefix}_{_sanitize(name)}"
+        typed_seen.add(metric)
         lines.append(f"# TYPE {metric} gauge")
         lines.append(f"{metric} {_fmt(v)}")
+    # labeled series (ReplicaGroup.metrics() per-replica dimension):
+    # [{"name", "labels": {k: v}, "value", "type"?}, ...]. One TYPE
+    # header per metric name (skipped when the un-labeled section
+    # already declared it), series in (name, labels) order.
+    labeled = snapshot.get("labeled") or []
+    for entry in sorted(labeled,
+                        key=lambda e: (e["name"],
+                                       sorted(e.get("labels", {}).items()))):
+        metric = f"{prefix}_{_sanitize(entry['name'])}"
+        if metric not in typed_seen:
+            typed_seen.add(metric)
+            lines.append(
+                f"# TYPE {metric} {entry.get('type', 'counter')}")
+        lines.append(
+            f"{metric}{_fmt_labels(entry.get('labels', {}))} "
+            f"{_fmt(entry['value'])}")
     return "\n".join(lines) + "\n"

@@ -148,6 +148,11 @@ FL_ROUTE = "fl_route"                    # client -> replica assignment made
 FL_REPLICA_DEATH = "fl_replica_death"    # replica declared dead (breaker open)
 FL_HANDOFF_BEGIN = "fl_handoff_begin"    # failover handoff started (quiesce)
 FL_HANDOFF_COMMIT = "fl_handoff_commit"  # state merged; clients rerouted
+# telemetry plane (PR 17): an SLO burn-rate alert transitioned. Carries
+# ``tenant``, ``objective`` ("latency"/"availability"), ``state``
+# ("firing"/"cleared") and both window burn rates, so a postmortem can
+# line the alert up against the admission/dispatch events that caused it.
+FL_SLO_ALERT = "fl_slo_alert"            # SLO burn-rate alert fired/cleared
 
 # metrics-histogram-only names for the replica router (never trace
 # spans — both windows sit inside a client's ``transport`` span and
@@ -164,7 +169,14 @@ FLIGHT_EVENTS = (
     FL_CKPT_LINEAGE, FL_GATHER, FL_SEND, FL_RECV, FL_CLOSE,
     FL_WATCHDOG_TRIP, FL_FATAL, FL_HOP_SEND, FL_HOP_RECV,
     FL_STAGE_REPLY, FL_ROUTE, FL_REPLICA_DEATH, FL_HANDOFF_BEGIN,
-    FL_HANDOFF_COMMIT)
+    FL_HANDOFF_COMMIT, FL_SLO_ALERT)
+
+# -- telemetry plane (obs/telemetry.py, PR 17) ------------------------- #
+# metrics-gauge-only names (the admission_* precedent — never trace
+# spans): the multi-window SLO burn rates the SLOTracker publishes per
+# tenant (render_prometheus adds the slt_ prefix -> slt_slo_burn_rate_*).
+SLO_BURN_FAST = "slo_burn_rate_fast"
+SLO_BURN_SLOW = "slo_burn_rate_slow"
 
 # the client-level phases that tile a step — the denominator of the
 # compute-vs-wire fraction (encode/wire are sub-phases of transport and

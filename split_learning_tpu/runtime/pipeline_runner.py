@@ -69,6 +69,7 @@ import numpy as np
 
 from split_learning_tpu.core.stage import SplitPlan
 from split_learning_tpu.obs import dispatch_debug as obs_dispatch
+from split_learning_tpu.obs import spans
 from split_learning_tpu.runtime.server import ProtocolError
 from split_learning_tpu.runtime.state import (
     TrainState, apply_grads, make_state, make_tx)
@@ -137,6 +138,9 @@ class _HopWorker(threading.Thread):
                 self.busy_s += dt
                 self.calls += 1
                 self.durations.append(dt)
+                reg = self._runner.telemetry_registry
+                if reg is not None:  # telemetry plane (PR 17), off=None
+                    reg.observe(spans.WIRE, dt)
             except BaseException as exc:  # noqa: BLE001 — parked, re-raised
                 self._runner._park_error(exc)
 
@@ -213,6 +217,11 @@ class PipelineRunner:
         self._spawn_workers()
         self.steps_done = 0
         self._wall_s = 0.0
+        # telemetry plane (PR 17): an obs.metrics.Registry the hub's
+        # TelemetryRing snapshots — attached by the launcher/bench when
+        # telemetry is on, None otherwise (zero-overhead-off: the only
+        # cost when off is this None check per hop/step)
+        self.telemetry_registry = None
 
     # ------------------------------------------------------------------ #
     def _build_jitted(self) -> None:
@@ -400,7 +409,12 @@ class PipelineRunner:
         with self._err_lock:
             losses = [self._losses.pop((step_i, m)) for m in range(M)]
         self.steps_done += 1
-        self._wall_s += time.perf_counter() - t_wall0
+        step_wall = time.perf_counter() - t_wall0
+        self._wall_s += step_wall
+        reg = self.telemetry_registry
+        if reg is not None:  # telemetry plane (PR 17), off=None
+            reg.observe(spans.STEP_TOTAL, step_wall)
+            reg.incr("hub_steps_total")
         return float(np.mean(losses))
 
     def predict(self, x: np.ndarray) -> np.ndarray:

@@ -519,16 +519,28 @@ class ReplicaGroup:
 
     def metrics(self) -> Dict[str, Any]:
         """Group registry snapshot (re-route/handoff histograms + router
-        counters) with every replica's counters summed in. Per-replica
-        detail stays on ``replicas[i].metrics()`` (fleet_sim reports
-        it)."""
+        counters) with every replica's counters summed into group-level
+        totals AND broken out as ``labeled`` series carrying a
+        ``replica`` label dimension (render_prometheus emits
+        ``slt_<name>{replica="<i>"} v`` — a scraper sees both the group
+        aggregate and the per-replica split from one scrape, instead of
+        the pre-PR-17 replica-0-only view). Per-replica gauges ride the
+        same label."""
         snap = self.registry.snapshot()
         for name, value in self.counters().items():
             snap["counters"][f"{name}_total"] = float(value)
+        labeled = snap.setdefault("labeled", [])
         for idx in self.live_replicas():
             sub = self._slots[idx].runtime.metrics()
             for k, v in sub.get("counters", {}).items():
                 snap["counters"][k] = snap["counters"].get(k, 0.0) + v
+                labeled.append({"name": k, "type": "counter",
+                                "labels": {"replica": str(idx)},
+                                "value": float(v)})
+            for k, v in sub.get("gauges", {}).items():
+                labeled.append({"name": k, "type": "gauge",
+                                "labels": {"replica": str(idx)},
+                                "value": float(v)})
         return snap
 
     def counters(self) -> Dict[str, float]:

@@ -62,6 +62,7 @@ from split_learning_tpu.obs import flight as obs_flight
 from split_learning_tpu.obs import locks as obs_locks
 from split_learning_tpu.obs import spans
 from split_learning_tpu.obs import trace as obs_trace
+from split_learning_tpu.obs.metrics import Registry
 from split_learning_tpu.parallel.distributed import server_state_layout
 from split_learning_tpu.runtime.admission import AdmissionController
 from split_learning_tpu.runtime.replay import ReplayCache
@@ -134,7 +135,12 @@ class StageRuntime:
         self.is_last = self.stage_index == plan.num_stages - 1
         self.party = f"stage{self.stage_index}"
 
-        self._lock = obs_locks.make_lock("StageRuntime._lock")
+        # first-class observability (PR 17): stages expose the same
+        # Registry-backed /metrics + /telemetry surface the 2-party
+        # server does; the lock feeds lock_hold into it when tracing
+        self._metrics = Registry()
+        self._lock = obs_locks.make_lock("StageRuntime._lock",
+                                         registry=self._metrics)
         self._dd = obs_dispatch.attach()
         self._ddtok = obs_dispatch.token()
 
@@ -335,6 +341,7 @@ class StageRuntime:
             tr.record(spans.DEFERRED_APPLY, t0, dw,
                       trace_id=obs_trace.CTX.trace_id, party=self.party,
                       tid=entry["client_id"], step=entry["step"])
+            self._metrics.observe(spans.DEFERRED_APPLY, dw)
         fl = obs_flight.get_recorder()
         if fl is not None:
             fl.record(spans.FL_DEFER_APPLY, step=entry["step"],
@@ -362,12 +369,14 @@ class StageRuntime:
             entry, owner = self.replay.begin(client_id, "hop_fwd", seq)
             if not owner:
                 return self.replay.wait(entry)
+        tr = obs_trace.get_tracer()
         admitted = False
         try:
             if self._admission is not None:
                 self._admission.admit(client_id)
                 admitted = True
             with self._lock:
+                t0 = time.perf_counter() if tr is not None else 0.0
                 self._check_seq("hop_fwd", seq, client_id)
                 x_dev = self._to_dev(x)
                 if not self.is_last:
@@ -387,6 +396,11 @@ class StageRuntime:
             # off the lock: overlap discipline (device replies skip the
             # materialization entirely — dispatch stays async)
             y_host = y if device else np.asarray(y)
+            if tr is not None:
+                # the stage's forward compute window (dispatch through
+                # materialization) — /telemetry's critical-path input
+                self._metrics.observe(spans.DISPATCH,
+                                      time.perf_counter() - t0)
             if entry is not None:
                 self.replay.resolve(entry, y_host)
             if admitted:
@@ -455,6 +469,7 @@ class StageRuntime:
                 tr.record(spans.REPLY_GRAD, t0, rw,
                           trace_id=obs_trace.CTX.trace_id,
                           party=self.party, tid=client_id, step=step)
+                self._metrics.observe(spans.REPLY_GRAD, rw)
             if entry is not None:
                 self.replay.resolve(entry, g_host)
             fl = obs_flight.get_recorder()
@@ -522,6 +537,7 @@ class StageRuntime:
                 tr.record(spans.REPLY_GRAD, t0, rw,
                           trace_id=obs_trace.CTX.trace_id,
                           party=self.party, tid=client_id, step=step)
+                self._metrics.observe(spans.REPLY_GRAD, rw)
             res = (g_host, loss_f)
             if entry is not None:
                 self.replay.resolve(entry, res)
@@ -620,6 +636,8 @@ class StageRuntime:
         return out
 
     def health(self) -> Dict[str, Any]:
+        from split_learning_tpu.version import __version__
+        uptime = time.monotonic() - self._t_start
         return {
             "status": "ok",
             "role": "stage",
@@ -628,9 +646,39 @@ class StageRuntime:
             "is_last": self.is_last,
             "microbatches": self.microbatches,
             "apply_lag": self.apply_lag,
-            "uptime_s": time.monotonic() - self._t_start,
+            "uptime_s": uptime,  # legacy spelling, pre-PR-17 callers
+            "uptime_seconds": uptime,
+            "version": __version__,
             "counters": self.counters(),
         }
+
+    def metrics(self) -> Dict[str, Any]:
+        """In-process equivalent of ``GET /metrics`` — the same
+        Registry-snapshot-plus-scrape-time-folds contract
+        ServerRuntime.metrics() honors, so stages are first-class
+        observability citizens (hop counters as ``_total`` counters,
+        depths as gauges, admission splits when multi-tenant). Runs
+        entirely off the hop path."""
+        snap = self._metrics.snapshot()
+        # point-in-time depths are gauges; monotone hop/replay/deferred
+        # counts are counters with the server's _total suffix convention
+        gauge_keys = ("pending_steps", "deferred_apply_depth",
+                      "replay_cache_size")
+        for k, v in self.counters().items():
+            if k in gauge_keys:
+                snap["gauges"][k] = float(v)
+            else:
+                snap["counters"][f"{k}_total"] = float(v)
+        snap["gauges"]["uptime_seconds"] = float(
+            time.monotonic() - self._t_start)
+        snap["gauges"]["stage_index"] = float(self.stage_index)
+        if self._admission is not None:
+            for k, v in self._admission.counters().items():
+                snap["counters"][k] = float(v)
+            snap["gauges"].update(self._admission.gauges())
+        if self._dd is not None:
+            snap["gauges"].update(self._dd.gauges())
+        return snap
 
     # -- wire-server replay hooks (transport/http.py) ------------------- #
     def replay_lookup(self, client_id: int, op: str,

@@ -60,7 +60,8 @@ class SplitHTTPServer:
 
     def __init__(self, runtime: Any, host: str = "127.0.0.1",
                  port: int = 0, compress: str = "none",
-                 density: float = 0.1, chaos: Optional[Any] = None) -> None:
+                 density: float = 0.1, chaos: Optional[Any] = None,
+                 telemetry: Optional[Any] = None) -> None:
         """compress/density: server-side *defaults* for reply packing —
         a request carrying its own ``compress``/``density`` keys always
         wins (the client picks the wire format; these let ``serve
@@ -70,11 +71,18 @@ class SplitHTTPServer:
         server-side faults on the seeded schedule: http500 / drop_req
         before the runtime applies anything, drop_resp (reply discarded
         after apply — the lost-response case) / corrupt (bad reply CRC)
-        after, delay before. None = the untouched wire."""
+        after, delay before. None = the untouched wire.
+
+        telemetry: optional obs/telemetry.py TelemetryRing backing
+        ``GET /telemetry`` for THIS server (multi-server processes give
+        each server its own ring); None falls back to the process-global
+        ring, and 404 when both are off — the off-path serves exactly
+        the legacy routes."""
         if compress not in ("none", "int8", "topk8"):
             raise ValueError(f"unknown compression {compress!r}")
         self.runtime = runtime
         self.chaos = chaos
+        self.telemetry = telemetry
         self._chaos_attempts = _AttemptCounter()
         self.default_compress = compress
         self.default_density = float(density)
@@ -162,6 +170,24 @@ class SplitHTTPServer:
                     else:
                         body = json.dumps(
                             fl.dump(reason="http")).encode("utf-8")
+                        self._reply(200, body, ctype="application/json")
+                elif self.path == "/telemetry":
+                    # windowed time-series (obs/telemetry.py): advance
+                    # the ring (at most one snapshot per elapsed window;
+                    # the snapshot is the runtime's own scrape path) and
+                    # serialize the dump HERE, outside any runtime lock
+                    # (SLT001 — the acceptance gate on this route). 404
+                    # when telemetry is off, the /debug/flight precedent.
+                    from split_learning_tpu.obs import (
+                        telemetry as obs_telemetry)
+                    ring = outer.telemetry or obs_telemetry.get_ring()
+                    if ring is None:
+                        self._reply(404, codec.encode(
+                            {"error": "telemetry off "
+                                      "(SLT_TELEMETRY/--telemetry)"}))
+                    else:
+                        ring.advance()
+                        body = json.dumps(ring.dump()).encode("utf-8")
                         self._reply(200, body, ctype="application/json")
                 else:
                     self._reply(404, codec.encode({"error": "not found"}))

@@ -542,3 +542,94 @@ def test_fleet_sim_replication_schema(monkeypatch, capsys):
     monkeypatch.setattr(sys, "argv", [
         "fleet_sim.py", "--clients", "2", "--kill-replica-at", "1"])
     assert fleet_sim.main() == 2
+
+
+TELEMETRY_KEYS = {"enabled", "interval_s", "windows",
+                  "p99_ms_trajectory", "burn_peak", "slo_alerts",
+                  "bottleneck_histogram"}
+
+
+def test_fleet_sim_summary_telemetry_schema(monkeypatch, capsys):
+    """scripts/fleet_sim.py's ``telemetry`` block is schema-stable
+    across arms: with --telemetry it reports the windowed dispatch-p99
+    trajectory, a burn-rate peak against an unattainable SLO and a
+    per-window bottleneck histogram; without it the same keys carry
+    the false/empty/null arm so twin-run diffs never branch on shape."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "fleet_sim", os.path.join(REPO, "scripts", "fleet_sim.py"))
+    fleet_sim = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fleet_sim)
+
+    # telemetry arm: fast windows + a 0.5ms SLO no real step can meet,
+    # so the burn pair fires deterministically
+    monkeypatch.setattr(sys, "argv", [
+        "fleet_sim.py", "--clients", "4", "--steps", "2",
+        "--rate", "5.0", "--batch", "4", "--workers", "4",
+        "--telemetry", "--telemetry-interval-s", "0.1",
+        "--slo-ms", "0.5"])
+    assert fleet_sim.main() == 0
+    out = capsys.readouterr().out
+    block = json.loads(out[out.index("{"):])["telemetry"]
+    assert set(block) == TELEMETRY_KEYS
+    assert block["enabled"] is True
+    assert block["interval_s"] == 0.1
+    assert block["windows"] > 0
+    assert len(block["p99_ms_trajectory"]) == block["windows"]
+    assert any(v is not None for v in block["p99_ms_trajectory"])
+    assert block["burn_peak"] is not None and block["burn_peak"] > 1.0
+    assert block["bottleneck_histogram"]
+    assert set(block["bottleneck_histogram"]) <= {"queue_wait",
+                                                 "compute"}
+    for alert in block["slo_alerts"]:
+        assert alert["state"] in ("firing", "cleared")
+
+    # null arm: same keys, false/empty/null values
+    monkeypatch.setattr(sys, "argv", [
+        "fleet_sim.py", "--clients", "2", "--steps", "1",
+        "--rate", "5.0", "--batch", "4"])
+    assert fleet_sim.main() == 0
+    out = capsys.readouterr().out
+    null_arm = json.loads(out[out.index("{"):])["telemetry"]
+    assert null_arm == {"enabled": False, "interval_s": None,
+                        "windows": 0, "p99_ms_trajectory": [],
+                        "burn_peak": None, "slo_alerts": [],
+                        "bottleneck_histogram": {}}
+
+
+@pytest.mark.slow
+def test_bench_fleet_telemetry_role_quick():
+    """bench.py --role fleet_telemetry --quick end to end: the
+    telemetry-on twin stays inside the 2% steps/sec budget, the
+    critical path pins the synthetic-slow middle stage in >=90% of
+    warm windows, the 3-replica burn pair fires against an
+    unattainable SLO, and per-replica labeled series render."""
+    sys.path.insert(0, REPO)
+    from bench import measure_fleet_telemetry
+    r = measure_fleet_telemetry(quick=True)
+
+    assert r["leg"] == "fleet_telemetry"
+    assert r["stages"] == 3 and r["replicas"] == 3
+
+    ov = r["telemetry_overhead"]
+    assert set(ov) == {"steps_per_sec_off", "steps_per_sec_on",
+                       "overhead_frac", "budget_frac"}
+    assert ov["steps_per_sec_off"] > 0 and ov["steps_per_sec_on"] > 0
+
+    attr = r["attribution"]
+    assert attr["slow_party"] == "stage1"
+    assert attr["windows_attributed"] > 0
+    assert attr["accuracy"] >= attr["accuracy_floor"] == 0.9
+    assert attr["bottleneck_histogram"].get("stage1", 0) > 0
+
+    burn = r["slo_burn"]
+    assert burn["fired"] is True
+    assert burn["windows"] > 0
+    assert any(a["state"] == "firing" for a in burn["alerts"])
+
+    assert r["per_replica_labeled_series"] > 0
+
+    # the only tolerated invalidity is steps/sec noise on a loaded
+    # box; every deterministic gate above must hold regardless
+    if not r["valid"]:
+        assert "slower than off" in (r["invalid_reason"] or "")

@@ -1,0 +1,670 @@
+"""Fleet telemetry plane (PR 17): obs/telemetry.py windowed rings +
+SLO burn-rate tracking, obs/federate.py cross-party federation and
+critical-path attribution, and the exposition/zero-overhead edges they
+lean on.
+
+Pins, in order: ring window math is exact under an injectable virtual
+clock (deltas, rates, empty idle windows, forced partial windows,
+capacity); counter and histogram resets fall back to the post-restart
+cumulative value (the Prometheus ``rate()`` convention);
+``histogram_percentile`` returns 0.0 — never NaN — on empty deltas and
+clamps +Inf-slot percentiles to the last finite edge; the multi-window
+burn-rate pair fires and clears deterministically on synthetic window
+streams and journals typed FL_SLO_ALERT records; federation merges
+three synthetic parties into one keyed view with fleet/tenant rate
+splits; critical-path attribution decomposes a recorded 3-stage
+fixture into compute/queue/wire/bubble and names the slow party;
+labeled series render with correct Prometheus label escaping; the
+``/telemetry`` endpoint serves ring dumps (404 when off); and with
+telemetry fully off the chain's loss series is bit-for-bit identical
+to a telemetry-on twin — the plane never touches arithmetic."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from split_learning_tpu.obs import flight
+from split_learning_tpu.obs import spans
+from split_learning_tpu.obs import telemetry as obs_telemetry
+from split_learning_tpu.obs import trace as obs_trace
+from split_learning_tpu.obs.federate import (
+    FleetCollector, bottleneck_histogram, critical_path, merge_fleet,
+    party_key, serve_telemetry, split_tenant)
+from split_learning_tpu.obs.metrics import (
+    Histogram, Registry, escape_label_value, histogram_delta,
+    histogram_percentile, render_prometheus)
+from split_learning_tpu.obs.telemetry import (
+    SLOTracker, SloObjective, TelemetryRing)
+
+
+class VClock:
+    """Injectable monotonic clock (SLT004-clean window math)."""
+
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _ring_over(state, **kw):
+    """Ring over a mutable metrics()-shaped dict (snapshot_fn reads the
+    live dict, the ring's delta logic does the rest)."""
+    clk = VClock(0.0)
+    ring = TelemetryRing(
+        lambda: {"counters": dict(state.get("counters", {})),
+                 "histograms": {k: dict(v) for k, v in
+                                state.get("histograms", {}).items()},
+                 "gauges": dict(state.get("gauges", {}))},
+        party="test", clock=clk, **kw)
+    return ring, clk
+
+
+def _hist_snap(*values):
+    h = Histogram()
+    for v in values:
+        h.observe(v)
+    return h.snapshot()
+
+
+# ---------------------------------------------------------------------- #
+# ring window math under the virtual clock
+# ---------------------------------------------------------------------- #
+
+def test_ring_windows_deltas_and_rates():
+    state = {"counters": {"steps_total": 0.0}, "histograms": {},
+             "gauges": {"depth": 0.0}}
+    ring, clk = _ring_over(state, interval_s=1.0, capacity=10)
+
+    state["counters"]["steps_total"] = 5.0
+    state["gauges"]["depth"] = 3.0
+    clk.t = 1.0
+    assert ring.advance() == 1
+    (w,) = ring.windows()
+    assert w["index"] == 0
+    assert w["t_start"] == 0.0 and w["t_end"] == 1.0
+    assert w["counters"]["steps_total"] == 5.0
+    assert w["rates"]["steps_total"] == 5.0
+    assert w["gauges"]["depth"] == 3.0
+
+    # second window: only the delta (2 more steps -> rate 2/s)
+    state["counters"]["steps_total"] = 7.0
+    clk.t = 2.0
+    assert ring.advance() == 1
+    assert ring.windows()[-1]["counters"]["steps_total"] == 2.0
+    assert ring.windows()[-1]["rates"]["steps_total"] == 2.0
+
+
+def test_ring_same_interval_advance_is_noop():
+    ring, clk = _ring_over({"counters": {"c": 1.0}}, interval_s=1.0)
+    clk.t = 0.5
+    assert ring.advance() == 0           # window 0 still open
+    assert ring.windows() == []
+    clk.t = 1.0
+    assert ring.advance() == 1
+    assert ring.advance() == 0           # idempotent at the boundary
+
+
+def test_ring_skipped_intervals_emit_empty_windows():
+    """A scrape gap attributes the whole delta to the latest complete
+    window; the skipped intervals stay in the ring as explicitly empty
+    windows so the time axis stays uniform (burn windows depend on
+    it)."""
+    state = {"counters": {"c": 0.0}}
+    ring, clk = _ring_over(state, interval_s=1.0, capacity=10)
+    state["counters"]["c"] = 9.0
+    clk.t = 4.2                          # windows 0..3 complete
+    assert ring.advance() == 4
+    ws = ring.windows()
+    assert [w["index"] for w in ws] == [0, 1, 2, 3]
+    assert all(w["counters"] == {} for w in ws[:3])
+    assert ws[3]["counters"]["c"] == 9.0
+
+
+def test_ring_force_closes_partial_window_with_honest_width():
+    state = {"counters": {"c": 0.0}}
+    ring, clk = _ring_over(state, interval_s=1.0)
+    state["counters"]["c"] = 4.0
+    clk.t = 0.5
+    assert ring.advance(force=True) == 1
+    w = ring.windows()[-1]
+    assert w["t_end"] == pytest.approx(0.5)
+    assert w["rates"]["c"] == pytest.approx(8.0)   # 4 events / 0.5 s
+    # a second force inside the same interval cannot invert the axis
+    clk.t = 0.6
+    ring.advance(force=True)
+    w2 = ring.windows()[-1]
+    assert w2["t_end"] >= w2["t_start"]
+
+
+def test_ring_capacity_bounds_the_window_list():
+    state = {"counters": {"c": 0.0}}
+    ring, clk = _ring_over(state, interval_s=1.0, capacity=3)
+    for i in range(1, 8):
+        clk.t = float(i)
+        ring.advance()
+    ws = ring.windows()
+    assert len(ws) == 3
+    assert [w["index"] for w in ws] == [4, 5, 6]
+    assert ring.windows(last=2)[0]["index"] == 5
+
+
+def test_ring_counter_reset_falls_back_to_post_restart_value():
+    state = {"counters": {"c": 10.0}}
+    ring, clk = _ring_over(state, interval_s=1.0)
+    clk.t = 1.0
+    ring.advance()
+    state["counters"]["c"] = 4.0         # party restarted mid-scrape
+    clk.t = 2.0
+    ring.advance()
+    assert ring.windows()[-1]["counters"]["c"] == 4.0
+
+
+def test_ring_histogram_windows_roll_percentiles():
+    state = {"histograms": {spans.DISPATCH: _hist_snap(0.004)}}
+    ring, clk = _ring_over(state, interval_s=1.0)
+    clk.t = 1.0
+    ring.advance()
+    p = ring.windows()[-1]["percentiles"][spans.DISPATCH]
+    assert 2.5 <= p["p99"] <= 5.0        # ms, within the 4 ms bucket
+    # next window: one much slower observation dominates the DELTA
+    # percentiles even though the cumulative histogram is mostly fast
+    state["histograms"][spans.DISPATCH] = _hist_snap(0.004, 0.9)
+    clk.t = 2.0
+    ring.advance()
+    w = ring.windows()[-1]
+    assert w["histograms"][spans.DISPATCH]["count"] == 1
+    assert w["percentiles"][spans.DISPATCH]["p99"] >= 500.0
+    # idle window: no delta -> no percentile entry (not NaN, not 0 spam)
+    clk.t = 3.0
+    ring.advance()
+    assert spans.DISPATCH not in ring.windows()[-1]["percentiles"]
+
+
+def test_ring_dump_schema():
+    ring, clk = _ring_over({"counters": {"c": 1.0}}, interval_s=1.0)
+    clk.t = 1.0
+    ring.advance()
+    d = ring.dump()
+    assert d["version"] == 1 and d["kind"] == "slt-telemetry"
+    assert d["party"] == "test"
+    assert d["interval_s"] == 1.0
+    assert d["slo"] is None
+    assert len(d["windows"]) == 1
+    json.dumps(d)                        # JSON-safe by construction
+
+
+# ---------------------------------------------------------------------- #
+# histogram delta / percentile edges (satellite b)
+# ---------------------------------------------------------------------- #
+
+def test_histogram_percentile_empty_delta_is_zero_not_nan():
+    assert histogram_percentile({}, 99.0) == 0.0
+    assert histogram_percentile({"count": 0}, 50.0) == 0.0
+    empty = histogram_delta(_hist_snap(0.01), _hist_snap(0.01))
+    assert empty["count"] == 0
+    assert histogram_percentile(empty, 99.0) == 0.0
+
+
+def test_histogram_percentile_inf_slot_clamps_to_last_finite_edge():
+    snap = _hist_snap(50.0, 60.0, 70.0)  # all beyond the 10 s top edge
+    assert histogram_percentile(snap, 50.0) == snap["buckets"][-1]
+    assert histogram_percentile(snap, 99.0) == snap["buckets"][-1]
+
+
+def test_histogram_percentile_rejects_bad_quantile():
+    with pytest.raises(ValueError):
+        histogram_percentile(_hist_snap(0.01), 101.0)
+    with pytest.raises(ValueError):
+        histogram_percentile(_hist_snap(0.01), -1.0)
+
+
+def test_histogram_delta_subtracts_and_tolerates_reset():
+    prev = _hist_snap(0.004)
+    cur = _hist_snap(0.004, 0.9)
+    d = histogram_delta(cur, prev)
+    assert d["count"] == 1
+    assert d["sum"] == pytest.approx(0.9)
+    assert sum(d["cumulative"][-1:]) == 2 - 1
+    # reset: cur strictly smaller than prev -> delta is cur itself
+    r = histogram_delta(prev, cur)
+    assert r["count"] == prev["count"]
+    assert r["cumulative"] == prev["cumulative"]
+
+
+# ---------------------------------------------------------------------- #
+# SLO burn-rate pair (deterministic fire / clear)
+# ---------------------------------------------------------------------- #
+
+def _lat_window(idx, slow, fast):
+    """A ring window whose dispatch delta has ``slow`` observations over
+    100 ms and ``fast`` under 1 ms."""
+    h = Histogram()
+    for _ in range(slow):
+        h.observe(0.9)
+    for _ in range(fast):
+        h.observe(0.0005)
+    return {"index": idx, "counters": {}, "gauges": {},
+            "histograms": {spans.DISPATCH: h.snapshot()},
+            "percentiles": {}}
+
+
+def test_burn_rate_pair_fires_and_clears_deterministically():
+    obj = SloObjective(kind="latency", tenant=0, target=0.99,
+                       slo_ms=100.0)
+    tr = SLOTracker([obj], fast_windows=2, slow_windows=4,
+                    threshold=1.0)
+    # two all-bad windows: burn 100x on both horizons -> fires once
+    fired = tr.observe_window(_lat_window(0, slow=4, fast=0))
+    assert [a.state for a in fired] == ["firing"]
+    assert tr.observe_window(_lat_window(1, slow=4, fast=0)) == []
+    assert tr.firing() == [{"tenant": 0, "objective": "latency"}]
+    g = tr.burn_gauges()
+    assert g[f"{spans.SLO_BURN_FAST}_latency_t0"] > 1.0
+    assert g[f"{spans.SLO_BURN_SLOW}_latency_t0"] > 1.0
+    # idle windows are skipped, not counted as good: still firing
+    assert tr.observe_window(_lat_window(2, slow=0, fast=0)) == []
+    assert tr.firing() != []
+    # four clean windows push both horizons under threshold -> clears
+    cleared = []
+    for i in range(3, 7):
+        cleared += tr.observe_window(_lat_window(i, slow=0, fast=50))
+    assert [a.state for a in cleared] == ["cleared"]
+    assert tr.firing() == []
+    states = [a["state"] for a in tr.alerts()]
+    assert states == ["firing", "cleared"]
+
+
+def test_burn_rate_single_bad_window_does_not_page():
+    """The slow horizon rejects blips: one bad window in a long good
+    stream keeps burn_slow under threshold -> never fires."""
+    obj = SloObjective(kind="latency", target=0.9, slo_ms=100.0)
+    tr = SLOTracker([obj], fast_windows=1, slow_windows=8,
+                    threshold=1.5)
+    for i in range(6):
+        assert tr.observe_window(_lat_window(i, slow=0, fast=20)) == []
+    assert tr.observe_window(_lat_window(6, slow=1, fast=19)) == []
+    assert tr.alerts() == []
+
+
+def test_availability_objective_uses_tenant_counters():
+    obj = SloObjective(kind="availability", tenant=1, target=0.5)
+    w = {"index": 0, "histograms": {},
+         "counters": {f"{spans.ADMISSION_ADMITTED}_t1": 1.0,
+                      f"{spans.ADMISSION_REJECTED}_t1": 3.0}}
+    assert obj.window_error_rate(w) == pytest.approx(0.75)
+    assert obj.window_error_rate(
+        {"index": 1, "histograms": {}, "counters": {}}) is None
+
+
+def test_slo_alert_journaled_to_flight_recorder():
+    fl = flight.enable(party="proc")
+    try:
+        tr = SLOTracker([SloObjective(kind="latency", slo_ms=100.0)],
+                        fast_windows=1, slow_windows=2)
+        tr.observe_window(_lat_window(0, slow=3, fast=0))
+        evs = [e for e in fl.events() if e["name"] == spans.FL_SLO_ALERT]
+        assert len(evs) == 1
+        assert evs[0]["fields"]["state"] == "firing"
+        assert evs[0]["fields"]["objective"] == "latency"
+    finally:
+        flight.disable()
+
+
+def test_ring_merges_burn_gauges_into_windows():
+    tr = SLOTracker([SloObjective(kind="latency", slo_ms=100.0)],
+                    fast_windows=1, slow_windows=2)
+    state = {"histograms": {spans.DISPATCH: _hist_snap(0.9, 0.9)}}
+    ring, clk = _ring_over(state, interval_s=1.0, slo=tr)
+    clk.t = 1.0
+    ring.advance()
+    w = ring.windows()[-1]
+    assert w["gauges"][f"{spans.SLO_BURN_FAST}_latency_t0"] > 1.0
+    d = ring.dump()
+    assert d["slo"]["firing"] == [{"tenant": 0, "objective": "latency"}]
+    assert [a["state"] for a in d["slo"]["alerts"]] == ["firing"]
+
+
+# ---------------------------------------------------------------------- #
+# federation: merge + critical path on a synthetic 3-party fixture
+# ---------------------------------------------------------------------- #
+
+def _dump(party, windows, slo=None):
+    return {"version": 1, "kind": "slt-telemetry", "party": party,
+            "interval_s": 1.0, "capacity": 10,
+            "next_index": len(windows), "windows": windows,
+            "slo": slo}
+
+
+def _win(idx, hists=None, counters=None, rates=None):
+    return {"index": idx, "t_start": float(idx),
+            "t_end": float(idx + 1), "interval_s": 1.0,
+            "counters": counters or {}, "rates": rates or {},
+            "gauges": {}, "histograms": hists or {}, "percentiles": {}}
+
+
+def _sum_hist(total_s, count=1):
+    """A window-delta histogram whose sum/count are what the critical
+    path reads (bucket detail irrelevant to attribution sums)."""
+    return {"buckets": (10.0,), "cumulative": [count],
+            "sum": float(total_s), "count": int(count)}
+
+
+def test_party_key_and_tenant_split():
+    assert party_key("hub") == "hub"
+    assert party_key("stage", 2) == "stage2"
+    assert party_key("server", None, 1) == "server.r1"
+    assert split_tenant("admission_admitted_t2") == (
+        "admission_admitted", 2)
+    assert split_tenant("steps_total") == ("steps_total", None)
+
+
+def test_merge_fleet_three_parties():
+    scraped = [
+        {"role": "hub", "stage": None, "replica": None, "key": "hub",
+         "error": None, "telemetry": _dump("hub", [
+             _win(0, rates={"hub_steps_total": 2.0})])},
+        {"role": "stage", "stage": 1, "replica": None, "key": "stage1",
+         "error": None, "telemetry": _dump("stage1", [
+             _win(0, rates={"hop_fwd_total": 8.0,
+                            "admission_admitted_t0": 3.0})])},
+        {"role": "stage", "stage": 2, "replica": None, "key": "stage2",
+         "error": None, "telemetry": _dump("stage2", [
+             _win(0, rates={"hop_fwd_total": 8.0,
+                            "admission_admitted_t0": 5.0})],
+             slo={"burn": {"slo_burn_rate_fast_latency_t0": 2.5},
+                  "firing": [{"tenant": 0, "objective": "latency"}],
+                  "alerts": []})},
+    ]
+    view = merge_fleet(scraped)
+    assert set(view["parties"]) == {"hub", "stage1", "stage2"}
+    assert view["fleet_rates"]["hop_fwd_total"] == pytest.approx(16.0)
+    assert view["tenant_rates"]["t0"]["admission_admitted"] == (
+        pytest.approx(8.0))
+    assert view["slo_burn"][
+        "stage2:slo_burn_rate_fast_latency_t0"] == 2.5
+    assert view["slo_firing"] == [
+        {"party": "stage2", "tenant": 0, "objective": "latency"}]
+
+
+def _fixture_scrape(stage1_compute, stage2_compute, hub_wire,
+                    step_s=1.0, queue1=0.05):
+    hub_h = {spans.STEP_TOTAL: _sum_hist(step_s, 2),
+             spans.WIRE: _sum_hist(hub_wire, 6)}
+    s1_h = {spans.DISPATCH: _sum_hist(stage1_compute, 4),
+            spans.QUEUE_WAIT: _sum_hist(queue1, 4)}
+    s2_h = {spans.DISPATCH: _sum_hist(stage2_compute, 4)}
+    return [
+        {"role": "hub", "stage": None, "replica": None, "key": "hub",
+         "error": None, "telemetry": _dump("hub", [_win(0, hub_h)])},
+        {"role": "stage", "stage": 1, "replica": None, "key": "stage1",
+         "error": None,
+         "telemetry": _dump("stage1", [_win(0, s1_h)])},
+        {"role": "stage", "stage": 2, "replica": None, "key": "stage2",
+         "error": None,
+         "telemetry": _dump("stage2", [_win(0, s2_h)])},
+    ]
+
+
+def test_critical_path_decomposition_names_slow_stage():
+    cp = critical_path(_fixture_scrape(
+        stage1_compute=0.2, stage2_compute=0.6, hub_wire=0.5))
+    assert len(cp) == 1
+    w = cp[0]
+    assert w["steps"] == 2
+    assert w["compute_s"]["stage2"] == pytest.approx(0.6)
+    assert w["queue_s"]["stage1"] == pytest.approx(0.05)
+    # wire brackets remote work: 0.5 - (0.2+0.6+0.05) clamps to 0
+    assert w["wire_s"] == 0.0
+    assert w["bubble_s"] == pytest.approx(1.0 - 0.85)
+    assert w["bottleneck"]["party"] == "stage2"
+    assert w["bottleneck"]["kind"] == "compute"
+    assert w["bottleneck"]["share"] == pytest.approx(0.6)
+
+
+def test_critical_path_wire_bottleneck_and_histogram():
+    cp = critical_path(_fixture_scrape(
+        stage1_compute=0.05, stage2_compute=0.05, hub_wire=0.9,
+        queue1=0.0))
+    assert cp[0]["bottleneck"]["party"] == "hub"
+    assert cp[0]["bottleneck"]["kind"] == "wire"
+    assert cp[0]["wire_s"] == pytest.approx(0.8)
+    assert bottleneck_histogram(cp) == {"hub": 1}
+
+
+def test_critical_path_skips_idle_and_needs_a_hub():
+    scraped = _fixture_scrape(0.1, 0.1, 0.1)
+    scraped[0]["telemetry"]["windows"][0]["histograms"] = {}
+    assert critical_path(scraped) == []          # no hub steps
+    assert critical_path(scraped[1:]) == []      # no hub party
+
+
+def test_collector_dead_party_is_data_not_a_crash():
+    view = FleetCollector([
+        {"role": "hub", "dump": _dump("hub", [])},
+        {"role": "stage", "stage": 1,
+         "url": "http://127.0.0.1:1/nope"},   # nothing listens there
+    ], timeout_s=0.2).collect()
+    assert view["parties"]["stage1"]["error"]
+    assert view["parties"]["hub"]["error"] is None
+    assert view["critical_path"] == []
+
+
+def test_collector_in_process_ring_source():
+    state = {"counters": {"hub_steps_total": 0.0}}
+    ring, clk = _ring_over(state, interval_s=1.0)
+    state["counters"]["hub_steps_total"] = 3.0
+    clk.t = 1.0
+    view = FleetCollector(
+        [{"role": "hub", "ring": ring}]).collect()
+    assert view["parties"]["hub"]["windows"] == 1
+    assert view["parties"]["hub"]["rates"]["hub_steps_total"] == 3.0
+
+
+# ---------------------------------------------------------------------- #
+# exposition: label escaping + labeled series (satellite c)
+# ---------------------------------------------------------------------- #
+
+def test_escape_label_value():
+    assert escape_label_value('a"b') == 'a\\"b'
+    assert escape_label_value("a\\b") == "a\\\\b"
+    assert escape_label_value("a\nb") == "a\\nb"
+    # backslash first: an embedded \" round-trips unambiguously
+    assert escape_label_value('\\"') == '\\\\\\"'
+
+
+def test_render_prometheus_labeled_series():
+    snap = {"histograms": {}, "counters": {"hop_fwd": 7.0},
+            "gauges": {}, "phase_fractions": {},
+            "labeled": [
+                {"name": "hop_fwd", "type": "counter",
+                 "labels": {"replica": "0"}, "value": 3.0},
+                {"name": "hop_fwd", "type": "counter",
+                 "labels": {"replica": "1"}, "value": 4.0},
+                {"name": "weird", "type": "gauge",
+                 "labels": {"path": 'a"b\nc'}, "value": 1.0},
+            ]}
+    text = render_prometheus(snap)
+    assert 'slt_hop_fwd{replica="0"} 3' in text
+    assert 'slt_hop_fwd{replica="1"} 4' in text
+    assert 'slt_weird{path="a\\"b\\nc"} 1' in text
+    # one TYPE header per metric even when labeled series share the
+    # name with the un-labeled aggregate
+    assert text.count("# TYPE slt_hop_fwd counter") == 1
+    assert "# TYPE slt_weird gauge" in text
+
+
+# ---------------------------------------------------------------------- #
+# env knobs + endpoint + zero-overhead-off bit identity
+# ---------------------------------------------------------------------- #
+
+def test_env_config_parses_knobs(monkeypatch):
+    monkeypatch.delenv("SLT_TELEMETRY", raising=False)
+    assert obs_telemetry.env_config() is None
+    monkeypatch.setenv("SLT_TELEMETRY", "0")
+    assert obs_telemetry.env_config() is None
+    monkeypatch.setenv("SLT_TELEMETRY", "1")
+    monkeypatch.setenv("SLT_TELEMETRY_INTERVAL_S", "0.5")
+    monkeypatch.setenv("SLT_TELEMETRY_CAPACITY", "7")
+    cfg = obs_telemetry.env_config()
+    assert cfg == {"interval_s": 0.5, "capacity": 7}
+    assert obs_telemetry.tracker_from_config(cfg) is None
+    monkeypatch.setenv("SLT_TELEMETRY_SLO_MS", "25")
+    monkeypatch.setenv("SLT_TELEMETRY_BURN_THRESHOLD", "2.0")
+    cfg = obs_telemetry.env_config()
+    tr = obs_telemetry.tracker_from_config(cfg, tenants=2)
+    assert tr.threshold == 2.0
+    kinds = [(o.kind, o.tenant) for o in tr.objectives]
+    assert ("latency", 0) in kinds and ("availability", 1) in kinds
+
+
+def test_global_ring_enable_disable(monkeypatch):
+    assert obs_telemetry.get_ring() is None     # default: off
+    monkeypatch.setenv("SLT_TELEMETRY", "true")
+    ring = obs_telemetry.maybe_enable_from_env(
+        lambda: {"counters": {}}, party="p")
+    try:
+        assert obs_telemetry.get_ring() is ring
+        assert obs_telemetry.enabled()
+    finally:
+        obs_telemetry.disable()
+    assert obs_telemetry.get_ring() is None
+
+
+def test_serve_telemetry_endpoint():
+    state = {"counters": {"c": 0.0}}
+    ring, clk = _ring_over(state, interval_s=0.05)
+    srv, _thread = serve_telemetry(ring, port=0)
+    try:
+        url = f"http://127.0.0.1:{srv.server_address[1]}/telemetry"
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            body = json.loads(resp.read())
+        assert body["kind"] == "slt-telemetry"
+        assert body["party"] == "test"
+        with pytest.raises(Exception):
+            urllib.request.urlopen(
+                url.replace("/telemetry", "/other"), timeout=5)
+    finally:
+        srv.shutdown()
+
+
+def test_http_server_telemetry_route():
+    """transport/http.py serves /telemetry for ANY runtime role (404
+    when telemetry is off, the ring dump when a per-server ring is
+    attached) and stage-role /health carries uptime_seconds + build."""
+    import jax
+    from split_learning_tpu.models import get_plan
+    from split_learning_tpu.runtime.stage import StageRuntime
+    from split_learning_tpu.transport.http import SplitHTTPServer
+    from split_learning_tpu.utils import Config
+
+    batch = 4
+    cfg = Config(mode="split", model="split_cnn_chain3",
+                 batch_size=batch, num_stages=3, microbatches=1)
+    plan = get_plan(model="split_cnn_chain3", mode="split")
+    sample = np.zeros((batch, 28, 28, 1), np.float32)
+    stage = StageRuntime(plan, 1, cfg, jax.random.PRNGKey(0), sample,
+                         microbatches=1, apply_lag=0)
+    state = {"counters": {"c": 1.0}}
+    ring, clk = _ring_over(state, interval_s=0.01)
+    clk.t = 1.0
+    off = SplitHTTPServer(stage).start()
+    on = SplitHTTPServer(stage, telemetry=ring).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{off.url}/telemetry", timeout=5)
+        assert err.value.code == 404
+        with urllib.request.urlopen(f"{on.url}/telemetry",
+                                    timeout=5) as resp:
+            body = json.loads(resp.read())
+        assert body["kind"] == "slt-telemetry"
+        assert body["windows"]
+        from split_learning_tpu.transport import codec
+        with urllib.request.urlopen(f"{on.url}/health",
+                                    timeout=5) as resp:
+            health = codec.decode(resp.read())
+        assert "uptime_seconds" in health and "version" in health
+        with urllib.request.urlopen(f"{on.url}/metrics",
+                                    timeout=5) as resp:
+            text = resp.read().decode()
+        assert "slt_uptime_seconds" in text
+        assert "slt_stage_index" in text
+    finally:
+        off.stop()
+        on.stop()
+        stage.close()
+
+
+def _chain_losses(telemetry: bool, steps: int = 3):
+    import jax
+    from split_learning_tpu.models import get_plan
+    from split_learning_tpu.obs.metrics import Registry
+    from split_learning_tpu.runtime.pipeline_runner import PipelineRunner
+    from split_learning_tpu.runtime.stage import StageRuntime
+    from split_learning_tpu.transport.local import LocalTransport
+    from split_learning_tpu.utils import Config
+
+    batch = 8
+    cfg = Config(mode="split", model="split_cnn_chain3",
+                 batch_size=batch, num_stages=3, microbatches=1,
+                 seed=2)
+    plan = get_plan(model="split_cnn_chain3", mode="split")
+    sample = np.zeros((batch, 28, 28, 1), np.float32)
+    if telemetry:
+        obs_trace.enable()
+    stages = [StageRuntime(plan, i, cfg, jax.random.PRNGKey(2), sample,
+                           microbatches=1, apply_lag=0)
+              for i in (1, 2)]
+    runner = PipelineRunner(plan, cfg, jax.random.PRNGKey(2), sample,
+                            [LocalTransport(s) for s in stages],
+                            microbatches=1)
+    rings = []
+    try:
+        if telemetry:
+            hub_reg = Registry()
+            runner.telemetry_registry = hub_reg
+            rings = [TelemetryRing(hub_reg.snapshot, party="hub",
+                                   interval_s=0.01)]
+            rings += [TelemetryRing(s.metrics,
+                                    party=f"stage{s.stage_index}",
+                                    interval_s=0.01) for s in stages]
+        losses = []
+        rs = np.random.RandomState(5)
+        for i in range(steps):
+            x = rs.randn(batch, 28, 28, 1).astype(np.float32)
+            y = rs.randint(0, 10, batch).astype(np.int64)
+            losses.append(runner.step(x, y, i))
+            for ring in rings:
+                ring.advance(force=True)
+    finally:
+        runner.close()
+        for s in stages:
+            s.close()
+        if telemetry:
+            obs_trace.disable()
+    return losses, rings
+
+
+@pytest.mark.slow
+def test_telemetry_off_is_bit_identical_to_on():
+    """The zero-overhead-off pin, stated as arithmetic: a chain run with
+    telemetry fully off produces the exact same loss series as a twin
+    with the tracer on, per-party rings attached to every runtime, and
+    the rings force-advanced after every step. The plane observes; it
+    never participates."""
+    assert obs_telemetry.get_ring() is None
+    assert obs_trace.get_tracer() is None
+    base, _ = _chain_losses(telemetry=False)
+    on, rings = _chain_losses(telemetry=True)
+    assert base == on                    # bit-for-bit, not approx
+    # and the on-twin actually measured something
+    hub_windows = rings[0].windows()
+    assert sum(w["counters"].get("hub_steps_total", 0)
+               for w in hub_windows) == 3
+    stage_counts = sum(
+        w["histograms"].get(spans.DISPATCH, {}).get("count", 0)
+        for w in rings[1].windows())
+    assert stage_counts > 0
