@@ -2327,6 +2327,160 @@ def measure_mpmd_colocated(quick: bool) -> dict:
     }
 
 
+def measure_mpmd_compressed(quick: bool) -> dict:
+    """Compressed hop wires on the K-stage chain (PR 18): the same
+    3-stage split_cnn_chain3 over REAL SplitHTTPServer loopback wires,
+    M=4, run dense ("none") vs topk8 vs clapping at density 0.25 on a
+    converging 4-batch cycle. Every run drives its own fresh chain —
+    parity is measured through each run's own wire, end loss against
+    the dense run's. Density 0.25 is the measured knee: it still
+    clears 10x on the wire (values + bitmap overhead) while holding
+    end-loss inside the nats budget; per-step loss on the 4-batch
+    cycle is ~0.4-nat noisy, so end loss averages the last 8 steps.
+
+    Gates: (a) topk8 AND clapping hop bytes (request+reply, the
+    transports' own byte counters) >= 10x below the dense chain's over
+    the same step count; (b) each compressed run's end-of-run loss
+    within the absolute-nats budget of the dense run's (error feedback
+    — persistent ledger or Clapping's storage-free fold — must keep
+    the sparsified trajectory converging with the dense one); (c) zero
+    steady-state recompiles under the dispatch watchdog (packed/dense
+    payload shapes are stable per wire); (d) Clapping stages export NO
+    wire-EF ledger in their runtime extras while topk8 stages do —
+    the storage-free contract, measured not asserted."""
+    import jax
+    import numpy as np
+
+    from split_learning_tpu.models import get_plan
+    from split_learning_tpu.obs import dispatch_debug
+    from split_learning_tpu.runtime.pipeline_runner import PipelineRunner
+    from split_learning_tpu.runtime.stage import StageRuntime
+    from split_learning_tpu.transport.http import (
+        HttpTransport, SplitHTTPServer)
+    from split_learning_tpu.utils import Config
+
+    batch = 32
+    microbatches = 4
+    density = 0.25
+    steps = 16 if quick else 24
+    rs = np.random.RandomState(0)
+    px = rs.rand(4, batch, 28, 28, 1).astype(np.float32)
+    py = rs.randint(0, 10, (4, batch)).astype(np.int32)
+    plan3 = get_plan(model="split_cnn_chain3", mode="split")
+    dd = dispatch_debug.tracker()
+
+    def chain_run(compress):
+        """One fresh HTTP chain; returns (losses, total hop wire bytes
+        across both hops and directions, per-stage extras sidecars)."""
+        cfg = Config(mode="split", model="split_cnn_chain3",
+                     batch_size=batch, num_stages=3,
+                     microbatches=microbatches)
+        ef_mode = "clapping" if compress == "clapping" else "topk8"
+        stages = [StageRuntime(plan3, i, cfg, jax.random.PRNGKey(0),
+                               px[0], microbatches=microbatches,
+                               apply_lag=1, ef_mode=ef_mode)
+                  for i in (1, 2)]
+        servers, ts = [], []
+        for s in stages:
+            srv = SplitHTTPServer(s, compress=compress,
+                                  density=density).start()
+            servers.append(srv)
+            ts.append(HttpTransport(srv.url, compress=compress,
+                                    density=density))
+        runner = PipelineRunner(plan3, cfg, jax.random.PRNGKey(0),
+                                px[0], ts, microbatches=microbatches)
+        losses = []
+        try:
+            for r in range(steps):
+                losses.append(runner.step(px[r % 4], py[r % 4], r))
+            extras = [s.export_runtime_extras(steps) for s in stages]
+        finally:
+            runner.close()
+            for s in stages:
+                s.close()
+            for srv in servers:
+                srv.stop()
+        wire_bytes = sum(t.stats.bytes_sent + t.stats.bytes_received
+                         for t in ts)
+        return losses, wire_bytes, extras
+
+    dispatch_debug.force(True)
+    try:
+        g0 = dd.gauges()
+        dense_series, dense_bytes, _ = chain_run("none")
+        topk8_series, topk8_bytes, topk8_extras = chain_run("topk8")
+        clap_series, clap_bytes, clap_extras = chain_run("clapping")
+        g1 = dd.gauges()
+    finally:
+        dispatch_debug.force(False)
+    steady = g1["steady_state_recompiles"] - g0["steady_state_recompiles"]
+
+    def end_loss(series):
+        return float(np.mean(series[-8:]))
+
+    nats_budget = 0.35
+    parity = {
+        "topk8": abs(end_loss(topk8_series) - end_loss(dense_series)),
+        "clapping": abs(end_loss(clap_series) - end_loss(dense_series)),
+    }
+    reduction = {
+        "topk8": dense_bytes / topk8_bytes if topk8_bytes else None,
+        "clapping": dense_bytes / clap_bytes if clap_bytes else None,
+    }
+    # the storage-free contract: a clapping stage's extras sidecar
+    # carries no wire_ef entry at all, a topk8 stage's does
+    topk8_ledger = all("wire_ef" in e for e in topk8_extras)
+    clap_ledger_free = all("wire_ef" not in e for e in clap_extras)
+
+    invalid_reason = None
+    low = [k for k, v in reduction.items() if not v or v < 10.0]
+    drift = [k for k, v in parity.items() if v > nats_budget]
+    if low:
+        invalid_reason = (
+            f"hop byte reduction below 10x for {low} "
+            f"(got {reduction}): the compressed chain is not "
+            "an order of magnitude lighter on the wire")
+    elif drift:
+        invalid_reason = (
+            f"end-loss parity above the {nats_budget}-nat budget for "
+            f"{drift} (got {parity}): error feedback is not keeping "
+            "the sparsified trajectory with the dense one")
+    elif steady:
+        invalid_reason = (
+            f"steady_state_recompiles={steady:.0f} != 0: a packed "
+            "payload shape is unstable and retraces per step")
+    elif not topk8_ledger or not clap_ledger_free:
+        invalid_reason = (
+            f"EF ledger contract broken (topk8 exports ledger: "
+            f"{topk8_ledger}, clapping ledger-free: {clap_ledger_free})")
+    return {
+        "leg": "mpmd_compressed",
+        "stages": 3,
+        "microbatches": microbatches,
+        "batch": batch,
+        "density": density,
+        "steps": steps,
+        "model": {"family": "split_cnn_chain3",
+                  "partition": ["part_a", "trunk_b", "head_c"]},
+        "platform": "cpu+http-loopback",
+        "host_cores": os.cpu_count(),
+        "note": ("Dense vs topk8 vs clapping over real HTTP loopback "
+                 "hop wires, each run through its own chain. Bytes are "
+                 "the transports' request+reply body counters; parity "
+                 "is absolute nats against the dense run's end loss."),
+        "hop_wire_bytes": {"dense": dense_bytes, "topk8": topk8_bytes,
+                           "clapping": clap_bytes},
+        "hop_byte_reduction": reduction,
+        "loss_parity_nats": parity,
+        "nats_budget": nats_budget,
+        "clapping_extras_ledger_free": clap_ledger_free,
+        "topk8_extras_carry_ledger": topk8_ledger,
+        "steady_state_recompiles": steady,
+        "valid": invalid_reason is None,
+        "invalid_reason": invalid_reason,
+    }
+
+
 def measure_fleet_telemetry(quick: bool) -> dict:
     """Fleet telemetry plane (PR 17): three sub-measurements over the
     obs/telemetry.py ring and obs/federate.py collector.
@@ -3336,7 +3490,7 @@ def main() -> None:
                              "replica_failover", "decode",
                              "flash_micro", "sharded_server",
                              "mpmd_pipeline", "mpmd_colocated",
-                             "fleet_telemetry"],
+                             "mpmd_compressed", "fleet_telemetry"],
                     default=None)
     ap.add_argument("--quick", action="store_true")
     args = ap.parse_args()
@@ -3357,6 +3511,7 @@ def main() -> None:
               "sharded_server": measure_sharded_server,
               "mpmd_pipeline": measure_mpmd_pipeline,
               "mpmd_colocated": measure_mpmd_colocated,
+              "mpmd_compressed": measure_mpmd_compressed,
               "fleet_telemetry": measure_fleet_telemetry}[args.role]
         print(json.dumps(fn(args.quick)))
         return
@@ -3582,6 +3737,12 @@ def main() -> None:
                                 timeout=900)
         if coloc is not None:
             detail["mpmd_colocated"] = coloc
+        # compressed hop wires (PR 18): dense vs topk8 vs clapping over
+        # real HTTP loopback hops — >= 10x hop bytes at end-loss parity
+        comp = _run_subprocess("mpmd_compressed", args.quick, CPU_ENV,
+                               timeout=900)
+        if comp is not None:
+            detail["mpmd_compressed"] = comp
 
     detail["fused"] = fused
     if fused is None:

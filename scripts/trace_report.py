@@ -352,7 +352,8 @@ def render(rep: Dict[str, Any]) -> str:
             # schedule), so every new column falls back to '-'
             lines.append(f"  {'stage':>5} {'sched':>6} {'bubble':>8} "
                          f"{'gpipe':>8} {'1f1b':>8} "
-                         f"{'reply_p50':>10} {'hops':>6} {'applyQ':>7}")
+                         f"{'reply_p50':>10} {'hops':>6} {'applyQ':>7} "
+                         f"{'ratio':>7} {'density':>8}")
             for row in stages:
                 if not isinstance(row, dict):
                     continue
@@ -373,10 +374,27 @@ def render(rep: Dict[str, Any]) -> str:
                 depth = row.get("deferred_apply_depth")
                 depth_col = (f"{int(depth):>7d}" if depth is not None
                              else f"{'-':>7}")
+                # compressed hop wire columns (PR 18): cumulative
+                # raw/wire ratio and the controller's current density —
+                # dense or pre-PR-18 sidecars carry neither, '-'
+                ratio = row.get("compression_ratio")
+                ratio_col = (f"{ratio:>6.1f}x" if ratio is not None
+                             else f"{'-':>7}")
+                dens = row.get("density")
+                dens_col = (f"{dens:>8.3f}" if dens is not None
+                            else f"{'-':>8}")
                 lines.append(
                     f"  {int(row.get('stage', 0)):>5d} {sched_col} "
                     f"{bub_col} {gpipe_col} {onefb_col} {p50_col} "
-                    f"{int(row.get('hop_calls', 0)):>6d} {depth_col}")
+                    f"{int(row.get('hop_calls', 0)):>6d} {depth_col} "
+                    f"{ratio_col} {dens_col}")
+        dc = pipe.get("density")
+        if isinstance(dc, dict) and dc.get("windows_closed"):
+            lines.append(
+                f"  adaptive density: {dc.get('windows_closed')} windows "
+                f"(budget {dc.get('budget_nats')} nats / "
+                f"{dc.get('window')}-step window), "
+                f"final {dc.get('densities')}")
     tqw = rep.get("tenant_queue_wait")
     if tqw:
         lines.append("")

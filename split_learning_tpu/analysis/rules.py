@@ -406,7 +406,8 @@ _NONDET_IMPORTS = {
 
 def check_slt004(src: Src) -> Iterator[Finding]:
     if not (_ends(src, "transport/chaos.py", "transport/codec.py",
-                  "native/codec.py", "runtime/breaker.py")
+                  "transport/density.py", "native/codec.py",
+                  "runtime/breaker.py")
             or _in_dir(src, "ops")):
         return
     for node in ast.walk(src.tree):

@@ -293,6 +293,32 @@ def _server_mesh(args):
         raise ValueError(str(e)) from e
 
 
+def _density_arg(v: str):
+    """argparse type for --compress-density: a float, or the literal
+    "auto" (PR 18 adaptive density controller, chain wires only)."""
+    s = str(v).strip().lower()
+    if s == "auto":
+        return "auto"
+    try:
+        return float(s)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"--compress-density must be a float or 'auto' (got {v!r})")
+
+
+def _density_or_default(args) -> float:
+    """The plain-float density for paths that cannot run the adaptive
+    controller (2-party wires, serve replies): 'auto' warns and falls
+    back to the historical default."""
+    d = getattr(args, "compress_density", 0.1)
+    if d == "auto":
+        print("[warn] --compress-density auto drives the chain hop "
+              "wires only (mode=split, --stages > 2); this wire uses "
+              "the fixed default 0.1", file=sys.stderr)
+        return 0.1
+    return float(d)
+
+
 def cmd_train(args) -> int:
     # must run before any JAX backend initializes (DCN multi-host, no-op
     # for single-process runs)
@@ -483,6 +509,32 @@ def cmd_train(args) -> int:
         lag = getattr(args, "apply_lag", 0) or 0
         stage_rts: list = []
         transports: list = []
+        # compressed hop wires (PR 18): --compress extends the 2-party
+        # codec to every hop of the chain; --compress-density auto binds
+        # one adaptive DensityController across all of them. The
+        # device wire is exempt — it ships device buffers zero-copy,
+        # there are no wire bytes to compress.
+        chain_compress = getattr(args, "compress", None)
+        if chain_compress and args.transport == "device":
+            print("[warn] --compress ignored on --transport device "
+                  "(zero-copy device wire; nothing to compress)",
+                  file=sys.stderr)
+            chain_compress = None
+        chain_dc = None
+        chain_density = getattr(args, "compress_density", 0.1)
+        if chain_density == "auto":
+            if chain_compress in ("topk8", "clapping"):
+                from split_learning_tpu.transport.density import (
+                    DensityController)
+                chain_dc = DensityController()
+                chain_density = 0.1  # fallback; controller drives wires
+            else:
+                print("[warn] --compress-density auto needs --compress "
+                      "topk8 or clapping; using the fixed default 0.1",
+                      file=sys.stderr)
+                chain_density = 0.1
+        chain_ef_mode = ("clapping" if chain_compress == "clapping"
+                         else "topk8")
         if args.transport == "http":
             from split_learning_tpu.transport.http import HttpTransport
             urls = [u.strip() for u in
@@ -495,7 +547,11 @@ def cmd_train(args) -> int:
                       file=sys.stderr)
                 return 2
             for i, url in enumerate(urls):
-                t = HttpTransport(url)
+                t = HttpTransport(url,
+                                  compress=chain_compress or "none",
+                                  density=chain_density,
+                                  density_controller=chain_dc,
+                                  wire_id=f"hop{i + 1}")
                 info = t.wait_ready(timeout=args.wait_server)
                 if info.get("role") != "stage" \
                         or info.get("stage_index") != i + 1:
@@ -518,7 +574,8 @@ def cmd_train(args) -> int:
                 srt = StageRuntime(plan, i, cfg,
                                    jax.random.PRNGKey(cfg.seed), sample,
                                    microbatches=M, apply_lag=lag,
-                                   mesh=_server_mesh(args))
+                                   mesh=_server_mesh(args),
+                                   ef_mode=chain_ef_mode)
                 stage_rts.append(srt)
                 if args.transport == "device":
                     # zero-copy co-located wire: device buffers hand
@@ -528,7 +585,10 @@ def cmd_train(args) -> int:
                         DeviceTransport)
                     transports.append(DeviceTransport(srt))
                 else:
-                    transports.append(LocalTransport(srt))
+                    transports.append(LocalTransport(
+                        srt, compress=chain_compress,
+                        density=chain_density,
+                        density_controller=chain_dc))
         chaos_spec = getattr(args, "chaos", None)
         if chaos_spec:
             from split_learning_tpu.transport.chaos import (
@@ -545,6 +605,12 @@ def cmd_train(args) -> int:
                   file=sys.stderr)
         runner = PipelineRunner(plan, cfg, rng, sample, transports,
                                 microbatches=M, schedule=cfg.schedule)
+        runner.density_controller = chain_dc  # None unless density=auto
+        if chain_compress:
+            print(f"[compress] chain hop wires: {chain_compress} "
+                  f"(density "
+                  f"{'auto' if chain_dc is not None else chain_density}, "
+                  f"ef {chain_ef_mode})", file=sys.stderr)
 
         # telemetry plane (PR 17): the hub is a party too — give it a
         # windowed ring over its own step/hop registry and (with
@@ -668,6 +734,13 @@ def cmd_train(args) -> int:
                   f"(ideal {st['bubble_theoretical']:.3f}) "
                   f"reply_p50={st['reply_p50_ms']:.1f}ms",
                   file=sys.stderr)
+        dc_snap = chain_meta.get("density")
+        if dc_snap is not None:
+            print(f"[density] adaptive controller: "
+                  f"windows={dc_snap['windows_closed']} "
+                  f"densities={dc_snap['densities']} "
+                  f"(budget {dc_snap['budget_nats']} nats / "
+                  f"{dc_snap['window']}-step window)", file=sys.stderr)
         if stage_rts:
             full_params = [runner.state.params] + [
                 srt.export_state().params for srt in stage_rts]
@@ -872,7 +945,7 @@ def cmd_train(args) -> int:
         transport_factory = None
         if args.transport == "http":
             from split_learning_tpu.transport.http import HttpTransport
-            density = getattr(args, "compress_density", 0.1)
+            density = _density_or_default(args)
             # pool >= depth: a shared session with W > 10 lanes would
             # otherwise serialize on urllib3's default pool of 10
             pool = max(32, depth)
@@ -920,13 +993,18 @@ def cmd_train(args) -> int:
                 _make_replica, getattr(args, "replicas", 1) or 1,
                 sync_every=getattr(args, "replica_sync_every", 0) or 0,
                 handoff=getattr(args, "handoff", "live") or "live",
-                seed=cfg.seed)
+                seed=cfg.seed,
+                # compressed replica sync rides the same switch as the
+                # wire (PR 18); int8/none keep the dense legacy sync
+                sync_compress=(args.compress if args.compress in
+                               ("topk8", "clapping") else None),
+                sync_density=_density_or_default(args))
             # --compress plumbs here too (wire emulation through the real
             # codec) so compressed-path runs don't need sockets; None
             # keeps the legacy direct path bit-for-bit
             transport = LocalTransport(
                 server, compress=args.compress,
-                density=getattr(args, "compress_density", 0.1))
+                density=_density_or_default(args))
         chaos_spec = getattr(args, "chaos", None)
         if chaos_spec:
             # seeded fault injection wraps whichever wire was built —
@@ -1212,7 +1290,9 @@ def cmd_serve(args) -> int:
                 microbatches=max(cfg.microbatches, 1),
                 apply_lag=args.apply_lag,
                 tenants=args.tenants, quota=args.quota,
-                slo_ms=args.slo_ms, mesh=_server_mesh(args))
+                slo_ms=args.slo_ms, mesh=_server_mesh(args),
+                ef_mode=("clapping" if args.compress == "clapping"
+                         else "topk8"))
         except ValueError as e:  # e.g. stage_index out of range
             print(f"[error] {e}", file=sys.stderr)
             return 2
@@ -1242,13 +1322,19 @@ def cmd_serve(args) -> int:
                     slo_ms=args.slo_ms,
                     decouple_bwd=args.decouple_bwd,
                     apply_lag=args.apply_lag,
-                    mesh=_server_mesh(args))
+                    mesh=_server_mesh(args),
+                    ef_mode=("clapping" if args.compress == "clapping"
+                             else "topk8"))
             from split_learning_tpu.runtime.replica import maybe_replicate
             runtime = maybe_replicate(
                 _make_replica, n_replicas,
                 sync_every=getattr(args, "replica_sync_every", 0) or 0,
                 handoff=getattr(args, "handoff", "live") or "live",
-                seed=cfg.seed)
+                seed=cfg.seed,
+                sync_compress=(args.compress if args.compress in
+                               ("topk8", "clapping") else None),
+                sync_density=float(getattr(args, "compress_density",
+                                           0.1) or 0.1))
         except ValueError as e:  # e.g. --coalesce-max outside split mode
             print(f"[error] {e}", file=sys.stderr)
             return 2
@@ -1830,17 +1916,26 @@ def main(argv: Optional[list] = None) -> int:
                     help="on a raw-file miss, download the canonical "
                          "distribution into --data-dir (sha256-verified; "
                          "default stays hermetic/offline)")
-    pt.add_argument("--compress", choices=["none", "int8", "topk8"],
+    pt.add_argument("--compress",
+                    choices=["none", "int8", "topk8", "clapping"],
                     default=None,
                     help="wire compression of the cut-layer tensors "
-                         "(http transport only): int8 = dense 4x "
-                         "quantization; topk8 = top-k sparsification + "
-                         "int8 with error feedback (~17x at the default "
-                         "density — see README 'Wire compression')")
+                         "(http/local transports) and, in a chain run "
+                         "(--stages > 2), of every hop wire: int8 = "
+                         "dense 4x quantization; topk8 = top-k "
+                         "sparsification + int8 with error feedback "
+                         "(~17x at the default density); clapping = "
+                         "topk8 selection with storage-free error "
+                         "feedback — nothing persisted or migrated "
+                         "(README 'Pipeline compression')")
     pt.add_argument("--compress-density", dest="compress_density",
-                    type=float, default=0.1,
-                    help="topk8 only: fraction of cut-layer elements "
-                         "shipped per step (default 0.1)")
+                    type=_density_arg, default=0.1,
+                    help="topk8/clapping: fraction of elements shipped "
+                         "per step (default 0.1), or 'auto' — the "
+                         "deterministic adaptive density controller "
+                         "(chain runs only): tightens per-wire density "
+                         "while end-loss stays inside a rolling parity "
+                         "budget, loosens every wire when it drifts")
     pt.add_argument("--pipeline-depth", dest="pipeline_depth", type=int,
                     default=1,
                     help="split mode, local/http transports: keep up to N "
@@ -2011,11 +2106,15 @@ def main(argv: Optional[list] = None) -> int:
                          "heavy weight matrices (and their optimizer "
                          "mirrors) shard across it via the SpecLayout "
                          "column-then-row rule")
-    ps.add_argument("--compress", choices=["none", "int8", "topk8"],
+    ps.add_argument("--compress",
+                    choices=["none", "int8", "topk8", "clapping"],
                     default=None,
                     help="default wire compression for replies to clients "
                          "that do not pick one themselves (a request's own "
-                         "compress key always wins)")
+                         "compress key always wins); clapping also "
+                         "switches this party's reply-side error "
+                         "feedback to the storage-free ledger (no EF "
+                         "state in checkpoints or failover handoffs)")
     ps.add_argument("--compress-density", dest="compress_density",
                     type=float, default=0.1,
                     help="topk8 only: default reply density (default 0.1)")

@@ -171,6 +171,15 @@ FLIGHT_EVENTS = (
     FL_STAGE_REPLY, FL_ROUTE, FL_REPLICA_DEATH, FL_HANDOFF_BEGIN,
     FL_HANDOFF_COMMIT, FL_SLO_ALERT)
 
+# -- compressed hop wires (transport/density.py, PR 18) ---------------- #
+# metrics-gauge-only name prefix (the admission_* precedent — never a
+# trace span): the adaptive density controller's current per-wire
+# density, published by the hub as ``wire_density_<wire>`` after each
+# decision window (render_prometheus adds the slt_ prefix ->
+# slt_wire_density_*). Pairs with the per-runtime
+# ``wire_compression_ratio`` gauge the transports feed.
+WIRE_DENSITY = "wire_density"
+
 # -- telemetry plane (obs/telemetry.py, PR 17) ------------------------- #
 # metrics-gauge-only names (the admission_* precedent — never trace
 # spans): the multi-window SLO burn rates the SLOTracker publishes per

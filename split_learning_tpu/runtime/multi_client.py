@@ -37,7 +37,9 @@ class MultiClientSplitRunner:
                  sync_bottoms_every: int = 0,
                  logger: Optional[Any] = None,
                  concurrent: bool = False,
-                 profiler: Optional[Any] = None) -> None:
+                 profiler: Optional[Any] = None,
+                 sync_compress: Optional[str] = None,
+                 sync_density: float = 0.1) -> None:
         """transport_factory(client_id) -> a Transport for that client.
         sync_bottoms_every: if > 0, FedAvg the client bottom stages every
         that many rounds (0 = fully personal bottoms).
@@ -48,7 +50,15 @@ class MultiClientSplitRunner:
         deterministic relay schedule the interleaving tests pin.
         profiler: one PhaseProfiler shared by every client (it is
         thread-safe, so concurrent=True rounds aggregate correctly) —
-        the pooled compute-vs-transport split across the fleet."""
+        the pooled compute-vs-transport split across the fleet.
+        sync_compress: None (default) keeps sync_bottoms dense and
+        bit-for-bit legacy. "topk8"/"clapping" route each client's
+        contribution through the wire codec as a delta from the last
+        agreed mean (state.compressed_sync_contribution — raw params
+        are dense, drift is sparse), with error feedback carrying the
+        dropped drift into the next round. The first sync is always
+        dense (no reference yet). Byte savings accumulate on
+        ``sync_raw_bytes`` / ``sync_wire_bytes``."""
         n = num_clients if num_clients is not None else cfg.num_clients
         if n < 1:
             raise ValueError("need at least one client")
@@ -65,6 +75,18 @@ class MultiClientSplitRunner:
         ]
         self._steps = [0] * n
         self._rounds = 0
+        if sync_compress not in (None, "topk8", "clapping"):
+            raise ValueError(
+                f"unknown sync compression {sync_compress!r}")
+        self.sync_compress = sync_compress
+        self.sync_density = float(sync_density)
+        self._sync_ef = None
+        self._sync_ref = None  # last agreed mean (the delta reference)
+        self.sync_raw_bytes = 0
+        self.sync_wire_bytes = 0
+        if sync_compress is not None:
+            from split_learning_tpu.transport import codec
+            self._sync_ef = codec.make_wire_ef(sync_compress)
 
     def train_round(self, batches_per_client: Sequence[Tuple[np.ndarray, np.ndarray]]
                     ) -> List[float]:
@@ -180,13 +202,31 @@ class MultiClientSplitRunner:
         averaging an untrained init into the round would drag every
         bottom toward initialization, and overwriting the dropout's
         params would hide that it never contributed."""
-        from split_learning_tpu.runtime.state import fedavg_mean
+        from split_learning_tpu.runtime.state import (
+            compressed_sync_contribution, fedavg_mean)
         self._flush_server_halves()
         ready = [c for c in self.clients
                  if c.state is not None and int(c.state.step) > 0]
         if len(ready) < 2:
             return
-        mean_params = fedavg_mean([c.state.params for c in ready])
+        if self._sync_ef is not None and self._sync_ref is not None:
+            # compressed round: each contribution is ref + topk8(drift);
+            # EF repays each client's dropped drift next round
+            contribs = []
+            for c in ready:
+                rec, raw_b, wire_b = compressed_sync_contribution(
+                    self._sync_ef, f"sync_bottom{c.client_id}",
+                    c.state.params, self._sync_ref, self.sync_density)
+                self.sync_raw_bytes += raw_b
+                self.sync_wire_bytes += wire_b
+                contribs.append(rec)
+            mean_params = fedavg_mean(contribs)
+        else:
+            # dense round: no reference yet (first sync), or
+            # compression off — bit-for-bit the legacy path
+            mean_params = fedavg_mean([c.state.params for c in ready])
+        if self._sync_ef is not None:
+            self._sync_ref = mean_params
         for c in ready:
             c.state = TrainState(params=mean_params,
                                  opt_state=c.state.opt_state,

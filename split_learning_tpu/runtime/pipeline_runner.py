@@ -222,6 +222,12 @@ class PipelineRunner:
         # telemetry is on, None otherwise (zero-overhead-off: the only
         # cost when off is this None check per hop/step)
         self.telemetry_registry = None
+        # adaptive density (PR 18): a transport.density.DensityController
+        # shared with the chain transports — attached by the launcher
+        # when --compress-density auto, None otherwise. The driver is
+        # the single writer of note_loss (between steps, no hops in
+        # flight), which is what makes the trajectory deterministic.
+        self.density_controller = None
 
     # ------------------------------------------------------------------ #
     def _build_jitted(self) -> None:
@@ -415,7 +421,17 @@ class PipelineRunner:
         if reg is not None:  # telemetry plane (PR 17), off=None
             reg.observe(spans.STEP_TOTAL, step_wall)
             reg.incr("hub_steps_total")
-        return float(np.mean(losses))
+        loss_mean = float(np.mean(losses))
+        dc = self.density_controller
+        if dc is not None:
+            # rung moves happen HERE, between steps — no request reads a
+            # density mid-change, so same seed + schedule => same
+            # trajectory (SLT004: pure function of losses and ratios)
+            dc.note_loss(loss_mean)
+            if reg is not None:
+                for wire, d in dc.densities().items():
+                    reg.set_gauge(f"{spans.WIRE_DENSITY}_{wire}", d)
+        return loss_mean
 
     def predict(self, x: np.ndarray) -> np.ndarray:
         """Forward-only through the whole chain (each stage's predict
@@ -454,7 +470,7 @@ class PipelineRunner:
                 depth = h.get("counters", {}).get("deferred_apply_depth")
             except Exception:  # noqa: BLE001 — report stays best-effort
                 pass
-            out.append({
+            row = {
                 "stage": i + 1,
                 "schedule": self.schedule,
                 "warmup_depth": warm,
@@ -466,7 +482,21 @@ class PipelineRunner:
                 "reply_p50_ms": p50 * 1e3,
                 "hop_calls": fwd.calls + (bwd.calls if bwd else 0),
                 "deferred_apply_depth": depth,
-            })
+            }
+            # compressed hop wire accounting (PR 18): cumulative ratio
+            # from the transport's own counters, plus the controller's
+            # current density when adaptive density drives this wire
+            summ = t.stats.summary()
+            if summ.get("compress_wire_bytes"):
+                row["compression_ratio"] = summ.get("compression_ratio")
+                row["compress_raw_bytes"] = summ["compress_raw_bytes"]
+                row["compress_wire_bytes"] = summ["compress_wire_bytes"]
+            dc = self.density_controller
+            wid = getattr(t, "wire_id", None) or getattr(
+                getattr(t, "inner", None), "wire_id", None)
+            if dc is not None and wid is not None:
+                row["density"] = dc.densities().get(wid)
+            out.append(row)
         return out
 
     def trace_metadata(self) -> Dict[str, Any]:
@@ -485,6 +515,11 @@ class PipelineRunner:
                                              self.plan.num_stages),
             "steps": self.steps_done,
             "stages": self.stage_report(),
+            # adaptive density (PR 18): full deterministic trajectory —
+            # absent entirely when no controller is attached, so the
+            # report's tolerant parser stays backward-compatible
+            **({"density": self.density_controller.snapshot()}
+               if self.density_controller is not None else {}),
         }
 
     def close(self) -> None:

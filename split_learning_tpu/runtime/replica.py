@@ -135,12 +135,17 @@ class ReplicaGroup:
                  handoff: str = "live",
                  ckpt_dir: Optional[str] = None,
                  failure_threshold: int = 3,
-                 seed: int = 0) -> None:
+                 seed: int = 0,
+                 sync_compress: Optional[str] = None,
+                 sync_density: float = 0.1) -> None:
         if not replicas:
             raise ValueError("ReplicaGroup needs at least one replica")
         if handoff not in HANDOFF_MODES:
             raise ValueError(
                 f"handoff must be one of {HANDOFF_MODES} (got {handoff!r})")
+        if sync_compress not in (None, "topk8", "clapping"):
+            raise ValueError(
+                f"unknown sync compression {sync_compress!r}")
         self.replicas: List[Any] = list(replicas)
         self.sync_every = int(sync_every)
         self.handoff_mode = handoff
@@ -166,6 +171,18 @@ class ReplicaGroup:
             "replica_fenced_waits": 0.0}
         self._steps_since_sync = 0
         self._ckpt_lineage = 0
+        # compressed replica sync (PR 18): same delta-from-reference
+        # path sync_bottoms uses — None keeps sync_now bit-for-bit
+        # legacy dense
+        self.sync_compress = sync_compress
+        self.sync_density = float(sync_density)
+        self._sync_ef = None
+        self._sync_ref = None
+        if sync_compress is not None:
+            from split_learning_tpu.transport import codec
+            self._sync_ef = codec.make_wire_ef(sync_compress)
+            self._counters["sync_raw_bytes"] = 0.0
+            self._counters["sync_wire_bytes"] = 0.0
 
     # -- liveness (PR-4 breaker machinery) ------------------------------ #
     def _make_probe(self, idx: int) -> Callable[[], Any]:
@@ -576,8 +593,10 @@ class ReplicaGroup:
         with self._lock:
             self._ckpt_lineage += 1
             lineage = self._ckpt_lineage
+        # a group of clapping-mode replicas contributes no EF records at
+        # all -> the group payload omits the key (storage-free contract)
         return _ckpt.build_extras(step, lineage, replay=replay,
-                                  wire_ef=wire_ef)
+                                  wire_ef=(wire_ef or None))
 
     def resume_from(self, state: Any, step: int,
                     extras: Optional[Dict[str, Any]] = None) -> None:
@@ -621,7 +640,8 @@ class ReplicaGroup:
         single-replica group stays bit-identical to the bare server.
         Returns the number of replicas synced."""
         from split_learning_tpu.runtime.state import fedavg_mean
-        runtimes = [self._slots[i].runtime for i in self.live_replicas()]
+        live = self.live_replicas()
+        runtimes = [self._slots[i].runtime for i in live]
         if len(runtimes) <= 1:
             # fedavg_mean's N=1 identity, taken all the way: a lone
             # replica's params are already the group mean, and skipping
@@ -637,7 +657,32 @@ class ReplicaGroup:
             # export_state flushes deferred applies under the runtime
             # lock — the mean must average caught-up tops
             params.append(r.export_state().params)
-        mean = fedavg_mean(params)
+        if self._sync_ef is not None and self._sync_ref is not None:
+            # compressed round (PR 18): each replica ships ref +
+            # topk8(drift); EF repays dropped drift next sync. First
+            # round is dense — no reference exists yet.
+            from split_learning_tpu.runtime.state import (
+                compressed_sync_contribution)
+            contribs = []
+            raw_b = wire_b = 0
+            for slot_idx, p in zip(live, params):
+                # keyed by SLOT index: a death must not bleed one
+                # replica's residual ledger into another's
+                rec, rb, wb = compressed_sync_contribution(
+                    self._sync_ef, f"sync_replica{slot_idx}",
+                    p, self._sync_ref, self.sync_density)
+                raw_b += rb
+                wire_b += wb
+                contribs.append(rec)
+            mean = fedavg_mean(contribs)
+            raw_f, wire_f = float(raw_b), float(wire_b)
+            with self._lock:
+                self._counters["sync_raw_bytes"] += raw_f
+                self._counters["sync_wire_bytes"] += wire_f
+        else:
+            mean = fedavg_mean(params)
+        if self._sync_ef is not None:
+            self._sync_ref = mean
         for r in runtimes:
             with r._lock:
                 # per-replica copy: the server's jitted step donates its
@@ -652,15 +697,21 @@ class ReplicaGroup:
 def maybe_replicate(factory: Callable[[int], Any], n: int,
                     sync_every: int = 0, handoff: str = "live",
                     ckpt_dir: Optional[str] = None,
-                    seed: int = 0) -> Any:
+                    seed: int = 0,
+                    sync_compress: Optional[str] = None,
+                    sync_density: float = 0.1) -> Any:
     """The one construction seam launch/fleet code uses. ``n <= 1``
     returns ``factory(0)`` bare — the zero-overhead-off pin: a
     single-replica deployment builds no router, no group lock, nothing
     on the step path. ``n > 1`` builds the replicas (the factory must
     produce same-init runtimes — same plan/cfg/rng per index) behind a
-    :class:`ReplicaGroup`."""
+    :class:`ReplicaGroup`. ``sync_compress``/``sync_density`` route the
+    group's FedAvg param sync through the delta-from-reference codec
+    path (PR 18); None keeps it dense."""
     if n <= 1:
         return factory(0)
     return ReplicaGroup([factory(i) for i in range(n)],
                         sync_every=sync_every, handoff=handoff,
-                        ckpt_dir=ckpt_dir, seed=seed)
+                        ckpt_dir=ckpt_dir, seed=seed,
+                        sync_compress=sync_compress,
+                        sync_density=sync_density)

@@ -78,7 +78,8 @@ class ServerRuntime:
                  slo_ms: Optional[Any] = None,
                  decouple_bwd: bool = False,
                  apply_lag: int = 0,
-                 mesh: Optional[Any] = None) -> None:
+                 mesh: Optional[Any] = None,
+                 ef_mode: str = "topk8") -> None:
         """coalesce_max > 1 turns on request coalescing (classic split
         mode only): concurrent split_step calls that arrive within
         ``coalesce_window_ms`` of each other batch into one dispatch, up
@@ -305,8 +306,12 @@ class ServerRuntime:
         # gradient segments from concurrent handler threads are safe).
         # Lives on the runtime, not the transport, so it follows the
         # training state: resume_from resets it with everything else.
+        # ef_mode "clapping" (PR 18) swaps in the storage-free ledger:
+        # identical selection math, but export/restore/merge are no-ops
+        # so checkpoints and failover handoffs carry no EF state
         from split_learning_tpu.transport import codec as _codec
-        self.wire_ef = _codec.TopK8EF()
+        self.ef_mode = str(ef_mode)
+        self.wire_ef = _codec.make_wire_ef(self.ef_mode)
         self._wire_totals = [0, 0]  # raw, wire — behind the ratio gauge
         # monotonic commit counter for the runtime-extras sidecar
         # (runtime/checkpoint.py): stamps every export so a restore can
@@ -896,7 +901,9 @@ class ServerRuntime:
                 step, self._ckpt_lineage,
                 replay=(self.replay.export_state()
                         if self.replay is not None else None),
-                wire_ef=self.wire_ef.export_state())
+                # clapping mode exports [] -> falsy -> key omitted: a
+                # storage-free server hands off / checkpoints NO ledger
+                wire_ef=(self.wire_ef.export_state() or None))
         fl = obs_flight.get_recorder()
         if fl is not None:
             fl.record(spans.FL_CKPT_CAPTURE, step=int(step),

@@ -105,7 +105,8 @@ class StageRuntime:
                  tenants: int = 1,
                  quota: Optional[Any] = None,
                  slo_ms: Optional[Any] = None,
-                 mesh: Optional[Any] = None) -> None:
+                 mesh: Optional[Any] = None,
+                 ef_mode: str = "topk8") -> None:
         """``rng``/``sample_input`` are the SHARED plan-level seed and
         stage-0 sample every party initializes the full plan from
         (keeping only its own stage) — the same convention the client
@@ -187,6 +188,15 @@ class StageRuntime:
         self._seq_floor = -1
         self._hops = {"hop_fwd": 0, "hop_bwd": 0, "hop_loss": 0}
         self._ckpt_lineage = 0
+        # reply-direction error feedback for the compressed hop wire
+        # (PR 18), keyed (client_id, path) by the transports — per
+        # runtime, so the effective key is (client, stage, op).
+        # ef_mode "clapping" swaps in the storage-free ledger: same
+        # selection math, but nothing is checkpointed or migrated.
+        from split_learning_tpu.transport import codec as _codec
+        self.ef_mode = str(ef_mode)
+        self.wire_ef = _codec.make_wire_ef(self.ef_mode)
+        self._wire_totals = [0, 0]  # raw, wire — behind the ratio gauge
         self._t_start = time.monotonic()
 
     # ------------------------------------------------------------------ #
@@ -587,7 +597,10 @@ class StageRuntime:
             payload = _ckpt.build_extras(
                 step, self._ckpt_lineage,
                 replay=(self.replay.export_state()
-                        if self.replay is not None else None))
+                        if self.replay is not None else None),
+                # clapping mode exports [] -> omitted: chain-stage
+                # handoff carries no EF ledger (PR 18 pin)
+                wire_ef=(self.wire_ef.export_state() or None))
         fl = obs_flight.get_recorder()
         if fl is not None:
             fl.record(spans.FL_CKPT_CAPTURE, step=int(step),
@@ -616,6 +629,12 @@ class StageRuntime:
                         _ckpt.decode_obj(extras["replay"]))
                 else:
                     self.replay.clear()
+            if use_extras and "wire_ef" in extras:
+                self.wire_ef.restore_state(
+                    _ckpt.decode_obj(extras["wire_ef"]))
+            else:
+                # residuals predate the restored params — start clean
+                self.wire_ef.reset()
             if use_extras:
                 self._ckpt_lineage = max(self._ckpt_lineage,
                                          int(extras["lineage"]))
@@ -679,6 +698,25 @@ class StageRuntime:
         if self._dd is not None:
             snap["gauges"].update(self._dd.gauges())
         return snap
+
+    def note_wire_compression(self, raw_bytes: int, wire_bytes: int) -> None:
+        """Fold one compressed hop exchange (logical fp32 bytes vs bytes
+        on the wire, both directions) into the metrics Registry:
+        cumulative byte counters plus the ``wire_compression_ratio``
+        gauge — same contract as ServerRuntime, so /metrics
+        distinguishes hop wires (stage-labeled via ``stage_index``)
+        from the 2-party cut wire."""
+        raw_i, wire_i = int(raw_bytes), int(wire_bytes)
+        raw_f, wire_f = float(raw_i), float(wire_i)
+        with self._lock:
+            self._wire_totals[0] += raw_i
+            self._wire_totals[1] += wire_i
+            self._metrics.incr("wire_raw_bytes", raw_f)
+            self._metrics.incr("wire_bytes", wire_f)
+            if self._wire_totals[1] > 0:
+                self._metrics.set_gauge(
+                    "wire_compression_ratio",
+                    self._wire_totals[0] / self._wire_totals[1])
 
     # -- wire-server replay hooks (transport/http.py) ------------------- #
     def replay_lookup(self, client_id: int, op: str,

@@ -98,6 +98,37 @@ def apply_grads(tx: optax.GradientTransformation, state: TrainState,
     return TrainState(params=params, opt_state=opt_state, step=state.step + 1)
 
 
+def compressed_sync_contribution(ef, tag, params, ref, density
+                                 ) -> Tuple[Params, int, int]:
+    """One party's contribution to a compressed param sync (PR 18):
+    delta-from-reference through the topk8 wire codec.
+
+    Raw params are a terrible topk8 input — most weights carry mass, so
+    keeping the top 10% |x| zeroes ~90% of the model. What IS sparse is
+    how far each party has drifted from the last agreed mean, so the
+    wire carries ``topk8(params - ref)`` and the receiver reconstructs
+    ``ref + delta'``. The EF ledger (keyed ``(tag, leaf_index)``,
+    decay 1.0 — a param delta is an additive signal that must be fully
+    repaid) carries the dropped drift into the next sync round, so
+    repeated syncs converge on the true mean instead of systematically
+    under-shooting. Returns ``(reconstruction, raw_bytes, wire_bytes)``
+    — the byte pair feeds the sync_raw_bytes/sync_wire_bytes counters."""
+    import numpy as np
+    from split_learning_tpu.transport import codec
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    ref_leaves = jax.tree_util.tree_flatten(ref)[0]
+    out, raw_b, wire_b = [], 0, 0
+    for i, (p, r) in enumerate(zip(leaves, ref_leaves)):
+        p_np = np.asarray(p, dtype=np.float32)
+        r_np = np.asarray(r, dtype=np.float32)
+        packed = ef.compress((tag, i), p_np - r_np, density, decay=1.0)
+        rb, wb = codec.compressed_leaf_bytes(packed)
+        raw_b += rb
+        wire_b += wb
+        out.append(r_np + codec.decompress_tree(packed))
+    return jax.tree_util.tree_unflatten(treedef, out), raw_b, wire_b
+
+
 def fedavg_mean(params_list, weights=None) -> Params:
     """FedAvg: leafwise mean over client param pytrees — the real
     aggregation the reference left as a TODO (src/server_part.py:81-82).

@@ -329,9 +329,13 @@ EF_DECAY_GRADS = 1.0
 EF_DECAY_ACTS = 0.5
 
 # tensor roles whose wire payload is a gradient (client-up "u_grads";
-# the /forward_pass and /u_backward replies) — everything else on the
-# step path is a forward activation/feature
-_GRAD_ROLES = frozenset({"u_grads", "/forward_pass", "/u_backward"})
+# the /forward_pass and /u_backward replies; the chain's backward hop
+# request "hop_g" and the /hop_backward and /hop_loss replies, which
+# carry the cut cotangent downstream) — everything else on the step
+# path is a forward activation/feature (including "hop_x" /
+# "hop_loss_x" requests and the /hop_forward reply)
+_GRAD_ROLES = frozenset({"u_grads", "/forward_pass", "/u_backward",
+                         "hop_g", "/hop_backward", "/hop_loss"})
 
 
 def ef_decay_for(role: str) -> float:
@@ -432,6 +436,53 @@ class TopK8EF:
                 key = tuple(key)
             out[key] = np.asarray(rec["res"], dtype=np.float32)
         return out
+
+
+class ClappingEF(TopK8EF):
+    """Storage-free error feedback (Clapping, arXiv:2509.19029 §3).
+
+    Same in-memory fold as :class:`TopK8EF` — the residual of micro-
+    batch t rides into microbatch t+1's selection, so dropped mass is
+    delayed one pipeline tick, never lost — but the ledger is declared
+    *ephemeral*: nothing is checkpointed, nothing migrates on a PR-15
+    replica handoff, and a restart simply starts folding from zero.
+    The staleness this admits is exactly the delayed-gradient bound of
+    pipeline-parallel optimization (arXiv:1910.05104): the residual is
+    at most one selection old, and losing it on a crash costs one
+    microbatch of dropped mass — the same mass a dense retransmit of
+    that microbatch would have re-sent anyway.
+
+    Concretely: ``export_state()`` is empty (so
+    ``checkpoint.build_extras`` omits the ``wire_ef`` field entirely
+    and the extras sidecar measurably shrinks), ``restore_state`` /
+    ``merge_state`` ignore their input — a topk8-mode snapshot restored
+    into a clapping endpoint does not resurrect a ledger the mode
+    promised not to keep."""
+
+    def export_state(self) -> list:
+        return []
+
+    def restore_state(self, entries: list) -> None:
+        del entries  # storage-free: nothing persists, nothing restores
+
+    def merge_state(self, entries: list) -> int:
+        del entries  # handoff migrates no ledger in clapping mode
+        return 0
+
+
+# the EF ledger modes a wire endpoint can run; "clapping" is topk8
+# selection + the storage-free ledger above
+EF_MODES = ("topk8", "clapping")
+
+
+def make_wire_ef(mode: str) -> TopK8EF:
+    """EF ledger for ``mode`` — the one switch point every endpoint
+    (ServerRuntime, StageRuntime, the client transports) routes
+    through, so a mode typo fails at construction, not at handoff."""
+    if mode not in EF_MODES:
+        raise CodecError(
+            f"unknown EF mode {mode!r} (expected one of {EF_MODES})")
+    return ClappingEF() if mode == "clapping" else TopK8EF()
 
 
 def compressed_leaf_bytes(obj: Any) -> Tuple[int, int]:

@@ -49,9 +49,10 @@ _OP_BY_PATH = {"/forward_pass": "split_step", "/u_forward": "u_forward",
 # MPMD pipeline hops (PR 14): served by a StageRuntime behind the same
 # handler. Every per-step keyed mechanism (chaos schedule, replay
 # lookup, attach_reply_body) uses the composite hop_seq(step, mb)
-# ordinal for these paths. Hops always travel lossless — the cut
-# tensors cross two wires per step and compression residual/EF ledgers
-# are per-(client, op); composing them across a chain is future work.
+# ordinal for these paths. Hop payloads compress like the 2-party cut
+# (PR 18): each hop wire is its own EF endpoint — the client transport
+# is bound to one stage and the stage's reply ledger keys (client,
+# path), so residuals never mix across the chain's wires.
 _HOP_PATHS = ("/hop_forward", "/hop_backward", "/hop_loss")
 
 
@@ -78,7 +79,7 @@ class SplitHTTPServer:
         each server its own ring); None falls back to the process-global
         ring, and 404 when both are off — the off-path serves exactly
         the legacy routes."""
-        if compress not in ("none", "int8", "topk8"):
+        if compress not in ("none", "int8", "topk8", "clapping"):
             raise ValueError(f"unknown compression {compress!r}")
         self.runtime = runtime
         self.chaos = chaos
@@ -89,7 +90,8 @@ class SplitHTTPServer:
         # reply-direction error feedback: prefer the runtime's buffer
         # (survives transport restarts, reset by resume_from); this local
         # one is the fallback for bare runtimes in tests
-        self._wire_ef = codec.TopK8EF()
+        self._wire_ef = codec.make_wire_ef(
+            "clapping" if compress == "clapping" else "topk8")
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -283,9 +285,7 @@ class SplitHTTPServer:
                     mode = req.get("compress") or outer.default_compress
                     density = float(req.get("density",
                                             outer.default_density))
-                    if self.path in _HOP_PATHS:
-                        mode = "none"  # hops travel lossless (above)
-                    if mode == "topk8":
+                    if mode in ("topk8", "clapping"):
                         # per-(client, op) error feedback on the reply
                         # direction — handler threads serving a coalesced
                         # group pack concurrently, so buffers must never
@@ -326,7 +326,7 @@ class SplitHTTPServer:
                             # statelessly — running the EF compressor
                             # again for a step it already packed would
                             # corrupt the residual ledger
-                            if mode == "topk8":
+                            if mode in ("topk8", "clapping"):
                                 pack = (lambda a: codec.topk8_compress(
                                     np.asarray(a), density)[0])
                             if op == "split_step":
@@ -336,15 +336,16 @@ class SplitHTTPServer:
                             elif op == "u_forward":
                                 resp = {"features": pack(cached)}
                             elif op == "hop_fwd":
-                                resp = {"y": cached, "step": req["step"],
+                                resp = {"y": pack(cached),
+                                        "step": req["step"],
                                         "mb": req.get("mb", 0)}
                             elif op == "hop_loss":
-                                resp = {"grads": cached[0],
+                                resp = {"grads": pack(cached[0]),
                                         "loss": cached[1],
                                         "step": req["step"],
                                         "mb": req.get("mb", 0)}
                             elif op == "hop_bwd":
-                                resp = {"grads": cached,
+                                resp = {"grads": pack(cached),
                                         "step": req["step"],
                                         "mb": req.get("mb", 0)}
                             else:
@@ -372,19 +373,19 @@ class SplitHTTPServer:
                         y = outer.runtime.hop_forward(
                             req["x"], int(req["step"]),
                             int(req.get("mb", 0)), cid)
-                        resp = {"y": y, "step": req["step"],
+                        resp = {"y": pack(y), "step": req["step"],
                                 "mb": req.get("mb", 0)}
                     elif self.path == "/hop_backward":
                         g = outer.runtime.hop_backward(
                             req["g"], int(req["step"]),
                             int(req.get("mb", 0)), cid)
-                        resp = {"grads": g, "step": req["step"],
+                        resp = {"grads": pack(g), "step": req["step"],
                                 "mb": req.get("mb", 0)}
                     elif self.path == "/hop_loss":
                         g, loss = outer.runtime.hop_loss(
                             req["x"], req["labels"], int(req["step"]),
                             int(req.get("mb", 0)), cid)
-                        resp = {"grads": g, "loss": loss,
+                        resp = {"grads": pack(g), "loss": loss,
                                 "step": req["step"],
                                 "mb": req.get("mb", 0)}
                     elif self.path == "/predict":
@@ -465,13 +466,24 @@ class HttpTransport(Transport):
 
     def __init__(self, base_url: str, timeout: float = 60.0,
                  compress: str = "none", density: float = 0.1,
-                 pool_maxsize: int = 32) -> None:
+                 pool_maxsize: int = 32,
+                 density_controller: Optional[Any] = None,
+                 wire_id: Optional[str] = None) -> None:
         """``compress="int8"`` quantizes the cut-layer tensors on the wire
         (4x fewer bytes; lossy — see ops/quantize.py). ``"topk8"`` ships
         only the top ``density`` fraction of magnitudes as int8 with
         sender-side error feedback (~17x at density 0.1 — see
-        transport/codec.py). Weights (/aggregate_weights) always travel
-        lossless.
+        transport/codec.py); ``"clapping"`` is the same selection with
+        the storage-free EF ledger (codec.ClappingEF — nothing
+        checkpointed, nothing migrated). Weights (/aggregate_weights)
+        always travel lossless. Pipeline hop payloads compress too —
+        one transport serves one stage, so its EF ledger is that hop
+        wire's (client, stage, op) endpoint.
+
+        density_controller / wire_id: optional
+        transport.density.DensityController; when bound, every packed
+        payload reads its density from the controller under this wire's
+        id and feeds the achieved byte ratio back.
 
         ``pool_maxsize`` sizes the urllib3 connection pool mounted on
         the session. requests' default is 10; a pipelined client sharing
@@ -480,7 +492,7 @@ class HttpTransport(Transport):
         callers with deep windows must pass ``pool_maxsize >= depth``
         (launch/run.py does)."""
         super().__init__()
-        if compress not in ("none", "int8", "topk8"):
+        if compress not in ("none", "int8", "topk8", "clapping"):
             raise ValueError(f"unknown compression {compress!r}")
         if pool_maxsize < 1:
             raise ValueError(f"pool_maxsize must be >= 1 (got {pool_maxsize})")
@@ -489,9 +501,12 @@ class HttpTransport(Transport):
         self.compress = compress
         self.density = float(density)
         self.pool_maxsize = int(pool_maxsize)
+        self._dc = density_controller
+        self.wire_id = wire_id if wire_id is not None else base_url
         # up-direction error feedback, keyed per op (one transport = one
         # client, so the op name is the whole key)
-        self._ef = codec.TopK8EF()
+        self._ef = codec.make_wire_ef(
+            "clapping" if compress == "clapping" else "topk8")
         self._session = requests.Session()
         adapter = requests.adapters.HTTPAdapter(
             pool_connections=self.pool_maxsize,
@@ -499,15 +514,24 @@ class HttpTransport(Transport):
         self._session.mount("http://", adapter)
         self._session.mount("https://", adapter)
 
+    def _topk8(self) -> bool:
+        return self.compress in ("topk8", "clapping")
+
+    def _density_now(self) -> float:
+        if self._dc is not None:
+            return self._dc.density(self.wire_id)
+        return self.density
+
     def _pack(self, arr: np.ndarray, key: str = "x") -> Any:
         if self.compress == "int8":
             return codec.q8_compress(np.asarray(arr))
-        if self.compress == "topk8":
+        if self._topk8():
             if key == "predict":
                 # stateless: no later step repays an inference residual
                 return codec.topk8_compress(np.asarray(arr),
-                                            self.density)[0]
-            return self._ef.compress(key, np.asarray(arr), self.density,
+                                            self._density_now())[0]
+            return self._ef.compress(key, np.asarray(arr),
+                                     self._density_now(),
                                      decay=codec.ef_decay_for(key))
         return np.asarray(arr)
 
@@ -524,7 +548,7 @@ class HttpTransport(Transport):
         replay cache) or never saw it (lost request -> retry dispatched
         fresh), the client's EF ledger ends in the same state it would
         have reached on a clean wire."""
-        if self.compress == "topk8":
+        if self._topk8():
             self._ef.rollback(key)
 
     def _post(self, path: str, payload: Dict[str, Any]) -> Dict[str, Any]:
@@ -542,11 +566,13 @@ class HttpTransport(Transport):
             payload = dict(payload, trace_id=tid)
         if self.compress != "none":
             payload = dict(payload, compress=self.compress)
-            if self.compress == "topk8":
-                payload["density"] = self.density
+            if self._topk8():
+                payload["density"] = self._density_now()
             raw_b, wire_b = codec.compressed_leaf_bytes(payload)
             if wire_b:
                 self.stats.record_compression(raw_b, wire_b)
+                if self._dc is not None:
+                    self._dc.note_ratio(self.wire_id, raw_b, wire_b)
         fl = obs_flight.get_recorder()
         if fl is not None and path in _TRACED_PATHS:
             fl.record(spans.FL_SEND, step=int(payload.get("step", -1)),
@@ -593,12 +619,26 @@ class HttpTransport(Transport):
                       client_id=int(payload.get("client_id", 0)),
                       party="client", trace_id=tid, path=path)
         t_dec0 = time.perf_counter() if tid is not None else 0.0
-        tree = codec.decode(resp.content)
-        if self.compress != "none":
-            raw_b, wire_b = codec.compressed_leaf_bytes(tree)
-            if wire_b:
-                self.stats.record_compression(raw_b, wire_b)
-        out = codec.decompress_tree(tree)
+        try:
+            tree = codec.decode(resp.content)
+            if self.compress != "none":
+                raw_b, wire_b = codec.compressed_leaf_bytes(tree)
+                if wire_b:
+                    self.stats.record_compression(raw_b, wire_b)
+                    if self._dc is not None:
+                        self._dc.note_ratio(self.wire_id, raw_b, wire_b)
+            out = codec.decompress_tree(tree)
+        except codec.CodecError as exc:
+            # a frame that passed the CRC gate but fails codec
+            # validation (truncated bitmap, out-of-range indices) is a
+            # BAD DELIVERY, not a protocol violation: surface it as the
+            # transient TransportError so the retry/replay machinery
+            # re-collects the original frame instead of a caller
+            # stepping on a silently-wrong tensor (or the raw
+            # ValueError killing the pipeline worker)
+            raise TransportError(
+                f"POST {path}: reply failed codec validation: "
+                f"{exc}") from exc
         if tid is not None:
             enc_s += time.perf_counter() - t_dec0  # client codec, both ways
             srv = out.pop("server_spans", None) or {}
@@ -699,9 +739,16 @@ class HttpTransport(Transport):
                          client_id)
         with timed(self.stats):
             self.stats.incr(spans.HOP_HOST_COPIES, 2)
-            out = self._post("/hop_forward", {
-                "x": np.asarray(x), "step": step, "mb": int(mb),
-                "client_id": client_id})
+            try:
+                out = self._post("/hop_forward", {
+                    "x": self._pack(x, "hop_x"), "step": step,
+                    "mb": int(mb), "client_id": client_id})
+            except Exception:
+                # a hop POST that never got its reply must not leave
+                # the shipped mass marked delivered — same EF rollback
+                # contract as the 2-party step ops
+                self._rollback("hop_x")
+                raise
         self._check_hop_echo("/hop_forward", out, step, mb)
         self._hop_flight(False, "hop_fwd", step, mb,
                          client_id)
@@ -713,9 +760,13 @@ class HttpTransport(Transport):
                          client_id)
         with timed(self.stats):
             self.stats.incr(spans.HOP_HOST_COPIES, 2)
-            out = self._post("/hop_backward", {
-                "g": np.asarray(g_out), "step": step, "mb": int(mb),
-                "client_id": client_id})
+            try:
+                out = self._post("/hop_backward", {
+                    "g": self._pack(g_out, "hop_g"), "step": step,
+                    "mb": int(mb), "client_id": client_id})
+            except Exception:
+                self._rollback("hop_g")
+                raise
         self._check_hop_echo("/hop_backward", out, step, mb)
         self._hop_flight(False, "hop_bwd", step, mb,
                          client_id)
@@ -728,9 +779,16 @@ class HttpTransport(Transport):
                          client_id)
         with timed(self.stats):
             self.stats.incr(spans.HOP_HOST_COPIES, 2)
-            out = self._post("/hop_loss", {
-                "x": np.asarray(x), "labels": np.asarray(labels),
-                "step": step, "mb": int(mb), "client_id": client_id})
+            try:
+                # labels travel lossless: integer classes quantize to
+                # garbage, and their bytes are noise next to the cut
+                out = self._post("/hop_loss", {
+                    "x": self._pack(x, "hop_loss_x"),
+                    "labels": np.asarray(labels),
+                    "step": step, "mb": int(mb), "client_id": client_id})
+            except Exception:
+                self._rollback("hop_loss_x")
+                raise
         self._check_hop_echo("/hop_loss", out, step, mb)
         self._hop_flight(False, "hop_loss", step, mb,
                          client_id)

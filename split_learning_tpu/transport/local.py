@@ -29,28 +29,52 @@ class LocalTransport(Transport):
 
     def __init__(self, server: Any, through_codec: bool = False,
                  compress: Optional[str] = None,
-                 density: float = 0.1) -> None:
+                 density: float = 0.1,
+                 ef_mode: str = "topk8",
+                 density_controller: Optional[Any] = None,
+                 wire_id: Optional[str] = None) -> None:
         """server: a ServerRuntime (duck-typed: split_step/u_forward/
-        u_backward/aggregate/health).
+        u_backward/aggregate/health) or a StageRuntime (hop ops).
 
         compress: None (default) is the legacy direct path — no wire
         emulation, bit-for-bit what this transport always did. Any of
-        "none"/"int8"/"topk8" switches the step ops to full wire
-        emulation: each direction's payload goes through the real codec
-        (encode -> byte count -> decode -> expand) with that compression
-        applied, exactly like one HTTP hop — so compressed-path parity
-        and convergence tests run in-process, no sockets. ``"none"``
-        emulates the dense fp32 wire (the baseline the bench legs
-        compare against). Weights (aggregate) always travel lossless."""
+        "none"/"int8"/"topk8"/"clapping" switches the step ops AND the
+        pipeline hop ops to full wire emulation: each direction's
+        payload goes through the real codec (encode -> byte count ->
+        decode -> expand) with that compression applied, exactly like
+        one HTTP hop — so compressed-path parity and convergence tests
+        run in-process, no sockets. ``"none"`` emulates the dense fp32
+        wire (the baseline the bench legs compare against);
+        ``"clapping"`` is topk8 selection with the storage-free EF
+        ledger (codec.ClappingEF). Weights (aggregate) always travel
+        lossless.
+
+        density_controller / wire_id: optional
+        transport.density.DensityController; when bound, every packed
+        payload reads its density from the controller under this
+        wire's id and feeds the achieved byte ratio back."""
         super().__init__()
-        if compress not in (None, "none", "int8", "topk8"):
+        if compress not in (None, "none", "int8", "topk8", "clapping"):
             raise ValueError(f"unknown compression {compress!r}")
         self.server = server
         self.through_codec = through_codec
         self.compress = compress
         self.density = float(density)
-        self._ef = codec.TopK8EF()        # up direction (client-owned)
-        self._down_ef = codec.TopK8EF()   # down fallback for bare servers
+        mode = "clapping" if compress == "clapping" else "topk8"
+        self._ef = codec.make_wire_ef(mode)       # up (client-owned)
+        self._down_ef = codec.make_wire_ef(mode)  # down, bare servers
+        self._dc = density_controller
+        stage = getattr(server, "stage_index", None)
+        self.wire_id = wire_id if wire_id is not None else (
+            f"hop{stage}" if stage is not None else "cut")
+
+    def _topk8(self) -> bool:
+        return self.compress in ("topk8", "clapping")
+
+    def _density_now(self) -> float:
+        if self._dc is not None:
+            return self._dc.density(self.wire_id)
+        return self.density
 
     def _roundtrip(self, obj: Any) -> Any:
         return codec.decode(codec.encode(obj)) if self.through_codec else obj
@@ -71,18 +95,19 @@ class LocalTransport(Transport):
     def _pack_up(self, arr: np.ndarray, key: Any) -> Any:
         if self.compress == "int8":
             return codec.q8_compress(np.asarray(arr))
-        if self.compress == "topk8":
-            return self._ef.compress(key, np.asarray(arr), self.density,
+        if self._topk8():
+            return self._ef.compress(key, np.asarray(arr),
+                                     self._density_now(),
                                      decay=codec.ef_decay_for(key[0]))
         return np.asarray(arr)
 
     def _pack_down(self, arr: np.ndarray, key: Any) -> Any:
         if self.compress == "int8":
             return codec.q8_compress(np.asarray(arr))
-        if self.compress == "topk8":
+        if self._topk8():
             # same buffer the HTTP server uses, same (client, op) keying
             ef = getattr(self.server, "wire_ef", None) or self._down_ef
-            return ef.compress(key, np.asarray(arr), self.density,
+            return ef.compress(key, np.asarray(arr), self._density_now(),
                                decay=codec.ef_decay_for(key[1]))
         return np.asarray(arr)
 
@@ -93,6 +118,13 @@ class LocalTransport(Transport):
         raw_b, wire_b = codec.compressed_leaf_bytes(payload)
         if wire_b:
             self.stats.record_compression(raw_b, wire_b)
+            if self._dc is not None:
+                self._dc.note_ratio(self.wire_id, raw_b, wire_b)
+            # mirror the HTTP server: the peer runtime folds the same
+            # bytes into its own /metrics (stage-labeled for hops)
+            nwc = getattr(self.server, "note_wire_compression", None)
+            if nwc is not None:
+                nwc(raw_b, wire_b)
         return codec.decompress_tree(codec.decode(body)), len(body)
 
     def _call(self, fn, *args):
@@ -219,8 +251,9 @@ class LocalTransport(Transport):
             if self.compress is not None:
                 # inference is stateless on both ends: no error feedback
                 a = np.asarray(activations)
-                if self.compress == "topk8":
-                    packed = codec.topk8_compress(a, self.density)[0]
+                if self._topk8():
+                    packed = codec.topk8_compress(a,
+                                                  self._density_now())[0]
                 elif self.compress == "int8":
                     packed = codec.q8_compress(a)
                 else:
@@ -228,9 +261,9 @@ class LocalTransport(Transport):
                 req, up = self._wire({"activations": packed})
                 out = self._call(self.server.predict, req["activations"],
                                  client_id)
-                if self.compress == "topk8":
+                if self._topk8():
                     packed_out = codec.topk8_compress(
-                        np.asarray(out), self.density)[0]
+                        np.asarray(out), self._density_now())[0]
                 elif self.compress == "int8":
                     packed_out = codec.q8_compress(np.asarray(out))
                 else:
@@ -279,10 +312,24 @@ class LocalTransport(Transport):
         self._hop_flight(True, "hop_fwd", step, mb,
                          client_id)
         with timed(self.stats):
-            y = self._call(self.server.hop_forward,
-                           self._hop_payload(x), step, mb,
-                           client_id)
-            res = self._roundtrip(y)
+            if self.compress is not None:
+                # the compressed hop wire (emulated, like the step ops):
+                # EF keys by role + client, and this transport is bound
+                # to ONE stage, so the ledger keying is effectively
+                # (client, stage, op) — the HTTP chain's contract
+                req, up = self._wire({"x": self._pack_up(
+                    np.asarray(x), ("hop_x", client_id))})
+                y = self._call(self.server.hop_forward, req["x"],
+                               step, mb, client_id)
+                resp, down = self._wire({"y": self._pack_down(
+                    y, (client_id, "/hop_forward"))})
+                self.stats.add_bytes(sent=up, received=down)
+                res = resp["y"]
+            else:
+                y = self._call(self.server.hop_forward,
+                               self._hop_payload(x), step, mb,
+                               client_id)
+                res = self._roundtrip(y)
         self._hop_flight(False, "hop_fwd", step, mb,
                          client_id)
         return res
@@ -292,10 +339,20 @@ class LocalTransport(Transport):
         self._hop_flight(True, "hop_bwd", step, mb,
                          client_id)
         with timed(self.stats):
-            g = self._call(self.server.hop_backward,
-                           self._hop_payload(g_out), step, mb,
-                           client_id)
-            res = self._roundtrip(g)
+            if self.compress is not None:
+                req, up = self._wire({"g": self._pack_up(
+                    np.asarray(g_out), ("hop_g", client_id))})
+                g = self._call(self.server.hop_backward, req["g"],
+                               step, mb, client_id)
+                resp, down = self._wire({"g": self._pack_down(
+                    g, (client_id, "/hop_backward"))})
+                self.stats.add_bytes(sent=up, received=down)
+                res = resp["g"]
+            else:
+                g = self._call(self.server.hop_backward,
+                               self._hop_payload(g_out), step, mb,
+                               client_id)
+                res = self._roundtrip(g)
         self._hop_flight(False, "hop_bwd", step, mb,
                          client_id)
         return res
@@ -306,11 +363,26 @@ class LocalTransport(Transport):
         self._hop_flight(True, "hop_loss", step, mb,
                          client_id)
         with timed(self.stats):
-            g, loss = self._call(self.server.hop_loss,
-                                 self._hop_payload(x),
-                                 self._hop_payload(labels),
-                                 step, mb, client_id)
-            res = self._roundtrip(g), float(loss)
+            if self.compress is not None:
+                # labels travel lossless (integer classes quantize to
+                # garbage); the loss scalar is dense by construction
+                req, up = self._wire({
+                    "x": self._pack_up(np.asarray(x),
+                                       ("hop_loss_x", client_id)),
+                    "labels": np.asarray(labels)})
+                g, loss = self._call(self.server.hop_loss, req["x"],
+                                     req["labels"], step, mb, client_id)
+                resp, down = self._wire({
+                    "g": self._pack_down(g, (client_id, "/hop_loss")),
+                    "loss": float(loss)})
+                self.stats.add_bytes(sent=up, received=down)
+                res = resp["g"], float(resp["loss"])
+            else:
+                g, loss = self._call(self.server.hop_loss,
+                                     self._hop_payload(x),
+                                     self._hop_payload(labels),
+                                     step, mb, client_id)
+                res = self._roundtrip(g), float(loss)
         self._hop_flight(False, "hop_loss", step, mb,
                          client_id)
         return res

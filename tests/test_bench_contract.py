@@ -633,3 +633,28 @@ def test_bench_fleet_telemetry_role_quick():
     # box; every deterministic gate above must hold regardless
     if not r["valid"]:
         assert "slower than off" in (r["invalid_reason"] or "")
+
+
+@pytest.mark.slow
+def test_bench_mpmd_compressed_role_quick():
+    """bench.py --role mpmd_compressed --quick end to end: dense vs
+    topk8 vs clapping over real HTTP loopback hop wires. Both
+    compressed modes must cut hop bytes >=10x AND hold end loss inside
+    the absolute-nats budget through their own wire; clapping's extras
+    must be ledger-free while topk8's carry one; and the packed payload
+    shapes must be dispatch-stable (zero steady-state recompiles)."""
+    sys.path.insert(0, REPO)
+    from bench import measure_mpmd_compressed
+    r = measure_mpmd_compressed(quick=True)
+
+    assert r["leg"] == "mpmd_compressed"
+    assert r["stages"] == 3 and r["microbatches"] == 4
+    for mode in ("dense", "topk8", "clapping"):
+        assert r["hop_wire_bytes"][mode] > 0
+    for mode in ("topk8", "clapping"):
+        assert r["hop_byte_reduction"][mode] >= 10.0
+        assert r["loss_parity_nats"][mode] <= r["nats_budget"]
+    assert r["clapping_extras_ledger_free"] is True
+    assert r["topk8_extras_carry_ledger"] is True
+    assert r["steady_state_recompiles"] == 0
+    assert r["valid"] is True, r["invalid_reason"]

@@ -14,7 +14,7 @@ from split_learning_tpu.utils import Config
 BATCH = 8
 
 
-def make(n_clients=2):
+def make(n_clients=2, **kw):
     cfg = Config(mode="split", batch_size=BATCH, num_clients=n_clients)
     plan = get_plan(mode="split")
     sample = np.zeros((BATCH, 28, 28, 1), np.float32)
@@ -22,7 +22,7 @@ def make(n_clients=2):
     runner = MultiClientSplitRunner(
         plan, cfg, jax.random.PRNGKey(0),
         transport_factory=lambda i: LocalTransport(server),
-        num_clients=n_clients)
+        num_clients=n_clients, **kw)
     return server, runner
 
 
@@ -76,6 +76,36 @@ def test_bottom_sync_fedavg():
     a, b = (jax.tree_util.tree_leaves(c.state.params) for c in runner.clients)
     for la, lb in zip(a, b):
         np.testing.assert_allclose(np.asarray(la), np.asarray(lb))
+
+
+def test_bottom_sync_compressed_delta_from_reference():
+    """sync_compress='topk8' (PR 18): the FIRST sync has no agreed
+    reference yet and goes dense — bit-identical to the legacy FedAvg
+    mean — and every later sync ships topk8 deltas from the last mean
+    (raw params are dense; inter-sync drift is sparse). Clients still
+    agree exactly after every sync (one reconstructed mean is adopted
+    by all) and the byte counters show real compression."""
+    _, runner_c = make(2, sync_bottoms_every=3, sync_compress="topk8",
+                       sync_density=0.1)
+    _, runner_d = make(2, sync_bottoms_every=3)
+    for r in range(3):
+        runner_c.train_round(batches(2, seed=r))
+        runner_d.train_round(batches(2, seed=r))
+    # first sync fired at round 3 with no reference: dense, legacy-exact
+    assert runner_c.sync_wire_bytes == 0
+    for lc, ld in zip(
+            jax.tree_util.tree_leaves(runner_c.clients[0].state.params),
+            jax.tree_util.tree_leaves(runner_d.clients[0].state.params)):
+        np.testing.assert_array_equal(np.asarray(lc), np.asarray(ld))
+    for r in range(3, 6):
+        runner_c.train_round(batches(2, seed=r))
+    # second sync shipped sparse deltas...
+    assert runner_c.sync_raw_bytes > runner_c.sync_wire_bytes > 0
+    # ...and the cohort still agrees exactly
+    a, b = (jax.tree_util.tree_leaves(c.state.params)
+            for c in runner_c.clients)
+    for la, lb in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
 
 
 def test_concurrent_clients_are_race_free():

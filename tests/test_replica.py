@@ -262,3 +262,57 @@ def test_fedavg_sync_equalizes_replica_params():
         group.split_step(x, y, 3, b)
     finally:
         group.close()
+
+
+# --------------------------------------------------------------------- #
+# compressed-wire handoff (PR 18): the storage-free EF contract
+# --------------------------------------------------------------------- #
+
+def test_clapping_handoff_migrates_no_ef_ledger():
+    """Clapping-mode replicas (storage-free EF, arXiv:2509.19029) hand
+    off NO residual ledger: the victim's extras capture omits wire_ef
+    entirely and is measurably smaller than a topk8 twin's holding the
+    identical in-memory residuals, the handoff merges zero EF entries
+    where the topk8 group merges at least one — and in both modes the
+    rerouted duplicate is still served the original reply, bit for
+    bit."""
+    from split_learning_tpu.transport import codec as wire_codec
+
+    sizes = {}
+    for mode in ("topk8", "clapping"):
+        group = ReplicaGroup(
+            [server_factory(ef_mode=mode)(i) for i in range(2)])
+        try:
+            victim = group.assignment(0)
+            # the victim packs one compressed reply for client 0,
+            # leaving a real residual in its ledger; the successor's
+            # ledger has no entry for that stream (merge_state keeps
+            # local keys, so a shared key would merge as zero)
+            rs = np.random.RandomState(1)
+            g = rs.randn(4096).astype(np.float32)
+            group.replicas[victim].wire_ef.compress(
+                (0, "/forward_pass"), g, 0.1)
+            x, y = batch(7)
+            orig_g, orig_loss = group.split_step(x, y, 0, 0)
+
+            cap = group.replicas[victim].export_runtime_extras(0)
+            sizes[mode] = len(wire_codec.encode(cap))
+            if mode == "clapping":
+                assert "wire_ef" not in cap
+            else:
+                assert "wire_ef" in cap
+
+            group.kill(victim)
+            ctr = group.counters()
+            if mode == "clapping":
+                assert ctr["handoff_ef_entries"] == 0
+            else:
+                assert ctr["handoff_ef_entries"] >= 1
+            # the dup after the kill: replayed original, never re-applied
+            dup_g, dup_loss = group.split_step(x, y, 0, 0)
+            np.testing.assert_array_equal(np.asarray(dup_g),
+                                          np.asarray(orig_g))
+            assert dup_loss == orig_loss
+        finally:
+            group.close()
+    assert sizes["clapping"] < sizes["topk8"], sizes
