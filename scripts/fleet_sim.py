@@ -58,7 +58,7 @@ from split_learning_tpu.transport.local import LocalTransport  # noqa: E402
 from split_learning_tpu.utils import Config  # noqa: E402
 
 
-def build_server(args: argparse.Namespace):
+def build_server(args: argparse.Namespace, autoscale_cfg=None):
     cfg = Config(mode="split", batch_size=args.batch,
                  num_clients=args.num_client_slots)
     plan = get_plan(mode="split")
@@ -78,8 +78,19 @@ def build_server(args: argparse.Namespace):
             quota=args.quota,
             slo_ms=args.slo_ms)
 
+    if autoscale_cfg is not None:
+        # an elastic run always fronts a ReplicaGroup — even from one
+        # starting replica — because the Autoscaler needs add/remove
+        # to exist; the zero-overhead-off pin applies only to the
+        # static --replicas 1 path below
+        from split_learning_tpu.runtime.replica import ReplicaGroup
+        n0 = max(args.replicas, int(autoscale_cfg["min_replicas"]))
+        server = ReplicaGroup([make_replica(i) for i in range(n0)],
+                              seed=args.seed)
+        return server, make_replica
     # --replicas 1 returns the bare runtime (zero-overhead-off)
-    return maybe_replicate(make_replica, args.replicas, seed=args.seed)
+    return maybe_replicate(make_replica, args.replicas,
+                           seed=args.seed), make_replica
 
 
 def make_factory(server: ServerRuntime, args: argparse.Namespace):
@@ -141,6 +152,56 @@ def _hist_ms(snap, name):
             "p99_ms": round(histogram_percentile(hist, 99) * 1e3, 3)}
 
 
+def autoscale_args_config(args):
+    """Merge the --autoscale* CLI flags over the SLT_AUTOSCALE* env
+    knobs; None when the autoscaler is off (no policy object is ever
+    constructed — the zero-overhead-off pin). Shared with launch/run.py
+    via runtime.autoscale.args_config."""
+    from split_learning_tpu.runtime import autoscale as rt_autoscale
+    return rt_autoscale.args_config(args)
+
+
+def autoscale_summary(autoscale_cfg, autoscaler, group, wall_s, n0):
+    """The ``autoscale`` block: scale-event log, replica-seconds vs the
+    static-peak counterfactual, and the policy-seen p99 trajectory.
+    Schema is stable across arms — a run without --autoscale ships the
+    same keys with the false/empty/null arm."""
+    block = {
+        "enabled": False,
+        "min_replicas": None,
+        "max_replicas": None,
+        "cooldown_s": None,
+        "decisions": 0,
+        "scale_ups": 0,
+        "scale_downs": 0,
+        "events": [],
+        "replica_seconds": None,
+        "static_peak_replica_seconds": None,
+        "peak_replicas": None,
+        "final_replicas": None,
+        "p99_ms_trajectory": [],
+    }
+    if autoscaler is None:
+        return block
+    block.update(autoscaler.summary())
+    block["enabled"] = True
+    block["min_replicas"] = int(autoscale_cfg["min_replicas"])
+    block["max_replicas"] = int(autoscale_cfg["max_replicas"])
+    block["cooldown_s"] = float(autoscale_cfg["cooldown_s"])
+    running = peak = n0
+    for ev in block["events"]:
+        running += 1 if ev["direction"] == "up" else -1
+        peak = max(peak, running)
+    block["peak_replicas"] = peak
+    block["final_replicas"] = len(group.live_replicas())
+    block["replica_seconds"] = round(
+        sum(group.replica_seconds().values()), 3)
+    # the counterfactual cost of provisioning the observed peak
+    # statically for the whole run — what elasticity must beat
+    block["static_peak_replica_seconds"] = round(peak * wall_s, 3)
+    return block
+
+
 def replication_summary(args, group, res):
     """The ``replication`` block: router/handoff counters, re-route
     latency tails, and per-replica admission/replay detail. Schema is
@@ -160,11 +221,17 @@ def replication_summary(args, group, res):
         "handoff": {k: 0 for k in handoff_keys},
         "reroute_wait": {"p50_ms": None, "p99_ms": None},
         "handoff_latency": {"p50_ms": None, "p99_ms": None},
+        # a bare server is one replica alive for the whole run — the
+        # same accounting a group reports, so static-vs-autoscale cost
+        # comparisons never branch on shape
+        "replica_seconds": round(res.wall_s, 3),
         "per_replica": [],
     }
     if group is None:
         return block
     counters = group.counters()
+    seconds = group.replica_seconds()
+    block["replica_seconds"] = round(sum(seconds.values()), 3)
     block["live_replicas"] = group.live_replicas()
     block["handoff"] = {k: int(counters.get(k, 0)) for k in handoff_keys}
     snap = group.registry.snapshot()
@@ -177,7 +244,8 @@ def replication_summary(args, group, res):
         assigned[rid] = assigned.get(rid, 0) + 1
     for i, r in enumerate(group.replicas):
         row = {"replica": i, "alive": i in live,
-               "assigned_clients": assigned.get(i, 0)}
+               "assigned_clients": assigned.get(i, 0),
+               "alive_s": round(seconds.get(i, 0.0), 3)}
         try:
             row["replay"] = (r.replay.counters()
                              if r.replay is not None else None)
@@ -191,13 +259,15 @@ def replication_summary(args, group, res):
     return block
 
 
-def setup_telemetry(args, server):
+def setup_telemetry(args, server, force=False):
     """Install a TelemetryRing over the server's (or replica group's)
     metrics() when ``--telemetry`` or SLT_TELEMETRY asks for one.
     Telemetry implies tracing — the windows' percentiles come from the
-    tracer-gated histograms. Returns the ring or None (off)."""
+    tracer-gated histograms. ``force`` is the autoscale path: the
+    policy reads its signals from ring windows, so --autoscale implies
+    the ring. Returns the ring or None (off)."""
     cfg = obs_telemetry.env_config()
-    if cfg is None and not args.telemetry:
+    if cfg is None and not args.telemetry and not force:
         return None
     if cfg is None:
         cfg = {"interval_s": obs_telemetry.DEFAULT_INTERVAL_S,
@@ -316,14 +386,36 @@ def main() -> int:
     ap.add_argument("--telemetry-interval-s", type=float, default=None,
                     help="telemetry window width in seconds "
                          "(default SLT_TELEMETRY_INTERVAL_S or 1.0)")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="policy-driven elastic replica count (also via "
+                         "SLT_AUTOSCALE=1); implies the telemetry ring "
+                         "and adds the ``autoscale`` summary block")
+    ap.add_argument("--autoscale-min", type=int, default=None,
+                    help="autoscale floor (default SLT_AUTOSCALE_MIN "
+                         "or 1)")
+    ap.add_argument("--autoscale-max", type=int, default=None,
+                    help="autoscale ceiling (default SLT_AUTOSCALE_MAX "
+                         "or 4)")
+    ap.add_argument("--autoscale-cooldown-s", type=float, default=None,
+                    help="scale-up cooldown seconds; scale-down is 2x "
+                         "(default SLT_AUTOSCALE_COOLDOWN_S or 5)")
+    ap.add_argument("--gate-autoscale", action="store_true",
+                    help="exit 1 unless the run observed >=1 scale-up "
+                         "and >=1 scale-down (needs --autoscale)")
     args = ap.parse_args()
     if args.kill_replica_at > 0 and args.replicas < 2:
         print("[fleet_sim] --kill-replica-at needs --replicas > 1",
               file=sys.stderr)
         return 2
+    autoscale_cfg = autoscale_args_config(args)
+    if args.gate_autoscale and autoscale_cfg is None:
+        print("[fleet_sim] --gate-autoscale needs --autoscale",
+              file=sys.stderr)
+        return 2
 
-    server = build_server(args)
-    group = server if args.replicas > 1 else None
+    server, make_replica = build_server(args, autoscale_cfg)
+    group = server if (args.replicas > 1
+                       or autoscale_cfg is not None) else None
     factory = make_factory(server, args)
     fcfg = FleetConfig(
         n_clients=args.clients, tenants=args.tenants,
@@ -334,21 +426,46 @@ def main() -> int:
 
     dispatch_debug.force(True)
     tracer_was_on = obs_trace.get_tracer() is not None
-    ring = setup_telemetry(args, server)
+    ring = setup_telemetry(args, server,
+                           force=autoscale_cfg is not None)
+    autoscaler = None
+    n0 = len(group.live_replicas()) if group is not None else 1
     try:
         warm_rounds = 0
         if not args.no_warm:
             warm_rounds = warm_fleet(server, factory, fcfg)
+        if autoscale_cfg is not None:
+            # constructed after warm so priming windows are history,
+            # not signal
+            from split_learning_tpu.runtime.autoscale import (
+                Autoscaler, policy_from_config)
+            autoscaler = Autoscaler(
+                group, make_replica, policy_from_config(autoscale_cfg),
+                ring, coalesce_max=args.coalesce_max,
+                slo_ms=args.slo_ms)
+            # background pump so idle windows (no step completions to
+            # poke the per-step hook) still reach the policy — that's
+            # where scale-downs come from
+            autoscaler.start(ring.interval_s)
         compiles_before = compile_count(server, group)
-        res = run_fleet(fcfg, factory, group=group)
+        res = run_fleet(fcfg, factory, group=group,
+                        autoscaler=autoscaler)
+        if autoscaler is not None:
+            # stop the pump before summarizing: a scale event landing
+            # mid-summary would make the blocks disagree
+            autoscaler.close()
         health = server.health()
         coalescing = health.get("coalescing", {})
         compiles_after = compile_count(server, group)
         replay = replay_counters(server, group)
         replication = replication_summary(args, group, res)
         telemetry = telemetry_summary(args, ring)
+        autoscale_block = autoscale_summary(
+            autoscale_cfg, autoscaler, group, res.wall_s, n0)
     finally:
         dispatch_debug.force(False)
+        if autoscaler is not None:
+            autoscaler.close()
         if ring is not None:
             obs_telemetry.disable()
             if not tracer_was_on:
@@ -395,6 +512,7 @@ def main() -> int:
             "chaos": bool(args.chaos),
             "replicas": args.replicas,
             "kill_replica_at": args.kill_replica_at,
+            "autoscale": autoscale_cfg is not None,
         },
         "warm_rounds": warm_rounds,
         "wall_s": round(res.wall_s, 3),
@@ -416,6 +534,7 @@ def main() -> int:
         "replay": replay,
         "replication": replication,
         "telemetry": telemetry,
+        "autoscale": autoscale_block,
     }
     print(json.dumps(summary, indent=1))
 
@@ -427,6 +546,16 @@ def main() -> int:
             return 1
         print(f"[fleet_sim] gate ok: {completed}/{expected} steps, "
               f"0 dropped", file=sys.stderr)
+    if args.gate_autoscale:
+        ups = autoscale_block["scale_ups"]
+        downs = autoscale_block["scale_downs"]
+        if ups < 1 or downs < 1:
+            print(f"[fleet_sim] AUTOSCALE GATE FAILED: "
+                  f"scale_ups={ups} scale_downs={downs}",
+                  file=sys.stderr)
+            return 1
+        print(f"[fleet_sim] autoscale gate ok: {ups} up / {downs} "
+              f"down", file=sys.stderr)
     return 0
 
 

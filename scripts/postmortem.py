@@ -39,6 +39,12 @@ Output, in ``scripts/trace_report.py`` section style:
       sequence numbers, so a merged 3-dump journal where mb goes
       backwards means a duplicate was materialized twice or a relay
       reordered the stream;
+    - ``step_lost_to_scale_down``: on an elastic run, a client's owning
+      claim was still unresolved when ``fl_scale_down`` retired the
+      replica the client last routed to, and no resolve ever followed —
+      the scale-down handoff dropped an in-flight step instead of
+      draining or replaying it (absence-based: skipped on truncated
+      rings);
     - ``step_applied_on_two_replicas``: on a replicated run, two
       ``fl_claim_resolve`` events for the same (client, op, step) with
       no intervening ``fl_claim_fail`` — merging per-replica dumps
@@ -69,7 +75,8 @@ try:
         FL_CLAIM_RESOLVE, FL_CLAIM_WAIT, FL_CLOSE, FL_DEFER_APPLY,
         FL_FATAL, FL_HANDOFF_BEGIN, FL_HANDOFF_COMMIT, FL_HOP_RECV,
         FL_HOP_SEND, FL_REPLAY_HIT, FL_REPLICA_DEATH, FL_REPLY,
-        FL_ROUTE, FL_STAGE_REPLY, FL_WATCHDOG_TRIP)
+        FL_ROUTE, FL_SCALE_DECISION, FL_SCALE_DOWN, FL_SCALE_UP,
+        FL_STAGE_REPLY, FL_WATCHDOG_TRIP)
 except ImportError:
     FL_ADMIT = "fl_admit"
     FL_CLAIM_BEGIN = "fl_claim_begin"
@@ -90,6 +97,9 @@ except ImportError:
     FL_REPLICA_DEATH = "fl_replica_death"
     FL_HANDOFF_BEGIN = "fl_handoff_begin"
     FL_HANDOFF_COMMIT = "fl_handoff_commit"
+    FL_SCALE_DECISION = "fl_scale_decision"
+    FL_SCALE_UP = "fl_scale_up"
+    FL_SCALE_DOWN = "fl_scale_down"
 
 Key = Tuple[int, Optional[str], int]  # (client_id, op, step)
 
@@ -164,6 +174,13 @@ def detect_anomalies(events: List[Dict[str, Any]],
     # truncation; an intervening fl_claim_fail releases the key (a
     # legitimate retry re-owns it).
     materialized: Dict[Key, Any] = {}
+    # elastic runs: each client's most recent route target, and the
+    # owned-but-unresolved keys snapshotted when a scale-down retired
+    # the replica they last routed to. A candidate that never resolves
+    # afterwards was dropped by the scale-down handoff instead of being
+    # drained or replayed — absence-based, so skipped under truncation.
+    last_route: Dict[int, Any] = {}
+    lost_candidates: List[Tuple[Key, int, Any]] = []
     admission_armed = any(e.get("name") == FL_ADMIT for e in events)
     for i, ev in enumerate(events):
         name = ev.get("name")
@@ -228,6 +245,14 @@ def detect_anomalies(events: List[Dict[str, Any]],
                     })
                 if prev is None or int(mb) > prev[0]:
                     hop_high[hk] = (int(mb), i)
+        elif name == FL_ROUTE:
+            last_route[int(ev.get("client_id", -1))] = fields.get("replica")
+        elif name == FL_SCALE_DOWN:
+            retired = fields.get("replica")
+            for k in owned:
+                if retired is not None \
+                        and last_route.get(k[0]) == retired:
+                    lost_candidates.append((k, i, retired))
         elif name == FL_CLOSE:
             close_at.setdefault(str(ev.get("party")), i)
         elif name == FL_DEFER_APPLY:
@@ -258,6 +283,21 @@ def detect_anomalies(events: List[Dict[str, Any]],
                             f"{admits.get(cid, 0)} admissions before it"),
                     })
     if not truncated:
+        seen_lost = set()
+        for k, i, retired in lost_candidates:
+            if k in resolved or k in seen_lost:
+                continue  # a later resolve = the handoff replayed it
+            seen_lost.add(k)
+            anomalies.append({
+                "kind": "step_lost_to_scale_down",
+                "client_id": k[0], "op": k[1], "step": k[2],
+                "message": (
+                    f"client {k[0]} op {k[1]!r} step {k[2]} was owned "
+                    f"and unresolved when fl_scale_down retired replica "
+                    f"{retired} (the client's last route target) and "
+                    "never resolved afterwards — the scale-down handoff "
+                    "dropped an in-flight step"),
+            })
         for k, i in sorted(owned.items(), key=lambda kv: kv[1]):
             anomalies.append({
                 "kind": "claim_never_resolved",

@@ -107,7 +107,8 @@ def render(view: dict, frame: int) -> str:
         head.append(f"{label}={total:.2f}")
     lines.append("fleet: " + "  ".join(head))
     lines.append(f"{'party':<12} {'win':>4} {'steps/s':>8} {'hops/s':>8} "
-                 f"{'p99 ms':>8} {'queue':>6} {'burn f/s':>10}")
+                 f"{'p99 ms':>8} {'queue':>6} {'repl':>5} {'scale':>6} "
+                 f"{'burn f/s':>10}")
     for key in sorted(view.get("parties", {})):
         info = view["parties"][key]
         if info.get("error"):
@@ -126,11 +127,18 @@ def render(view: dict, frame: int) -> str:
                  if k.startswith(f"{key}:")]
         burn = (f"{max(burns):.2f}" if burns else "-")
         p99_str = f"{p99:8.2f}" if p99 is not None else f"{'-':>8}"
+        # elastic autoscaling (PR 19): live replica count and the most
+        # recent policy verdict, read from the group-merged gauges; a
+        # party with no group shows '-' in both columns
+        repl = gauges.get(spans.REPLICAS_LIVE)
+        repl_str = f"{repl:5.0f}" if repl is not None else f"{'-':>5}"
+        dec = gauges.get(spans.AUTOSCALE_DECISION)
+        scale = ("-" if not dec else ("up" if dec > 0 else "down"))
         lines.append(
             f"{key:<12} {info.get('windows', 0):>4} "
             f"{_fmt_rate(_party_rate(info, _HEADLINE_RATES[0][1]))} "
             f"{_fmt_rate(_party_rate(info, _HEADLINE_RATES[1][1]))} "
-            f"{p99_str} {queue:>6.0f} {burn:>10}")
+            f"{p99_str} {queue:>6.0f} {repl_str} {scale:>6} {burn:>10}")
     cp = view.get("critical_path") or []
     if cp:
         last = cp[-1]

@@ -546,6 +546,34 @@ def handoff_exactly_once(run: Any) -> None:
                 f"resolved — not the one materialized reply")
 
 
+def scale_down_exactly_once(run: Any) -> None:
+    """Elastic scale-down discipline (PR 19): a policy-driven
+    ``remove_replica`` is the same fence/quiesce/capture/merge/reroute
+    handoff as a death, so every (client, op, step) must apply exactly
+    once group-wide and every duplicate's wait must return the one
+    materialized reply — AND the retired replica must never apply a
+    step after its ``scale_down`` note: the fence precedes the capture,
+    so an apply landing afterwards would be state the merge already
+    missed.
+
+    Notes read: ``begin(key, owner, replica)``, ``apply(key,
+    replica)``, ``resolve(key, value, replica)``, ``wait_return(key,
+    value, replica)``, ``scale_down(replica)``."""
+    handoff_exactly_once(run)
+    retired: set = set()
+    for kind, fields in run.notes:
+        if kind == "scale_down":
+            retired.add(fields.get("replica"))
+        elif kind == "apply" and fields.get("replica") in retired:
+            raise Violation(
+                "scale_down_exactly_once", run.schedule_id,
+                f"step {fields.get('key')} applied on replica "
+                f"{fields.get('replica')} AFTER that replica's "
+                f"scale-down committed — the fence precedes the "
+                f"capture, so this apply is state the handoff merge "
+                f"never saw")
+
+
 def flush_before_save(run: Any) -> None:
     """Checkpoint capture happens only after the deferred-apply queue
     drained: a snapshot taken with updates still queued persists params
@@ -578,6 +606,7 @@ INVARIANTS: Dict[str, Callable[[Any], None]] = {
     "replay_recovery_bit_identical": replay_recovery_bit_identical,
     "flush_before_save": flush_before_save,
     "handoff_exactly_once": handoff_exactly_once,
+    "scale_down_exactly_once": scale_down_exactly_once,
 }
 
 # --check findings flow through slt-lint's waiver/exit-code machinery;
@@ -600,6 +629,7 @@ RULE_OF_INVARIANT: Dict[str, str] = {
     "pipeline_hops_exactly_once": "SLT113",
     "handoff_exactly_once": "SLT114",
     "onefb_hop_order": "SLT115",
+    "scale_down_exactly_once": "SLT116",
 }
 
 

@@ -814,6 +814,113 @@ def replica_death_handoff(ctx: Ctx) -> Dict[str, Any]:
             "fenced_waits": int(counters["replica_fenced_waits"])}
 
 
+@scenario("scale_down_inflight_race",
+          invariants=("scale_down_exactly_once", "exactly_once_claims"),
+          budget=300, bound=2, requires="jax")
+def scale_down_inflight_race(ctx: Ctx) -> Dict[str, Any]:
+    """A policy-driven scale-down racing live traffic AND the breaker
+    probe cycle (PR 19): while client 0 delivers a step (and its
+    duplicate retransmit) to a 2-replica group, an autoscaler thread
+    retires the replica client 0 lives on via ``remove_replica`` — the
+    same fence/quiesce/capture/merge/reroute handoff a death takes —
+    and a prober thread runs health probes throughout. Explored at
+    every schedule point: the retirement can land before the claim,
+    inside the claim window, after resolve, or during the duplicate's
+    retransmit. Exactly-once must hold group-wide and the retired
+    replica must never apply a step after the scale-down commits (the
+    fence precedes the capture — a later apply would be state the
+    merge never saw). The probe cycle takes the same scale lock, so it
+    can neither declare a death mid-scale nor observe a half-fenced
+    slot."""
+    from split_learning_tpu.runtime.replay import ReplayCache
+    from split_learning_tpu.runtime.replica import ReplicaGroup
+
+    class _StubReplica:
+        """ServerRuntime's claim lifecycle minus jax (the
+        replica_death_handoff stub): a real ReplayCache decides
+        ownership, only the owner notes apply, duplicates block on the
+        entry."""
+
+        def __init__(self, idx: int) -> None:
+            self.idx = idx
+            self.replay = ReplayCache(window=8)
+            self._steps = 0
+
+        def health(self) -> Dict[str, Any]:
+            return {"step": self._steps, "status": "serving"}
+
+        def split_step(self, acts: Any, labels: Any, step: int,
+                       client_id: int = 0) -> Any:
+            key = (client_id, "split_step", step)
+            entry, owner = self.replay.begin(client_id, "split_step",
+                                             step)
+            ctx.note("begin", key=key, owner=owner, replica=self.idx)
+            if not owner:
+                value = self.replay.wait(entry, timeout=30.0)
+                ctx.note("wait_return", key=key, value=value,
+                         replica=self.idx)
+                return value
+            ctx.step("claim")  # the retirement can land in the window
+            self._steps += 1
+            ctx.note("apply", key=key, replica=self.idx)
+            value = ("reply", client_id, step, self.idx)
+            self.replay.resolve(entry, value)
+            ctx.note("resolve", key=key, value=value, replica=self.idx)
+            return value
+
+        def flush_deferred(self) -> int:
+            return 0
+
+        def export_runtime_extras(self, step: int) -> Dict[str, Any]:
+            from split_learning_tpu.runtime import checkpoint as _ckpt
+            return _ckpt.build_extras(
+                step, 1, replay=self.replay.export_state(), wire_ef=[])
+
+        def close(self) -> None:
+            pass
+
+    group = ReplicaGroup([_StubReplica(i) for i in range(2)])
+    victim = group.assignment(0)  # the replica client 0 lives on
+    other = next(c for c in range(1, 8)
+                 if group.assignment(c) != victim)
+
+    def deliver(cid: int, step: int, tag: str) -> None:
+        if tag == "dup":
+            ctx.step("wire")  # the retransmit window
+        group.split_step(None, None, step, cid)
+
+    def scaler() -> None:
+        ctx.step("scale")  # explored against every lifecycle point
+        group.remove_replica(victim)
+        ctx.note("scale_down", replica=victim)
+
+    def prober() -> None:
+        # the breaker probe cycle must serialize with the scale op on
+        # the scale lock — probing mid-retirement is a legal schedule
+        for _ in range(2):
+            ctx.step("probe")
+            for idx in group.live_replicas():
+                group.probe(idx)
+
+    workers = [ctx.spawn(deliver, 0, 1, "orig", name="c0-orig"),
+               ctx.spawn(deliver, 0, 1, "dup", name="c0-dup"),
+               ctx.spawn(deliver, other, 1, "orig", name="c-other"),
+               ctx.spawn(scaler, name="scaler"),
+               ctx.spawn(prober, name="prober")]
+    for w in workers:
+        w.join()
+    counters = group.counters()
+    assert counters["replica_scale_downs"] == 1, counters
+    assert counters["replica_deaths"] == 0, counters
+    assert group.live_replicas() == [1 - victim]
+    # stickiness: the bystander never moved off its surviving replica
+    assert group.assignment(other) == 1 - victim
+    return {"scale_downs": int(counters["replica_scale_downs"]),
+            "handoffs": int(counters["replica_handoffs"]),
+            "migrated": int(counters["handoff_replay_entries"]),
+            "fenced_waits": int(counters["replica_fenced_waits"])}
+
+
 # --------------------------------------------------------------------- #
 # crash–restart scenarios (slt-crash, SLT109–112)
 # --------------------------------------------------------------------- #

@@ -104,6 +104,35 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                         "datasets (default 64; cached per length)")
 
 
+def _add_autoscale_args(p: argparse.ArgumentParser) -> None:
+    """--autoscale* flags shared by train and serve (runtime/autoscale).
+    CLI wins over the SLT_AUTOSCALE* env twins; all default to None so
+    the merge in runtime.autoscale.args_config can tell 'unset' from
+    an explicit value."""
+    p.add_argument("--autoscale", action="store_true",
+                   help="elastic autoscaling (runtime/autoscale.py): a "
+                        "policy reads the telemetry ring each window and "
+                        "adds replicas under pressure / retires them via "
+                        "the exactly-once handoff when idle (implies "
+                        "--telemetry; env twin SLT_AUTOSCALE=1). Off = "
+                        "no policy object, static --replicas, "
+                        "bit-identical")
+    p.add_argument("--autoscale-min", dest="autoscale_min", type=int,
+                   default=None,
+                   help="floor on live replicas (default 1; env twin "
+                        "SLT_AUTOSCALE_MIN). The group starts at "
+                        "max(--replicas, this)")
+    p.add_argument("--autoscale-max", dest="autoscale_max", type=int,
+                   default=None,
+                   help="ceiling on live replicas (default 4; env twin "
+                        "SLT_AUTOSCALE_MAX)")
+    p.add_argument("--autoscale-cooldown-s", dest="autoscale_cooldown_s",
+                   type=float, default=None,
+                   help="scale-up cooldown in seconds; scale-down gets "
+                        "2x (retiring capacity is the slower reflex). "
+                        "Default 5; env twin SLT_AUTOSCALE_COOLDOWN_S")
+
+
 def _config_from_args(args) -> "Config":
     from split_learning_tpu.utils import Config
     overrides = {}
@@ -448,6 +477,9 @@ def cmd_train(args) -> int:
     full_params = None  # for --eval
     server = None       # the 2-party in-process server, when one exists
     chain_meta = None   # PipelineRunner.trace_metadata() (chain path)
+    as_cfg = None       # autoscale config (in-process server arm only)
+    autoscaler = None   # the live policy pump, when --autoscale is on
+    autoscale_ring = None
 
     if args.transport != "fused":
         # these knobs only exist on the fused single-program path; say so
@@ -988,9 +1020,14 @@ def cmd_train(args) -> int:
                                      apply_lag=getattr(
                                          args, "apply_lag", 0) or 0,
                                      mesh=_server_mesh(args))
-            from split_learning_tpu.runtime.replica import maybe_replicate
-            server = maybe_replicate(
-                _make_replica, getattr(args, "replicas", 1) or 1,
+            from split_learning_tpu.runtime.replica import (
+                ReplicaGroup, maybe_replicate)
+            # elastic autoscaling (PR 19): CLI over SLT_AUTOSCALE* env;
+            # None when off — static --replicas, bit-identical
+            from split_learning_tpu.runtime import (
+                autoscale as rt_autoscale)
+            as_cfg = rt_autoscale.args_config(args)
+            _group_kw = dict(
                 sync_every=getattr(args, "replica_sync_every", 0) or 0,
                 handoff=getattr(args, "handoff", "live") or "live",
                 seed=cfg.seed,
@@ -999,12 +1036,49 @@ def cmd_train(args) -> int:
                 sync_compress=(args.compress if args.compress in
                                ("topk8", "clapping") else None),
                 sync_density=_density_or_default(args))
+            if as_cfg is not None:
+                # the elastic arm always fronts a ReplicaGroup — even
+                # at one starting replica, scale-up needs the router
+                n0 = max(getattr(args, "replicas", 1) or 1,
+                         as_cfg["min_replicas"])
+                server = ReplicaGroup(
+                    [_make_replica(i) for i in range(n0)], **_group_kw)
+            else:
+                server = maybe_replicate(
+                    _make_replica, getattr(args, "replicas", 1) or 1,
+                    **_group_kw)
             # --compress plumbs here too (wire emulation through the real
             # codec) so compressed-path runs don't need sockets; None
             # keeps the legacy direct path bit-for-bit
             transport = LocalTransport(
                 server, compress=args.compress,
                 density=_density_or_default(args))
+            if as_cfg is not None:
+                # autoscale implies telemetry (the policy's signals ARE
+                # the ring's windows) and tracing (the ring's
+                # percentiles come from the tracer-gated histograms)
+                if obs.get_tracer() is None:
+                    obs.enable()
+                from split_learning_tpu.obs import telemetry as obs_tel
+                tcfg = obs_tel.env_config() or {
+                    "interval_s": obs_tel.DEFAULT_INTERVAL_S,
+                    "capacity": obs_tel.DEFAULT_CAPACITY}
+                autoscale_ring = obs_tel.enable(
+                    server.metrics, party="server",
+                    interval_s=tcfg["interval_s"],
+                    capacity=tcfg["capacity"],
+                    slo=obs_tel.tracker_from_config(tcfg))
+                autoscale_ring.start_sampler()
+                autoscaler = rt_autoscale.Autoscaler(
+                    server, _make_replica,
+                    rt_autoscale.policy_from_config(as_cfg),
+                    autoscale_ring, slo_ms=tcfg.get("slo_ms"))
+                autoscaler.start(autoscale_ring.interval_s)
+                print(f"[autoscale] policy on: "
+                      f"min={as_cfg['min_replicas']} "
+                      f"max={as_cfg['max_replicas']} "
+                      f"cooldown={as_cfg['cooldown_s']}s",
+                      file=sys.stderr)
         chaos_spec = getattr(args, "chaos", None)
         if chaos_spec:
             # seeded fault injection wraps whichever wire was built —
@@ -1147,6 +1221,13 @@ def cmd_train(args) -> int:
                                        on_epoch_end=on_epoch_end,
                                        **train_kwargs)
         finally:
+            if autoscaler is not None:
+                # stop the pump before anything tears down: a scale
+                # event must not race the post-run export/eval reads
+                autoscaler.close()
+            if autoscale_ring is not None:
+                from split_learning_tpu.obs import telemetry as obs_tel
+                obs_tel.disable()
             if hasattr(client, "close"):  # pipelined: join lanes + conns
                 client.close()
             if ckptr is not None:
@@ -1273,10 +1354,14 @@ def cmd_serve(args) -> int:
             (28, 28, 1))
         sample = np.zeros((cfg.batch_size,) + shape, np.float32)
     role = getattr(args, "role", "server") or "server"
+    as_cfg = None  # autoscale config; stays None for stage parties
     if role == "stage":
         # one middle/last party of the K-stage MPMD chain (PR 14): the
         # same HTTP wire, serving the hop ops instead of split_step
         from split_learning_tpu.runtime.stage import StageRuntime
+        if getattr(args, "autoscale", False):
+            print("[warn] --autoscale applies to the replicated server "
+                  "role only; ignored for --role stage", file=sys.stderr)
         if cfg.checkpoint_dir:
             print("[warn] stage parties do not own checkpoints; "
                   "--checkpoint-dir ignored (the chain client saves the "
@@ -1298,13 +1383,18 @@ def cmd_serve(args) -> int:
             return 2
     else:
         n_replicas = getattr(args, "replicas", 1) or 1
-        if n_replicas > 1 and cfg.checkpoint_dir:
+        # elastic autoscaling (PR 19): CLI over SLT_AUTOSCALE* env; None
+        # when off — no policy object, static --replicas, bit-identical
+        from split_learning_tpu.runtime import autoscale as rt_autoscale
+        as_cfg = rt_autoscale.args_config(args)
+        if (n_replicas > 1 or as_cfg is not None) and cfg.checkpoint_dir:
             # the group's checkpoint story is the handoff sidecar, not N
             # interleaved Orbax trees in one directory — refuse the
             # ambiguous layout instead of writing it
-            print("[error] --replicas > 1 does not compose with "
-                  "--checkpoint-dir yet (per-replica save/resume layout "
-                  "is ambiguous); drop one of them", file=sys.stderr)
+            print("[error] --replicas > 1 / --autoscale does not compose "
+                  "with --checkpoint-dir yet (per-replica save/resume "
+                  "layout is ambiguous); drop one of them",
+                  file=sys.stderr)
             return 2
         try:
             def _make_replica(_idx: int) -> ServerRuntime:
@@ -1325,16 +1415,30 @@ def cmd_serve(args) -> int:
                     mesh=_server_mesh(args),
                     ef_mode=("clapping" if args.compress == "clapping"
                              else "topk8"))
-            from split_learning_tpu.runtime.replica import maybe_replicate
-            runtime = maybe_replicate(
-                _make_replica, n_replicas,
-                sync_every=getattr(args, "replica_sync_every", 0) or 0,
-                handoff=getattr(args, "handoff", "live") or "live",
-                seed=cfg.seed,
-                sync_compress=(args.compress if args.compress in
-                               ("topk8", "clapping") else None),
-                sync_density=float(getattr(args, "compress_density",
-                                           0.1) or 0.1))
+            from split_learning_tpu.runtime.replica import (
+                ReplicaGroup, maybe_replicate)
+            sync_compress = (args.compress if args.compress in
+                             ("topk8", "clapping") else None)
+            sync_density = float(getattr(args, "compress_density",
+                                         0.1) or 0.1)
+            if as_cfg is not None:
+                # the elastic arm always fronts a ReplicaGroup — even at
+                # one starting replica, scale-up needs the router seam
+                n0 = max(n_replicas, as_cfg["min_replicas"])
+                runtime = ReplicaGroup(
+                    [_make_replica(i) for i in range(n0)],
+                    sync_every=getattr(args, "replica_sync_every", 0) or 0,
+                    handoff=getattr(args, "handoff", "live") or "live",
+                    seed=cfg.seed, sync_compress=sync_compress,
+                    sync_density=sync_density)
+            else:
+                runtime = maybe_replicate(
+                    _make_replica, n_replicas,
+                    sync_every=getattr(args, "replica_sync_every", 0) or 0,
+                    handoff=getattr(args, "handoff", "live") or "live",
+                    seed=cfg.seed,
+                    sync_compress=sync_compress,
+                    sync_density=sync_density)
         except ValueError as e:  # e.g. --coalesce-max outside split mode
             print(f"[error] {e}", file=sys.stderr)
             return 2
@@ -1505,7 +1609,10 @@ def cmd_serve(args) -> int:
     from split_learning_tpu.obs import telemetry as obs_telemetry
     telemetry_ring = None
     tel_cfg = obs_telemetry.env_config()
-    if tel_cfg is None and getattr(args, "telemetry", False):
+    if tel_cfg is None and (getattr(args, "telemetry", False)
+                            or as_cfg is not None):
+        # --autoscale implies telemetry: the policy's signals ARE the
+        # ring's windows
         tel_cfg = {"interval_s": obs_telemetry.DEFAULT_INTERVAL_S,
                    "capacity": obs_telemetry.DEFAULT_CAPACITY}
     if tel_cfg is not None:
@@ -1530,6 +1637,24 @@ def cmd_serve(args) -> int:
               f"(interval {tel_cfg['interval_s']}s, "
               f"capacity {tel_cfg['capacity']})", file=sys.stderr)
 
+    autoscaler = None
+    if as_cfg is not None:
+        # policy + pump over the live group; scale-up spawns via the
+        # same factory the group was built from, scale-down drives the
+        # exactly-once handoff (runtime/autoscale.py)
+        from split_learning_tpu.runtime.autoscale import (
+            Autoscaler, policy_from_config)
+        autoscaler = Autoscaler(
+            runtime, _make_replica, policy_from_config(as_cfg),
+            telemetry_ring,
+            coalesce_max=getattr(args, "coalesce_max", 1) or 1,
+            slo_ms=(tel_cfg.get("slo_ms")
+                    or (getattr(args, "slo_ms", 0) or None)))
+        autoscaler.start(telemetry_ring.interval_s)
+        print(f"[autoscale] policy on: min={as_cfg['min_replicas']} "
+              f"max={as_cfg['max_replicas']} "
+              f"cooldown={as_cfg['cooldown_s']}s", file=sys.stderr)
+
     server = SplitHTTPServer(runtime, host=args.host, port=args.port,
                              compress=args.compress or "none",
                              density=args.compress_density,
@@ -1543,6 +1668,10 @@ def cmd_serve(args) -> int:
         print("[serve] shutting down")
         server.stop()
     finally:
+        if autoscaler is not None:
+            # stop the pump first: a scale event must not race the
+            # group teardown below
+            autoscaler.close()
         if telemetry_ring is not None:
             telemetry_ring.advance(force=True)
             obs_telemetry.disable()
@@ -1993,6 +2122,7 @@ def main(argv: Optional[list] = None) -> int:
                          "successors: live (in-memory extras payload) or "
                          "checkpoint (round-trip through the durable "
                          "sidecar on disk)")
+    _add_autoscale_args(pt)
     pt.add_argument("--failure-policy", dest="failure_policy",
                     choices=["raise", "retry", "skip"], default=None,
                     help="what a split client does when the wire fails: "
@@ -2141,6 +2271,7 @@ def main(argv: Optional[list] = None) -> int:
                     help="failover handoff path: live (in-memory extras "
                          "payload) or checkpoint (durable sidecar "
                          "round-trip)")
+    _add_autoscale_args(ps)
     ps.add_argument("--trace", default=None, metavar="PATH",
                     help="per-step span tracing (obs/): serve live "
                          "queue-wait/dispatch histograms on GET /metrics "

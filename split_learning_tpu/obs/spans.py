@@ -153,6 +153,14 @@ FL_HANDOFF_COMMIT = "fl_handoff_commit"  # state merged; clients rerouted
 # ("firing"/"cleared") and both window burn rates, so a postmortem can
 # line the alert up against the admission/dispatch events that caused it.
 FL_SLO_ALERT = "fl_slo_alert"            # SLO burn-rate alert fired/cleared
+# elastic autoscaling (PR 19): policy-driven scale events. DECISION
+# carries ``direction`` ("up"/"down"), ``reason`` and ``executed``;
+# UP/DOWN carry ``replica`` (the spawned/retired index) and ``live`` so
+# a postmortem can attribute in-flight steps to a departing replica
+# (anomaly ``step_lost_to_scale_down``).
+FL_SCALE_DECISION = "fl_scale_decision"  # autoscale policy verdict (non-hold)
+FL_SCALE_UP = "fl_scale_up"              # replica spawned and adopted
+FL_SCALE_DOWN = "fl_scale_down"          # replica retired via policy handoff
 
 # metrics-histogram-only names for the replica router (never trace
 # spans — both windows sit inside a client's ``transport`` span and
@@ -169,7 +177,8 @@ FLIGHT_EVENTS = (
     FL_CKPT_LINEAGE, FL_GATHER, FL_SEND, FL_RECV, FL_CLOSE,
     FL_WATCHDOG_TRIP, FL_FATAL, FL_HOP_SEND, FL_HOP_RECV,
     FL_STAGE_REPLY, FL_ROUTE, FL_REPLICA_DEATH, FL_HANDOFF_BEGIN,
-    FL_HANDOFF_COMMIT, FL_SLO_ALERT)
+    FL_HANDOFF_COMMIT, FL_SLO_ALERT, FL_SCALE_DECISION, FL_SCALE_UP,
+    FL_SCALE_DOWN)
 
 # -- compressed hop wires (transport/density.py, PR 18) ---------------- #
 # metrics-gauge-only name prefix (the admission_* precedent — never a
@@ -186,6 +195,14 @@ WIRE_DENSITY = "wire_density"
 # tenant (render_prometheus adds the slt_ prefix -> slt_slo_burn_rate_*).
 SLO_BURN_FAST = "slo_burn_rate_fast"
 SLO_BURN_SLOW = "slo_burn_rate_slow"
+
+# -- elastic autoscaling (runtime/autoscale.py, PR 19) ----------------- #
+# metrics-gauge-only names (the admission_* precedent — never trace
+# spans): the router's live replica count and the autoscaler's last
+# policy verdict (+1 scale-up, -1 scale-down, 0 hold) — what slt_top's
+# fleet table renders per window.
+REPLICAS_LIVE = "replicas_live"
+AUTOSCALE_DECISION = "autoscale_decision"
 
 # the client-level phases that tile a step — the denominator of the
 # compute-vs-wire fraction (encode/wire are sub-phases of transport and

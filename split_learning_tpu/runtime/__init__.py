@@ -6,6 +6,8 @@ from split_learning_tpu.runtime.client import (
     USplitClientTrainer,
 )
 from split_learning_tpu.runtime.admission import AdmissionController
+from split_learning_tpu.runtime.autoscale import (
+    Autoscaler, AutoscalePolicy, AutoscaleSignals)
 from split_learning_tpu.runtime.breaker import CircuitBreaker
 from split_learning_tpu.runtime.coalesce import (
     ContinuousBatcher, RequestCoalescer)
@@ -37,4 +39,5 @@ __all__ = [
     "CircuitBreaker", "ReplayCache",
     "ReplicaGroup", "maybe_replicate", "rendezvous_pick",
     "AdmissionController", "ContinuousBatcher", "RequestCoalescer",
+    "Autoscaler", "AutoscalePolicy", "AutoscaleSignals",
 ]

@@ -157,12 +157,17 @@ class FleetHarness:
 
     def __init__(self, cfg: FleetConfig,
                  make_transport: Callable[[int], Any],
-                 group: Any = None) -> None:
+                 group: Any = None,
+                 autoscaler: Any = None) -> None:
         self.cfg = cfg
         self._make_transport = make_transport
         # the ReplicaGroup behind the transports, when the caller runs
         # one — only needed for the kill_replica_at chaos hook
         self._group = group
+        # the PR-19 control loop, when the caller runs one: poked after
+        # each completed step (maybe_scale is cheap and self-throttling
+        # — it evaluates at most once per telemetry window)
+        self._autoscaler = autoscaler
         self._killed = False
         self._steps_done = 0
         self.registry = Registry()
@@ -262,6 +267,17 @@ class FleetHarness:
             self._losses[(client_id, step)] = loss_f
         if self._group is not None and cfg.kill_replica_at > 0:
             self._maybe_kill_replica()
+        if self._autoscaler is not None:
+            # on this worker thread, holding no scheduler lock — a
+            # scale-down's quiesce must be able to drain the other
+            # workers' in-flight calls (the _maybe_kill_replica rule)
+            try:
+                self._autoscaler.maybe_scale()
+            except Exception:
+                # a control-plane fault must not kill the data-plane
+                # worker; the counter makes it visible (and the CI
+                # autoscale gate fails if scaling stopped working)
+                self.registry.incr("fleet_autoscale_errors")
 
     def _maybe_kill_replica(self) -> None:
         """The chaos trigger: once the fleet has completed
@@ -372,9 +388,11 @@ class FleetHarness:
 
 def run_fleet(cfg: FleetConfig,
               make_transport: Callable[[int], Any],
-              group: Any = None) -> FleetResult:
+              group: Any = None,
+              autoscaler: Any = None) -> FleetResult:
     """One-call wrapper: build the harness, run it, return the result."""
-    return FleetHarness(cfg, make_transport, group=group).run()
+    return FleetHarness(cfg, make_transport, group=group,
+                        autoscaler=autoscaler).run()
 
 
 def _pow2(n: int) -> int:

@@ -95,12 +95,17 @@ class _ReplicaSlot:
     """Router-side bookkeeping for one replica."""
 
     __slots__ = ("idx", "runtime", "breaker", "alive", "routable",
-                 "inflight", "drained", "handoff_done")
+                 "inflight", "drained", "handoff_done", "born_t", "dead_t")
 
     def __init__(self, idx: int, runtime: Any) -> None:
         self.idx = idx
         self.runtime = runtime
         self.breaker: Optional[CircuitBreaker] = None
+        # alive window bounds (group clock), for replica-seconds
+        # accounting: born at construction/adoption, dead at the
+        # handoff commit that retires the slot
+        self.born_t = 0.0
+        self.dead_t: Optional[float] = None
         # alive: accepting new dispatches. routable: still the
         # rendezvous target for its clients — stays True through the
         # handoff window so fenced clients wait instead of rerouting
@@ -137,7 +142,8 @@ class ReplicaGroup:
                  failure_threshold: int = 3,
                  seed: int = 0,
                  sync_compress: Optional[str] = None,
-                 sync_density: float = 0.1) -> None:
+                 sync_density: float = 0.1,
+                 clock: Callable[[], float] = time.monotonic) -> None:
         if not replicas:
             raise ValueError("ReplicaGroup needs at least one replica")
         if handoff not in HANDOFF_MODES:
@@ -150,17 +156,20 @@ class ReplicaGroup:
         self.sync_every = int(sync_every)
         self.handoff_mode = handoff
         self._ckpt_dir = ckpt_dir
+        self._clock = clock
+        self._failure_threshold = int(failure_threshold)
+        self._seed = int(seed)
         self._slots = [_ReplicaSlot(i, r)
                        for i, r in enumerate(self.replicas)]
         for slot in self._slots:
-            # the PR-4 breaker IS the liveness verdict; probes are free
-            # in-process so the backoff sleep is a no-op injectable
-            slot.breaker = CircuitBreaker(
-                self._make_probe(slot.idx),
-                failure_threshold=int(failure_threshold),
-                seed=seed * 1_000_003 + slot.idx,
-                sleep=lambda _s: None)
+            slot.born_t = self._clock()
+            slot.breaker = self._make_breaker(slot.idx)
         self._lock = obs_locks.make_lock("ReplicaGroup._lock")
+        # scale/membership operations (add_replica, remove_replica, the
+        # breaker's death declaration) serialize here, OUTSIDE _lock —
+        # lock order is always _scale_lock -> _lock, so a breaker probe
+        # cycle can never interleave with a concurrent scale decision
+        self._scale_lock = obs_locks.make_lock("ReplicaGroup._scale_lock")
         self._route_cache: Dict[int, int] = {}
         self.registry = Registry()
         self._counters: Dict[str, float] = {
@@ -168,7 +177,8 @@ class ReplicaGroup:
             "replica_deaths": 0.0, "replica_handoffs": 0.0,
             "handoff_replay_entries": 0.0, "handoff_ef_entries": 0.0,
             "handoff_deferred_flushed": 0.0, "replica_syncs": 0.0,
-            "replica_fenced_waits": 0.0}
+            "replica_fenced_waits": 0.0, "replica_scale_ups": 0.0,
+            "replica_scale_downs": 0.0}
         self._steps_since_sync = 0
         self._ckpt_lineage = 0
         # compressed replica sync (PR 18): same delta-from-reference
@@ -183,6 +193,15 @@ class ReplicaGroup:
             self._sync_ef = codec.make_wire_ef(sync_compress)
             self._counters["sync_raw_bytes"] = 0.0
             self._counters["sync_wire_bytes"] = 0.0
+
+    def _make_breaker(self, idx: int) -> CircuitBreaker:
+        # the PR-4 breaker IS the liveness verdict; probes are free
+        # in-process so the backoff sleep is a no-op injectable
+        return CircuitBreaker(
+            self._make_probe(idx),
+            failure_threshold=self._failure_threshold,
+            seed=self._seed * 1_000_003 + idx,
+            sleep=lambda _s: None)
 
     # -- liveness (PR-4 breaker machinery) ------------------------------ #
     def _make_probe(self, idx: int) -> Callable[[], Any]:
@@ -236,17 +255,22 @@ class ReplicaGroup:
             self.probe(idx)
 
     def _declare_dead(self, slot: _ReplicaSlot) -> None:
-        with self._lock:
-            if not slot.routable:
-                return
-            slot.alive = False
-            self._counters["replica_deaths"] += 1
-            live = sum(1 for s in self._slots if s.alive)
-        fl = obs_flight.get_recorder()
-        if fl is not None:
-            fl.record(spans.FL_REPLICA_DEATH, party="router",
-                      replica=slot.idx, live=live)
-        self._fail_over(slot)
+        # the whole death declaration (fence + handoff) runs under the
+        # scale lock: a breaker probe cycle observing OPEN while a scale
+        # decision is mid-flight queues behind it — and if the scale-down
+        # already retired this slot, the routable re-check below bails
+        with self._scale_lock:
+            with self._lock:
+                if not slot.routable:
+                    return
+                slot.alive = False
+                self._counters["replica_deaths"] += 1
+                live = sum(1 for s in self._slots if s.alive)
+            fl = obs_flight.get_recorder()
+            if fl is not None:
+                fl.record(spans.FL_REPLICA_DEATH, party="router",
+                          replica=slot.idx, live=live)
+            self._fail_over(slot)
 
     # -- failover handoff ----------------------------------------------- #
     def _fail_over(self, slot: _ReplicaSlot) -> None:
@@ -280,6 +304,7 @@ class ReplicaGroup:
             # rendezvous target — its fenced clients reroute onto
             # successors that already hold the merged state
             slot.routable = False
+            slot.dead_t = self._clock()
             stale = [cid for cid, rid in self._route_cache.items()
                      if rid == slot.idx]
             for cid in stale:
@@ -433,6 +458,174 @@ class ReplicaGroup:
         with self._lock:
             return [s.idx for s in self._slots if s.alive]
 
+    # -- elastic scale operations (PR 19) -------------------------------- #
+    def capacity_replicas(self) -> List[int]:
+        """Live replicas whose breaker is not OPEN — what an autoscaler
+        may count as serving capacity. A replica mid-breaker-trip is
+        already on its way out; spawning against it, or retiring a
+        healthy peer because of it, would fight the failure detector."""
+        with self._lock:
+            return [s.idx for s in self._slots
+                    if s.alive and s.breaker is not None
+                    and s.breaker.state != OPEN]
+
+    def handoff_in_flight(self) -> bool:
+        """True while any handoff is fenced but not yet committed
+        (routable without being alive) — the window in which a second
+        membership change must not start."""
+        with self._lock:
+            return any(s.routable and not s.alive for s in self._slots)
+
+    def route_counts(self) -> Dict[int, int]:
+        """Cached client assignments per live replica — the load signal
+        a scale-down uses to pick the least-loaded victim."""
+        with self._lock:
+            counts = {s.idx: 0 for s in self._slots if s.alive}
+            for rid in self._route_cache.values():
+                if rid in counts:
+                    counts[rid] += 1
+            return counts
+
+    def replica_seconds(self) -> Dict[int, float]:
+        """Per-replica alive seconds (group clock): born at
+        construction/adoption, closed at the handoff commit that retired
+        the slot — still-running replicas accrue to now. The cost side
+        of the static-vs-autoscale comparison."""
+        now = self._clock()
+        with self._lock:
+            return {s.idx: max(0.0, (now if s.dead_t is None else s.dead_t)
+                               - s.born_t)
+                    for s in self._slots}
+
+    def add_replica(self, factory: Callable[[int], Any]) -> int:
+        """Scale-up: spawn a replica via ``factory`` and let sticky HRW
+        routing adopt it. Lock-disciplined: membership changes serialize
+        on the scale lock (never racing a breaker-declared death), and
+        the expensive construction runs OUTSIDE the router lock so
+        in-flight steps keep dispatching. Before the newcomer becomes
+        routable, the resolved replay entries (and EF residual streams)
+        of every client HRW will move to it are copied over — born
+        resolved, so a duplicate rerouted to the new replica is served
+        the original reply, never re-applied. The donors keep their
+        copies; ``put`` is first-apply-wins, so the leftovers are
+        harmless. Returns the new replica index."""
+        with self._scale_lock:
+            idx = len(self._slots)
+            runtime = factory(idx)
+            slot = _ReplicaSlot(idx, runtime)
+            slot.born_t = self._clock()
+            slot.breaker = self._make_breaker(idx)
+            with self._lock:
+                targets = [s.idx for s in self._slots if s.routable]
+                donors = [s for s in self._slots if s.alive]
+            new_targets = targets + [idx]
+            self._adopt_params(donors, runtime)
+            moved_replay: list = []
+            moved_ef: list = []
+            for donor in donors:
+                cache = getattr(donor.runtime, "replay", None)
+                if cache is not None:
+                    for rec in cache.export_state():
+                        if rendezvous_pick(int(rec["key"][0]),
+                                           new_targets) == idx:
+                            moved_replay.append(rec)
+                ledger = getattr(donor.runtime, "wire_ef", None)
+                if ledger is not None:
+                    for rec in ledger.export_state() or []:
+                        key = rec["key"]
+                        cid = key[0] if isinstance(key, (list, tuple)) \
+                            else key
+                        try:
+                            if rendezvous_pick(int(cid),
+                                               new_targets) == idx:
+                                moved_ef.append(rec)
+                        except (TypeError, ValueError):
+                            pass
+            cache = getattr(runtime, "replay", None)
+            if cache is not None:
+                for rec in moved_replay:
+                    cid, op, st = rec["key"]
+                    cache.put(int(cid), str(op), int(st),
+                              rec.get("result"))
+                    body = rec.get("body")
+                    if body is not None:
+                        cache.attach_body(int(cid), str(op), int(st),
+                                          bytes(body))
+            ledger = getattr(runtime, "wire_ef", None)
+            if ledger is not None and moved_ef:
+                ledger.merge_state(moved_ef)
+            with self._lock:
+                self._slots.append(slot)
+                self.replicas.append(runtime)
+                # purge exactly the clients HRW reassigns: at N -> N+1
+                # rendezvous moves only the ~1/(N+1) whose max weight is
+                # the newcomer's; everyone else stays sticky
+                moved = [cid for cid, rid in self._route_cache.items()
+                         if rendezvous_pick(cid, new_targets) == idx]
+                for cid in moved:
+                    del self._route_cache[cid]
+                self._counters["replica_scale_ups"] += 1
+                live = sum(1 for s in self._slots if s.alive)
+        fl = obs_flight.get_recorder()
+        if fl is not None:
+            fl.record(spans.FL_SCALE_UP, party="router", replica=idx,
+                      live=live, adopted_replay=len(moved_replay),
+                      adopted_ef=len(moved_ef), rerouted=len(moved))
+        return idx
+
+    @staticmethod
+    def _adopt_params(donors: List[_ReplicaSlot], runtime: Any) -> None:
+        # a fresh-init newcomer would drag the FedAvg mean back toward
+        # init — adopt the first live donor's params so the group stays
+        # statistically one model (best-effort: stub replicas carry no
+        # TrainState and skip this)
+        if getattr(runtime, "state", None) is None or not donors:
+            return
+        donor = donors[0].runtime
+        if getattr(donor, "state", None) is None:
+            return
+        import jax
+        import jax.numpy as jnp
+        with donor._lock:
+            # copy under the donor's lock: its jitted step donates the
+            # params buffer, so an unguarded read races deletion
+            params = jax.tree_util.tree_map(jnp.copy,
+                                            donor.state.params)
+        with runtime._lock:
+            runtime.state = runtime.state._replace(params=params)
+
+    def remove_replica(self, idx: int) -> None:
+        """Scale-down: retire replica ``idx`` through the PR-15
+        quiesce/capture/merge/reroute handoff, driven by policy instead
+        of death — same fence, same exactly-once commit, no
+        ``replica_deaths`` attributed. Refuses to retire the last live
+        replica or one already fenced/mid-handoff. Serializes on the
+        scale lock, so it can never race a breaker death declaration or
+        another scale event."""
+        with self._scale_lock:
+            with self._lock:
+                slot = self._slots[idx]
+                if not slot.routable:
+                    raise ValueError(
+                        f"replica {idx} is already retired")
+                if not slot.alive:
+                    raise RuntimeError(
+                        f"replica {idx} is mid-handoff; scale-down "
+                        f"must not race it")
+                if sum(1 for s in self._slots if s.alive) <= 1:
+                    raise RuntimeError(
+                        "cannot scale down the last live replica (no "
+                        "successor to hand its step state to)")
+                slot.alive = False
+                self._counters["replica_scale_downs"] += 1
+            self._fail_over(slot)
+            with self._lock:
+                live = sum(1 for s in self._slots if s.alive)
+        fl = obs_flight.get_recorder()
+        if fl is not None:
+            fl.record(spans.FL_SCALE_DOWN, party="router", replica=idx,
+                      live=live)
+
     # -- the duck-typed server surface ----------------------------------- #
     def split_step(self, activations: np.ndarray, labels: np.ndarray,
                    step: int, client_id: int = 0) -> Tuple[np.ndarray, float]:
@@ -546,8 +739,11 @@ class ReplicaGroup:
         snap = self.registry.snapshot()
         for name, value in self.counters().items():
             snap["counters"][f"{name}_total"] = float(value)
+        live = self.live_replicas()
+        snap.setdefault("gauges", {})[spans.REPLICAS_LIVE] = float(len(live))
         labeled = snap.setdefault("labeled", [])
-        for idx in self.live_replicas():
+        hists = snap.setdefault("histograms", {})
+        for idx in live:
             sub = self._slots[idx].runtime.metrics()
             for k, v in sub.get("counters", {}).items():
                 snap["counters"][k] = snap["counters"].get(k, 0.0) + v
@@ -558,6 +754,26 @@ class ReplicaGroup:
                 labeled.append({"name": k, "type": "gauge",
                                 "labels": {"replica": str(idx)},
                                 "value": float(v)})
+            # group-summed histograms (dispatch/queue-wait tails): the
+            # telemetry ring's window percentiles — and the autoscale
+            # p99 signal — need the group view, not replica 0's
+            for k, h in sub.get("histograms", {}).items():
+                have = hists.get(k)
+                if have is None:
+                    hists[k] = {"buckets": h.get("buckets"),
+                                "cumulative": list(h.get(
+                                    "cumulative", [])),
+                                "sum": float(h.get("sum", 0.0)),
+                                "count": int(h.get("count", 0))}
+                elif len(have.get("cumulative", [])) == len(
+                        h.get("cumulative", [])):
+                    have["cumulative"] = [
+                        a + b for a, b in zip(have["cumulative"],
+                                              h["cumulative"])]
+                    have["sum"] = float(have["sum"]) + float(
+                        h.get("sum", 0.0))
+                    have["count"] = int(have["count"]) + int(
+                        h.get("count", 0))
         return snap
 
     def counters(self) -> Dict[str, float]:
@@ -616,6 +832,15 @@ class ReplicaGroup:
         return self._slots[self.live_replicas()[0]].runtime.trace_metadata()
 
     def close(self) -> None:
+        # drain, don't drop: a handoff that is fenced but not yet
+        # committed still owns step state its successors need — closing
+        # the survivors out from under it would strand fenced clients
+        # and lose the merge. Wait for every in-flight commit first.
+        with self._lock:
+            pending = [s for s in self._slots
+                       if s.routable and not s.alive]
+        for slot in pending:
+            slot.handoff_done.wait(timeout=_HANDOFF_TIMEOUT_S)
         for slot in self._slots:
             if slot.alive:
                 slot.runtime.close()

@@ -1,0 +1,519 @@
+"""Elastic autoscaling control plane (runtime/autoscale.py): the
+policy's deterministic verdicts (band/reject/p99 pressure, burn as
+mid-band tiebreak, hysteresis, per-direction cooldowns under an
+injectable clock), window->signal reduction, and the Autoscaler's scale
+events against a live ReplicaGroup — scale-up adopts with minimal HRW
+churn and replay-clean reroutes, scale-down retires through the
+exactly-once handoff, close() drains an in-flight handoff, and the
+whole module is zero-overhead when off. Protocol legs use the
+test_replica.py jax-light stub around a real ReplayCache."""
+
+import threading
+
+import pytest
+
+from split_learning_tpu.obs import spans
+from split_learning_tpu.runtime import (
+    ReplicaGroup, maybe_replicate, rendezvous_pick)
+from split_learning_tpu.runtime import autoscale as rt_autoscale
+from split_learning_tpu.runtime.autoscale import (
+    Autoscaler, AutoscalePolicy, AutoscaleSignals, signals_from_window)
+from split_learning_tpu.runtime.breaker import OPEN
+from split_learning_tpu.runtime.replay import ReplayCache
+
+
+class _Clock:
+    """Injectable monotonic clock: the policy's cooldowns become pure
+    functions of the test's explicit time steps."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class _StubReplica:
+    """test_replica.py's claim-lifecycle stub: a real ReplayCache
+    decides ownership, only the owner applies, and the reply pins which
+    payload materialized it."""
+
+    def __init__(self, idx):
+        self.idx = idx
+        self.replay = ReplayCache(window=16)
+        self.applies = []
+
+    def health(self):
+        return {"step": len(self.applies), "status": "serving"}
+
+    def split_step(self, payload, labels, step, client_id=0):
+        entry, owner = self.replay.begin(client_id, "split_step", step)
+        if not owner:
+            return self.replay.wait(entry, timeout=30.0)
+        self.applies.append((client_id, step, payload))
+        value = ("reply", client_id, step, self.idx, payload)
+        self.replay.resolve(entry, value)
+        return value
+
+    def flush_deferred(self):
+        return 0
+
+    def metrics(self):
+        return {"counters": {}, "gauges": {}, "histograms": {}}
+
+    def export_runtime_extras(self, step):
+        from split_learning_tpu.runtime.checkpoint import build_extras
+        return build_extras(step, 1, replay=self.replay.export_state(),
+                            wire_ef=[])
+
+    def close(self):
+        pass
+
+
+def _policy(clock=None, **kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("hysteresis_up", 1)
+    kw.setdefault("hysteresis_down", 1)
+    kw.setdefault("cooldown_up_s", 0.0)
+    kw.setdefault("cooldown_down_s", 0.0)
+    return AutoscalePolicy(clock=clock or _Clock(), **kw)
+
+
+# --------------------------------------------------------------------- #
+# policy verdicts
+# --------------------------------------------------------------------- #
+
+def test_policy_pressure_signals_scale_up():
+    """Each pressure signal alone breaches its ceiling -> up; a missing
+    signal never triggers."""
+    for sig in (AutoscaleSignals(occupancy=0.95),
+                AutoscaleSignals(reject_rate=0.5),
+                AutoscaleSignals(occupancy=0.5, p99_over_slo=1.4)):
+        d = _policy().decide(sig, n_live=1)
+        assert d.direction == "up", sig
+    # all-None window: no evidence of pressure, idle argues down
+    d = _policy().decide(AutoscaleSignals(), n_live=2)
+    assert d.direction == "down"
+    assert "idle" in d.reason
+
+
+def test_policy_scale_down_requires_every_signal_comfortable():
+    """Idle occupancy alone is not enough: a reject or an over-SLO p99
+    in the same window vetoes the down."""
+    p = _policy()
+    assert p.decide(AutoscaleSignals(occupancy=0.1),
+                    n_live=2).direction == "down"
+    assert _policy().decide(
+        AutoscaleSignals(occupancy=0.1, reject_rate=0.005),
+        n_live=2).direction == "hold"
+    assert _policy().decide(
+        AutoscaleSignals(occupancy=0.1, p99_over_slo=1.2),
+        n_live=2).direction == "up"
+
+
+def test_policy_burn_is_midband_tiebreak_only():
+    """The burn gauge integrates history: it must break a mid-band tie
+    toward up, but a stale burn must NOT block (or outvote) a
+    scale-down once the window itself is idle — the regression that
+    pinned every down to after the run ended."""
+    # mid-band occupancy + burning -> up (the tiebreak)
+    d = _policy().decide(AutoscaleSignals(occupancy=0.5, burn=2.0),
+                         n_live=2)
+    assert d.direction == "up" and "burn" in d.reason
+    # idle window + stale burn -> down anyway
+    d = _policy().decide(AutoscaleSignals(occupancy=0.1, burn=2.0),
+                         n_live=2)
+    assert d.direction == "down"
+    # mid-band, no burn -> hold
+    assert _policy().decide(AutoscaleSignals(occupancy=0.5),
+                            n_live=2).direction == "hold"
+
+
+def test_policy_hysteresis_counts_consecutive_windows():
+    p = _policy(hysteresis_up=2, hysteresis_down=2)
+    up = AutoscaleSignals(occupancy=0.95)
+    idle = AutoscaleSignals(occupancy=0.05)
+    assert p.decide(up, 1).direction == "hold"       # 1/2
+    assert p.decide(idle, 2).direction == "hold"     # streak broken: 1/2
+    assert p.decide(up, 1).direction == "hold"       # 1/2 again
+    assert p.decide(up, 1).direction == "up"         # 2/2
+
+
+def test_policy_cooldowns_per_direction_injectable_clock():
+    clk = _Clock()
+    p = _policy(clock=clk, cooldown_up_s=5.0, cooldown_down_s=10.0)
+    up = AutoscaleSignals(occupancy=0.95)
+    idle = AutoscaleSignals(occupancy=0.05)
+    assert p.decide(up, 1).direction == "up"
+    clk.t = 2.0
+    assert p.decide(up, 2).reason == "cooldown_up"
+    # the down direction has its own clock — an up does not charge it
+    assert p.decide(idle, 2).direction == "down"
+    clk.t = 4.0
+    assert p.decide(idle, 2).reason == "cooldown_down"
+    clk.t = 7.0                                      # up cooled, down not
+    assert p.decide(up, 1).direction == "up"
+    clk.t = 13.0
+    assert p.decide(idle, 2).direction == "down"
+
+
+def test_policy_floor_and_ceiling():
+    p = _policy(min_replicas=1, max_replicas=2)
+    d = p.decide(AutoscaleSignals(occupancy=0.95), n_live=2)
+    assert d.direction == "hold" and "at_max" in d.reason
+    d = _policy().decide(AutoscaleSignals(occupancy=0.05), n_live=1)
+    assert d.direction == "hold" and "at_min" in d.reason
+
+
+def test_policy_deterministic_replay():
+    """Same window sequence, same clock steps -> identical verdicts
+    (SLT004's determinism scope extends to the control plane)."""
+    windows = [AutoscaleSignals(occupancy=o, reject_rate=r)
+               for o, r in ((0.9, 0.0), (0.95, 0.2), (0.5, 0.0),
+                            (0.1, 0.0), (0.05, 0.0), (0.9, 0.0))]
+
+    def run():
+        clk = _Clock()
+        p = _policy(clock=clk, cooldown_up_s=1.0, cooldown_down_s=1.0,
+                    hysteresis_down=2)
+        out = []
+        for i, w in enumerate(windows):
+            clk.t = float(i)
+            d = p.decide(w, 2)
+            out.append((d.direction, d.reason))
+        return out
+
+    assert run() == run()
+
+
+def test_policy_validates_config():
+    with pytest.raises(ValueError):
+        AutoscalePolicy(min_replicas=0)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(band=(0.8, 0.2))
+
+
+# --------------------------------------------------------------------- #
+# window -> signals
+# --------------------------------------------------------------------- #
+
+def test_signals_from_window_arithmetic():
+    window = {
+        "index": 7,
+        "counters": {"coalesce_groups_flushed": 4.0,
+                     "coalesce_requests_coalesced": 12.0,
+                     spans.ADMISSION_ADMITTED: 90.0,
+                     spans.ADMISSION_REJECTED: 10.0},
+        "gauges": {f"{spans.SLO_BURN_FAST}:p99": 1.5,
+                   f"{spans.SLO_BURN_FAST}:err": 0.5},
+        "percentiles": {spans.DISPATCH: {"p99": 80.0}},
+    }
+    s = signals_from_window(window, coalesce_max=4, slo_ms=40.0)
+    assert s.occupancy == pytest.approx((12.0 / 4.0) / 4)
+    assert s.reject_rate == pytest.approx(0.1)
+    assert s.burn == pytest.approx(1.5)           # max across burn gauges
+    assert s.p99_over_slo == pytest.approx(2.0)
+    assert s.window_index == 7
+
+
+def test_signals_missing_evidence_is_none():
+    """No traffic, no SLO -> every signal None (and the policy treats
+    None as 'no evidence', never as pressure)."""
+    s = signals_from_window({"index": 0, "counters": {}, "gauges": {},
+                             "percentiles": {}}, coalesce_max=4)
+    assert (s.occupancy, s.reject_rate, s.burn, s.p99_over_slo) == \
+        (None, None, None, None)
+    # an SLO without a p99 sample stays None too
+    s = signals_from_window({"counters": {}, "gauges": {},
+                             "percentiles": {}}, slo_ms=40.0)
+    assert s.p99_over_slo is None
+
+
+# --------------------------------------------------------------------- #
+# capacity + scale events against a live group
+# --------------------------------------------------------------------- #
+
+class _StubRing:
+    """A TelemetryRing stand-in the test scripts window by window."""
+
+    def __init__(self):
+        self.queue = []
+        self.interval_s = 0.1
+
+    def advance(self):
+        pass
+
+    def push(self, **signals):
+        idx = len(self.queue)
+        counters = {}
+        if "occupancy" in signals:
+            counters = {"coalesce_groups_flushed": 1.0,
+                        "coalesce_requests_coalesced":
+                            signals["occupancy"] * 4}
+        self.queue.append({"index": idx, "counters": counters,
+                           "gauges": {}, "percentiles": {}})
+
+    def windows(self, last=1):
+        return self.queue[-last:] if self.queue else []
+
+
+def _autoscaler(group, ring, **kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 3)
+    policy = _policy(**kw)
+    return Autoscaler(group, lambda idx: _StubReplica(idx), policy,
+                      ring, coalesce_max=4)
+
+
+def test_capacity_excludes_breaker_open_replica():
+    group = ReplicaGroup([_StubReplica(i) for i in range(3)])
+    assert group.capacity_replicas() == [0, 1, 2]
+    group._slots[1].breaker.state = OPEN
+    assert group.capacity_replicas() == [0, 2]
+    assert group.live_replicas() == [0, 1, 2]     # open != dead
+
+
+def test_autoscaler_scales_up_and_down_on_window_signals():
+    group = ReplicaGroup([_StubReplica(0)])
+    ring = _StubRing()
+    a = _autoscaler(group, ring)
+
+    assert a.maybe_scale() is None                 # no window yet
+    ring.push(occupancy=0.95)
+    d = a.maybe_scale()
+    assert d.direction == "up" and d.executed
+    assert sorted(group.live_replicas()) == [0, 1]
+    assert a.maybe_scale() is None                 # same window: no verdict
+    ring.push(occupancy=0.05)
+    d = a.maybe_scale()
+    assert d.direction == "down" and d.executed
+    assert len(group.live_replicas()) == 1
+    assert a.scale_ups == 1 and a.scale_downs == 1
+    assert [e["direction"] for e in a.events] == ["up", "down"]
+    assert all(e["t_s"] >= 0 for e in a.events)
+    # the dashboard gauge carries the last verdict (-1 = down)
+    assert group.metrics()["gauges"][spans.AUTOSCALE_DECISION] == -1.0
+    counters = group.counters()
+    assert counters["replica_scale_ups"] == 1
+    assert counters["replica_scale_downs"] == 1
+    group.close()
+
+
+def test_autoscaler_down_blocked_while_handoff_in_flight():
+    group = ReplicaGroup([_StubReplica(i) for i in range(2)])
+    ring = _StubRing()
+    a = _autoscaler(group, ring)
+    group.handoff_in_flight = lambda: True
+    ring.push(occupancy=0.05)
+    d = a.maybe_scale()
+    assert d.direction == "down" and not d.executed
+    assert "handoff in flight" in d.reason
+    assert len(group.live_replicas()) == 2
+    group.close()
+
+
+def test_autoscaler_retires_least_loaded_replica():
+    group = ReplicaGroup([_StubReplica(i) for i in range(2)])
+    # load both caches through the router so route_counts sees skew
+    heavy = group.assignment(0)
+    for c in range(24):
+        group.split_step(f"p{c}", None, 1, c)
+    counts = group.route_counts()
+    light = min(counts, key=lambda idx: (counts[idx], -idx))
+    ring = _StubRing()
+    a = _autoscaler(group, ring)
+    ring.push(occupancy=0.05)
+    d = a.maybe_scale()
+    assert d.executed and d.replica == light
+    assert group.live_replicas() == [1 - light]
+    del heavy
+    group.close()
+
+
+# --------------------------------------------------------------------- #
+# scale-up adoption: minimal churn, replay-clean reroutes
+# --------------------------------------------------------------------- #
+
+def test_add_replica_minimal_churn_and_only_to_newcomer():
+    """HRW N->N+1: moved clients land ONLY on the newcomer, and the
+    moved fraction stays near 1/(N+1) (<= 1.5x the ideal share)."""
+    n, clients = 3, 400
+    group = ReplicaGroup([_StubReplica(i) for i in range(n)])
+    before = {c: group.assignment(c) for c in range(clients)}
+    new_idx = group.add_replica(lambda idx: _StubReplica(idx))
+    assert new_idx == n
+    moved = 0
+    for c in range(clients):
+        after = group.assignment(c)
+        if after != before[c]:
+            assert after == new_idx, f"client {c} moved to a bystander"
+            moved += 1
+    assert 0 < moved <= 1.5 * clients / (n + 1)
+    group.close()
+
+
+def test_scale_up_rerouted_garbage_dup_replays_clean():
+    """A step applied before the scale-up, retransmitted after it with a
+    garbage payload by a client HRW moved to the newcomer: served the
+    migrated original reply bit-identically, applied exactly once."""
+    group = ReplicaGroup([_StubReplica(i) for i in range(2)])
+    # find a client the 2->3 transition will move
+    mover = next(c for c in range(512)
+                 if rendezvous_pick(c, [0, 1, 2]) == 2)
+    origin = group.assignment(mover)
+    orig = group.split_step("orig-payload", None, 5, mover)
+    group.add_replica(lambda idx: _StubReplica(idx))
+    assert group.assignment(mover) == 2
+
+    dup = group.split_step("garbage-payload", None, 5, mover)
+    assert dup == orig
+    assert dup[-1] == "orig-payload"
+    assert dup[3] == origin                       # the original applier
+    applies = [a for r in group.replicas for a in r.applies
+               if a[0] == mover and a[1] == 5]
+    assert len(applies) == 1
+    assert group.replicas[2].applies == []        # newcomer applied nothing
+    group.close()
+
+
+def test_scale_down_garbage_dup_served_bit_identical_once():
+    """The acceptance pin: a step applied on the scale-down victim,
+    retransmitted with a garbage payload after the policy retired it —
+    one apply total, the dup answered from the merged replay entry."""
+    group = ReplicaGroup([_StubReplica(i) for i in range(2)])
+    victim = group.assignment(7)
+    orig = group.split_step("orig-payload", None, 2, 7)
+    assert group.replicas[victim].applies[-1][2] == "orig-payload"
+
+    ring = _StubRing()
+    a = _autoscaler(group, ring)
+    # idle window, but the applier must be the one the policy retires:
+    # load the other replica with more clients so least-loaded picks
+    # the victim deterministically
+    survivor = 1 - victim
+    others = [c for c in range(8, 256)
+              if group.assignment(c) == survivor][:2]
+    for i, c in enumerate(others):
+        group.split_step(f"other{i}", None, 1, c)
+    ring.push(occupancy=0.01)
+    d = a.maybe_scale()
+    assert d.executed and d.direction == "down" and d.replica == victim
+
+    dup = group.split_step("garbage-payload", None, 2, 7)
+    assert dup == orig
+    assert dup[-1] == "orig-payload"
+    applies = [x for r in group.replicas for x in r.applies
+               if x[0] == 7 and x[1] == 2]
+    assert len(applies) == 1
+    assert group.counters()["replica_scale_downs"] == 1
+    group.close()
+
+
+# --------------------------------------------------------------------- #
+# close() vs in-flight handoff (satellite: drain, don't drop)
+# --------------------------------------------------------------------- #
+
+def test_group_close_drains_inflight_handoff():
+    """close() racing a scale-down handoff waits for the commit instead
+    of closing the survivors out from under the merge: the migrated
+    entry still serves the dup after close began."""
+    group = ReplicaGroup([_StubReplica(i) for i in range(2)])
+    victim = group.assignment(0)
+    orig = group.split_step("orig", None, 1, 0)
+
+    release = threading.Event()
+    real_extras = group.replicas[victim].export_runtime_extras
+
+    def slow_extras(step):
+        release.wait(timeout=30.0)
+        return real_extras(step)
+
+    group.replicas[victim].export_runtime_extras = slow_extras
+    closer_done = threading.Event()
+
+    def closer():
+        # wait until the handoff is fenced, then race close against it
+        while not group.handoff_in_flight():
+            pass
+        group.close()
+        closer_done.set()
+
+    remover = threading.Thread(
+        target=group.remove_replica, args=(victim,))
+    t = threading.Thread(target=closer)
+    remover.start()
+    t.start()
+    assert not closer_done.wait(timeout=0.3)      # close() is draining
+    release.set()
+    remover.join(timeout=30.0)
+    t.join(timeout=30.0)
+    assert closer_done.is_set()
+    # the merge landed before the survivors closed: dup served from it
+    dup = group.split_step("garbage", None, 1, 0)
+    assert dup == orig
+    assert group.counters()["replica_handoffs"] == 1
+
+
+# --------------------------------------------------------------------- #
+# config plumbing + zero-overhead-off
+# --------------------------------------------------------------------- #
+
+def test_env_config_parsing(monkeypatch):
+    for var in ("SLT_AUTOSCALE", "SLT_AUTOSCALE_MIN", "SLT_AUTOSCALE_MAX",
+                "SLT_AUTOSCALE_COOLDOWN_S"):
+        monkeypatch.delenv(var, raising=False)
+    cfg = rt_autoscale.env_config()
+    assert cfg["enabled"] is False
+    assert cfg["min_replicas"] == 1 and cfg["max_replicas"] == 4
+    monkeypatch.setenv("SLT_AUTOSCALE", "1")
+    monkeypatch.setenv("SLT_AUTOSCALE_MIN", "2")
+    monkeypatch.setenv("SLT_AUTOSCALE_MAX", "6")
+    monkeypatch.setenv("SLT_AUTOSCALE_COOLDOWN_S", "0.5")
+    cfg = rt_autoscale.env_config()
+    assert cfg == {"enabled": True, "min_replicas": 2,
+                   "max_replicas": 6, "cooldown_s": 0.5}
+
+
+def test_args_config_cli_over_env(monkeypatch):
+    import argparse
+    for var in ("SLT_AUTOSCALE", "SLT_AUTOSCALE_MIN", "SLT_AUTOSCALE_MAX",
+                "SLT_AUTOSCALE_COOLDOWN_S"):
+        monkeypatch.delenv(var, raising=False)
+    ns = argparse.Namespace(autoscale=False, autoscale_min=None,
+                            autoscale_max=None, autoscale_cooldown_s=None)
+    # off everywhere -> None: the zero-overhead pin, no policy object
+    assert rt_autoscale.args_config(ns) is None
+    # a namespace without the attrs at all (stage role) is off too
+    assert rt_autoscale.args_config(argparse.Namespace()) is None
+    # env on, CLI overrides the numbers
+    monkeypatch.setenv("SLT_AUTOSCALE", "true")
+    ns.autoscale_max = 8
+    cfg = rt_autoscale.args_config(ns)
+    assert cfg["enabled"] is True and cfg["max_replicas"] == 8
+    # CLI flag alone turns it on
+    monkeypatch.delenv("SLT_AUTOSCALE")
+    ns.autoscale = True
+    ns.autoscale_min = 2
+    cfg = rt_autoscale.args_config(ns)
+    assert cfg["enabled"] is True and cfg["min_replicas"] == 2
+
+
+def test_policy_from_config_maps_cooldowns():
+    clk = _Clock()
+    p = rt_autoscale.policy_from_config(
+        {"enabled": True, "min_replicas": 2, "max_replicas": 5,
+         "cooldown_s": 3.0}, clock=clk)
+    assert p.min_replicas == 2 and p.max_replicas == 5
+    assert p.cooldown_up_s == 3.0
+    assert p.cooldown_down_s == 6.0               # retiring is the slower reflex
+    assert p._clock is clk
+
+
+def test_zero_overhead_off_maybe_replicate_untouched():
+    """--replicas 1 without --autoscale stays the bare runtime — no
+    group, no router, no policy anywhere near the step path."""
+    bare = _StubReplica(0)
+    assert maybe_replicate(lambda idx: bare, 1) is bare

@@ -477,7 +477,7 @@ def test_bench_replica_failover_role_quick():
 
 REPLICATION_KEYS = {"replicas", "kill_replica_at", "kills",
                     "live_replicas", "handoff", "reroute_wait",
-                    "handoff_latency", "per_replica"}
+                    "handoff_latency", "per_replica", "replica_seconds"}
 HANDOFF_KEYS = {"replica_routes", "replica_reroutes", "replica_deaths",
                 "replica_handoffs", "handoff_replay_entries",
                 "handoff_ef_entries", "handoff_deferred_flushed",
@@ -512,6 +512,8 @@ def test_fleet_sim_replication_schema(monkeypatch, capsys):
     assert null_arm["handoff_latency"] == {"p50_ms": None,
                                            "p99_ms": None}
     assert null_arm["per_replica"] == []
+    # the one bare replica is alive for the whole run
+    assert null_arm["replica_seconds"] > 0
 
     # chaos-kill arm: 2 replicas, kill the busiest mid-run
     monkeypatch.setattr(sys, "argv", [
@@ -534,6 +536,11 @@ def test_fleet_sim_replication_schema(monkeypatch, capsys):
     rows = kill_arm["per_replica"]
     assert [r["replica"] for r in rows] == [0, 1]
     assert sum(r["alive"] for r in rows) == 1
+    # per-replica alive windows: the killed one stopped accruing, and
+    # the group total is the sum of the per-replica windows
+    assert all(r["alive_s"] >= 0 for r in rows)
+    assert kill_arm["replica_seconds"] == pytest.approx(
+        sum(r["alive_s"] for r in rows), abs=0.01)
     # gate held through the kill: every scheduled step completed
     assert summary["dropped_steps"] == 0
     assert summary["steps_completed"] == summary["steps_expected"]
@@ -542,6 +549,102 @@ def test_fleet_sim_replication_schema(monkeypatch, capsys):
     monkeypatch.setattr(sys, "argv", [
         "fleet_sim.py", "--clients", "2", "--kill-replica-at", "1"])
     assert fleet_sim.main() == 2
+
+
+AUTOSCALE_KEYS = {"enabled", "min_replicas", "max_replicas",
+                  "cooldown_s", "decisions", "scale_ups", "scale_downs",
+                  "events", "replica_seconds",
+                  "static_peak_replica_seconds", "peak_replicas",
+                  "final_replicas", "p99_ms_trajectory"}
+
+
+def test_fleet_sim_summary_autoscale_schema(monkeypatch, capsys):
+    """The ``autoscale`` block is schema-stable across arms: an elastic
+    run ships the policy config, the scale-event log, replica-seconds
+    against the static-peak counterfactual and the policy-seen p99
+    trajectory; a run without --autoscale ships the same keys with the
+    false/empty/null arm — and constructs no policy at all (the
+    zero-overhead-off pin)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "fleet_sim_as", os.path.join(REPO, "scripts", "fleet_sim.py"))
+    fleet_sim = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fleet_sim)
+
+    # elastic arm: short windows + a fast cooldown so the pump gets
+    # several evaluations inside even a tiny run
+    monkeypatch.setattr(sys, "argv", [
+        "fleet_sim.py", "--clients", "4", "--steps", "2",
+        "--rate", "5.0", "--batch", "4", "--workers", "4",
+        "--autoscale", "--autoscale-min", "1", "--autoscale-max", "2",
+        "--autoscale-cooldown-s", "0.1",
+        "--telemetry-interval-s", "0.1"])
+    assert fleet_sim.main() == 0
+    out = capsys.readouterr().out
+    summary = json.loads(out[out.index("{"):])
+    block = summary["autoscale"]
+    assert set(block) == AUTOSCALE_KEYS
+    assert block["enabled"] is True
+    assert block["min_replicas"] == 1 and block["max_replicas"] == 2
+    assert block["cooldown_s"] == pytest.approx(0.1)
+    assert block["decisions"] >= 1
+    assert block["replica_seconds"] > 0
+    # the counterfactual is peak * run-wall; replica_seconds spans the
+    # group's whole lifetime (warmup included), so only sign-check here
+    assert block["static_peak_replica_seconds"] > 0
+    assert block["peak_replicas"] >= 1
+    assert block["final_replicas"] >= 1
+    for ev in block["events"]:
+        assert set(ev) == {"t_s", "window", "direction", "reason",
+                           "replica", "n_live"}
+        assert ev["direction"] in ("up", "down")
+    # the elastic arm fronts a group even at one replica, so the
+    # replication block reports through the router view
+    assert summary["replication"]["replicas"] >= 1
+    assert summary["config"]["autoscale"] is True
+
+    # null arm: same keys, false/empty/null values — exact dict
+    monkeypatch.setattr(sys, "argv", [
+        "fleet_sim.py", "--clients", "2", "--steps", "1",
+        "--rate", "5.0", "--batch", "4"])
+    assert fleet_sim.main() == 0
+    out = capsys.readouterr().out
+    summary = json.loads(out[out.index("{"):])
+    assert summary["autoscale"] == {
+        "enabled": False, "min_replicas": None, "max_replicas": None,
+        "cooldown_s": None, "decisions": 0, "scale_ups": 0,
+        "scale_downs": 0, "events": [], "replica_seconds": None,
+        "static_peak_replica_seconds": None, "peak_replicas": None,
+        "final_replicas": None, "p99_ms_trajectory": []}
+    assert summary["config"]["autoscale"] is False
+
+    # --gate-autoscale without --autoscale is a usage error, not a hang
+    monkeypatch.setattr(sys, "argv", [
+        "fleet_sim.py", "--clients", "2", "--gate-autoscale"])
+    assert fleet_sim.main() == 2
+
+
+@pytest.mark.slow
+def test_bench_autoscale_diurnal_role_quick():
+    """The autoscale_diurnal side leg (in-process, quick): static-peak
+    vs elastic twins over one seeded diurnal schedule — the contract
+    fields the orchestrator publishes plus every gate it enforces."""
+    sys.path.insert(0, REPO)
+    from bench import measure_autoscale_diurnal
+
+    rec = measure_autoscale_diurnal(quick=True)
+    assert rec["valid"], rec["invalid_reason"]
+    expected = rec["clients"] * rec["steps_per_client"]
+    for tag in ("static", "elastic"):
+        assert rec[tag]["steps_completed"] == expected
+        assert rec[tag]["dropped_steps"] == 0
+    assert rec["static"]["scale_ups"] == 0
+    assert rec["elastic"]["scale_ups"] >= 1
+    assert rec["elastic"]["settled_p99_ms"] is not None
+    assert rec["elastic"]["settled_p99_ms"] <= rec["slo_ms"]
+    assert rec["elastic"]["replica_seconds"] < \
+        rec["static"]["replica_seconds"]
+    assert rec["replica_seconds_saved"] > 0
 
 
 TELEMETRY_KEYS = {"enabled", "interval_s", "windows",
