@@ -353,7 +353,8 @@ def render(rep: Dict[str, Any]) -> str:
             lines.append(f"  {'stage':>5} {'sched':>6} {'bubble':>8} "
                          f"{'gpipe':>8} {'1f1b':>8} "
                          f"{'reply_p50':>10} {'hops':>6} {'applyQ':>7} "
-                         f"{'ratio':>7} {'density':>8}")
+                         f"{'ratio':>7} {'density':>8} "
+                         f"{'mesh':>9} {'mfu':>6}")
             for row in stages:
                 if not isinstance(row, dict):
                     continue
@@ -383,11 +384,23 @@ def render(rep: Dict[str, Any]) -> str:
                 dens = row.get("density")
                 dens_col = (f"{dens:>8.3f}" if dens is not None
                             else f"{'-':>8}")
+                # per-stage mesh + MFU (ISSUE 20 composed topologies):
+                # mesh renders as dataxmodel; pre-ISSUE-20 sidecars
+                # carry neither and fall back to '-'
+                mesh = row.get("mesh")
+                if isinstance(mesh, dict):
+                    mesh_col = (f"{int(mesh.get('data', 1))}x"
+                                f"{int(mesh.get('model', 1))}").rjust(9)
+                else:
+                    mesh_col = f"{'-':>9}"
+                smfu = row.get("mfu")
+                smfu_col = (f"{smfu:>6.1%}" if smfu is not None
+                            else f"{'-':>6}")
                 lines.append(
                     f"  {int(row.get('stage', 0)):>5d} {sched_col} "
                     f"{bub_col} {gpipe_col} {onefb_col} {p50_col} "
                     f"{int(row.get('hop_calls', 0)):>6d} {depth_col} "
-                    f"{ratio_col} {dens_col}")
+                    f"{ratio_col} {dens_col} {mesh_col} {smfu_col}")
         dc = pipe.get("density")
         if isinstance(dc, dict) and dc.get("windows_closed"):
             lines.append(

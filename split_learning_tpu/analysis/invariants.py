@@ -574,6 +574,61 @@ def scale_down_exactly_once(run: Any) -> None:
                 f"never saw")
 
 
+def sharded_handoff_reshard(run: Any) -> None:
+    """Sharded-stage failover discipline (ISSUE 20): everything
+    :func:`handoff_exactly_once` demands — here over the composite hop
+    keys ``(client, op, step*STRIDE+mb)`` — plus the placement half of
+    the handoff: a migrated reply served by a successor must have been
+    re-scattered onto the SUCCESSOR's mesh during the handoff merge,
+    and every serve must hand out the serving replica's own placement,
+    never the dead replica's (a stale device buffer outliving its mesh
+    is exactly the bug a host-encoded capture exists to prevent).
+
+    Notes read: the SLT114 set (``begin(key, owner, replica)``,
+    ``apply(key, replica)``, ``resolve(key, value, replica)``,
+    ``wait_return(key, value, replica)``), plus ``mesh_of(replica,
+    mesh)`` noted once per replica at build, ``migrate(key, dst)``
+    noted by the handoff merge per installed entry, and a
+    ``placement`` field on ``resolve``/``wait_return``."""
+    handoff_exactly_once(run)
+    mesh_of: Dict[Any, Any] = {}
+    for f in _notes(run, "mesh_of"):
+        mesh_of[f.get("replica")] = f.get("mesh")
+    resolved_on: Dict[Any, Any] = {}
+    for f in _notes(run, "resolve"):
+        resolved_on.setdefault(f["key"], f.get("replica"))
+    migrated: Dict[Any, Any] = {}
+    for f in _notes(run, "migrate"):
+        migrated[f["key"]] = f.get("dst")
+    for f in _notes(run, "wait_return"):
+        serving = f.get("replica")
+        own_mesh = mesh_of.get(serving)
+        if f.get("placement") != own_mesh:
+            raise Violation(
+                "sharded_handoff_reshard", run.schedule_id,
+                f"duplicate of {f['key']} served from replica "
+                f"{serving} with placement {f.get('placement')!r}; the "
+                f"replica's own mesh is {own_mesh!r} — a stale buffer "
+                f"outlived its mesh")
+        origin = resolved_on.get(f["key"])
+        if origin is None or origin == serving:
+            continue
+        dst = migrated.get(f["key"])
+        if dst is None:
+            raise Violation(
+                "sharded_handoff_reshard", run.schedule_id,
+                f"duplicate of {f['key']} served by replica {serving} "
+                f"but resolved on replica {origin} with no migrated "
+                f"entry — the handoff merge never carried it over")
+        if dst != own_mesh:
+            raise Violation(
+                "sharded_handoff_reshard", run.schedule_id,
+                f"entry {f['key']} migrated with placement {dst!r}, "
+                f"but the serving replica's mesh is {own_mesh!r} — the "
+                f"captured extras were not re-scattered onto the "
+                f"successor's mesh")
+
+
 def flush_before_save(run: Any) -> None:
     """Checkpoint capture happens only after the deferred-apply queue
     drained: a snapshot taken with updates still queued persists params
@@ -607,6 +662,7 @@ INVARIANTS: Dict[str, Callable[[Any], None]] = {
     "flush_before_save": flush_before_save,
     "handoff_exactly_once": handoff_exactly_once,
     "scale_down_exactly_once": scale_down_exactly_once,
+    "sharded_handoff_reshard": sharded_handoff_reshard,
 }
 
 # --check findings flow through slt-lint's waiver/exit-code machinery;
@@ -630,6 +686,7 @@ RULE_OF_INVARIANT: Dict[str, str] = {
     "handoff_exactly_once": "SLT114",
     "onefb_hop_order": "SLT115",
     "scale_down_exactly_once": "SLT116",
+    "sharded_handoff_reshard": "SLT117",
 }
 
 

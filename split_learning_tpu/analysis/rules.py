@@ -671,6 +671,25 @@ def check_slt011(src: Src) -> Iterator[Finding]:
 # finding message honest ("hold the lock or go through the barrier")
 _FLUSH_BARRIER_METHODS = frozenset({"export_state", "flush_deferred"})
 
+# the composable party core (runtime/party.py) and its public thin
+# configurations — a subclass inherits the deferred queue and the mesh
+# seams from the base even when its own body never names them, so the
+# runtime rules scope by inheritance, not by per-class attribute
+# sightings
+_PARTY_CORE_BASES = frozenset(
+    {"PartyRuntime", "ServerRuntime", "StageRuntime"})
+
+
+def _is_party_subclass(cls: ast.ClassDef) -> bool:
+    """True when the class derives (textually) from the party core or
+    one of its public configurations."""
+    for b in cls.bases:
+        name = (b.id if isinstance(b, ast.Name)
+                else b.attr if isinstance(b, ast.Attribute) else None)
+        if name in _PARTY_CORE_BASES:
+            return True
+    return False
+
 
 def _mentions_deferred(cls: ast.ClassDef) -> bool:
     """Does this class own a deferred-apply queue (``self._deferred``)?
@@ -748,7 +767,8 @@ def check_slt012(src: Src) -> Iterator[Finding]:
     if not _in_dir(src, "runtime"):
         return
     for node in ast.walk(src.tree):
-        if isinstance(node, ast.ClassDef) and _mentions_deferred(node):
+        if isinstance(node, ast.ClassDef) and (
+                _mentions_deferred(node) or _is_party_subclass(node)):
             v = _Slt012Visitor(src)
             for item in node.body:
                 v.visit(item)
@@ -844,7 +864,8 @@ def check_slt013(src: Src) -> Iterator[Finding]:
     if not _in_dir(src, "runtime"):
         return
     for node in ast.walk(src.tree):
-        if isinstance(node, ast.ClassDef) and _mentions_mesh(node):
+        if isinstance(node, ast.ClassDef) and (
+                _mentions_mesh(node) or _is_party_subclass(node)):
             v = _Slt013Visitor(src)
             for item in node.body:
                 v.visit(item)

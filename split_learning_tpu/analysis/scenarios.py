@@ -921,6 +921,136 @@ def scale_down_inflight_race(ctx: Ctx) -> Dict[str, Any]:
             "fenced_waits": int(counters["replica_fenced_waits"])}
 
 
+@scenario("sharded_stage_handoff",
+          invariants=("sharded_handoff_reshard", "exactly_once_claims"),
+          budget=300, bound=2, requires="jax")
+def sharded_stage_handoff(ctx: Ctx) -> Dict[str, Any]:
+    """A 2-replica group of a SHARDED pipeline stage under a mid-run
+    kill (ISSUE 20): hop deliveries (and a duplicate retransmit) flow
+    through the real ReplicaGroup hop router — sticky rendezvous
+    routing, the handoff fence, quiesce, extras capture, replay
+    migration — while the victim dies at every explored point of the
+    hop claim lifecycle. Exactly-once must hold group-wide over the
+    composite ``(client, op, step*STRIDE+mb)`` keys, AND every migrated
+    reply a successor serves must be re-scattered onto the SUCCESSOR's
+    mesh — never handed out with the dead replica's placement (a
+    sharded stage's device buffers die with it; only the host-encoded
+    capture survives, and the successor's serve is an H2D re-scatter
+    onto its own devices)."""
+    import jax
+    from split_learning_tpu.runtime.replay import ReplayCache
+    from split_learning_tpu.runtime.replica import ReplicaGroup
+    from split_learning_tpu.runtime.stage import MB_STRIDE, hop_seq
+
+    ndev = jax.device_count()
+
+    class _ResharedReplay(ReplayCache):
+        """The successor's side of the handoff merge: ``put`` is the
+        one entry point migrated records arrive through (born resolved,
+        first-apply-wins), so the re-scatter onto the owner's mesh —
+        and its note — live here."""
+
+        def __init__(self, owner: Any) -> None:
+            super().__init__(window=8)
+            self._owner = owner
+
+        def put(self, cid: int, op: str, st: int, result: Any) -> Any:
+            ctx.note("migrate", key=(int(cid), str(op), int(st)),
+                     dst=self._owner.placement)
+            return super().put(cid, op, st, result)
+
+    class _ShardedStageStub:
+        """StageRuntime's hop-claim lifecycle minus the programs: a
+        real ReplayCache decides ownership over the composite hop keys,
+        only the owner notes apply, and serve-side placement is modeled
+        by a real ``device_put`` of a tiny buffer onto the replica's
+        OWN device — the re-scatter a sharded successor performs on a
+        migrated host reply."""
+
+        def __init__(self, idx: int) -> None:
+            self.idx = idx
+            self.stage_index = 1
+            self.replay = _ResharedReplay(self)
+            self._seq = -1
+            # distinct placements when the host topology allows: the
+            # reshard the invariant tracks is host bytes -> THIS device
+            self.device = jax.devices()[idx % ndev]
+            self.placement = f"replica{idx}/dev{self.device.id}"
+            ctx.note("mesh_of", replica=idx, mesh=self.placement)
+
+        def health(self) -> Dict[str, Any]:
+            return {"step": max(self._seq // MB_STRIDE, -1),
+                    "status": "serving"}
+
+        def _rescatter(self) -> None:
+            buf = jax.device_put(np.zeros((1,), np.float32), self.device)
+            assert self.device in buf.devices()
+
+        def hop_forward(self, x: Any, step: int, mb: int = 0,
+                        client_id: int = 0, *,
+                        device: bool = False) -> Any:
+            seq = hop_seq(step, mb)
+            key = (client_id, "hop_fwd", seq)
+            entry, owner = self.replay.begin(client_id, "hop_fwd", seq)
+            ctx.note("begin", key=key, owner=owner, replica=self.idx)
+            if not owner:
+                value = self.replay.wait(entry, timeout=30.0)
+                self._rescatter()
+                ctx.note("wait_return", key=key, value=value,
+                         replica=self.idx, placement=self.placement)
+                return value
+            ctx.step("claim")  # the kill can land inside the window
+            self._seq = max(self._seq, seq)
+            ctx.note("apply", key=key, replica=self.idx)
+            value = ("reply", client_id, seq, self.idx)
+            self.replay.resolve(entry, value)
+            ctx.note("resolve", key=key, value=value, replica=self.idx,
+                     placement=self.placement)
+            return value
+
+        def flush_deferred(self) -> int:
+            return 0
+
+        def export_runtime_extras(self, step: int) -> Dict[str, Any]:
+            from split_learning_tpu.runtime import checkpoint as _ckpt
+            return _ckpt.build_extras(
+                step, 1, replay=self.replay.export_state(), wire_ef=[])
+
+        def close(self) -> None:
+            pass
+
+    group = ReplicaGroup([_ShardedStageStub(i) for i in range(2)])
+    victim = group.assignment(0)  # the replica client 0 lives on
+    # a bystander client on the OTHER replica: its route must survive
+    # the handoff unmoved (sticky routing is minimal-churn)
+    other = next(c for c in range(1, 8)
+                 if group.assignment(c) != victim)
+
+    def deliver(cid: int, mb: int, tag: str) -> None:
+        if tag == "dup":
+            ctx.step("wire")  # the retransmit window
+        group.hop_forward(None, 1, mb, cid)
+
+    def killer() -> None:
+        ctx.step("kill")  # explored against every lifecycle point
+        group.kill(victim)
+
+    workers = [ctx.spawn(deliver, 0, 0, "orig", name="c0-orig"),
+               ctx.spawn(deliver, 0, 0, "dup", name="c0-dup"),
+               ctx.spawn(deliver, other, 0, "orig", name="c-other"),
+               ctx.spawn(killer, name="killer")]
+    for w in workers:
+        w.join()
+    counters = group.counters()
+    assert counters["replica_handoffs"] == 1, counters
+    assert group.live_replicas() == [1 - victim]
+    # stickiness: the bystander never moved off its surviving replica
+    assert group.assignment(other) == 1 - victim
+    return {"handoffs": int(counters["replica_handoffs"]),
+            "migrated": int(counters["handoff_replay_entries"]),
+            "fenced_waits": int(counters["replica_fenced_waits"])}
+
+
 # --------------------------------------------------------------------- #
 # crash–restart scenarios (slt-crash, SLT109–112)
 # --------------------------------------------------------------------- #

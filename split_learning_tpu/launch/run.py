@@ -539,6 +539,51 @@ def cmd_train(args) -> int:
             return 2
         M = max(cfg.microbatches, 1)
         lag = getattr(args, "apply_lag", 0) or 0
+        # per-stage pjit (ISSUE 20): --mesh-data/--mesh-model shard the
+        # IN-PROCESS stage parties. The stage's H2D scatter shards each
+        # microbatch's batch dim over 'data', so rows-per-microbatch
+        # must divide the axis — the sharded server role's rule, per
+        # microbatch. Remote http stages pick their own mesh at serve
+        # time.
+        chain_mesh_data = int(getattr(args, "mesh_data", 1) or 1)
+        chain_mesh_model = int(getattr(args, "mesh_model", 1) or 1)
+        if chain_mesh_data * chain_mesh_model > 1 \
+                and args.transport == "http":
+            print("[warn] --mesh-data/--mesh-model shard in-process "
+                  "stage parties; remote http stages take their own "
+                  "mesh flags at serve time — ignored here",
+                  file=sys.stderr)
+        elif chain_mesh_data > 1 and (
+                cfg.batch_size % M
+                or (cfg.batch_size // M) % chain_mesh_data):
+            print(f"[error] --mesh-data {chain_mesh_data} needs the "
+                  f"per-microbatch rows (batch_size/microbatches = "
+                  f"{cfg.batch_size}/{M}) divisible by the data axis — "
+                  "the same rule as the sharded server role",
+                  file=sys.stderr)
+            return 2
+        # replicated stage parties (ISSUE 20): every in-process stage
+        # fronts a ReplicaGroup, same router/handoff seam as the server
+        # role. Host-reply wires only — a device wire's replay entries
+        # are device-resident and die with the replica.
+        chain_replicas = getattr(args, "replicas", 1) or 1
+        if chain_replicas > 1 and args.transport != "local":
+            print("[error] --replicas > 1 on the chain composes "
+                  "in-process stage parties behind the group router "
+                  "and needs --transport local (http stages are their "
+                  "own processes; the device wire's replay entries are "
+                  "device-resident and die with the replica)",
+                  file=sys.stderr)
+            return 2
+        if chain_replicas > 1 and cfg.checkpoint_dir:
+            # mirror the replicated server role's refusal: the group's
+            # checkpoint story is the handoff sidecar, not N interleaved
+            # per-stage trees in one directory
+            print("[error] --replicas > 1 does not compose with "
+                  "--checkpoint-dir yet (per-replica save/resume "
+                  "layout is ambiguous); drop one of them",
+                  file=sys.stderr)
+            return 2
         stage_rts: list = []
         transports: list = []
         # compressed hop wires (PR 18): --compress extends the 2-party
@@ -602,12 +647,18 @@ def cmd_train(args) -> int:
                     return 4
                 transports.append(t)
         else:
+            from split_learning_tpu.runtime.replica import maybe_replicate
             for i in range(1, plan.num_stages):
-                srt = StageRuntime(plan, i, cfg,
-                                   jax.random.PRNGKey(cfg.seed), sample,
-                                   microbatches=M, apply_lag=lag,
-                                   mesh=_server_mesh(args),
-                                   ef_mode=chain_ef_mode)
+                def _make_stage(_ridx: int = 0, _i: int = i):
+                    # same PRNGKey per replica: one stage model, N
+                    # servers of it (the server role's convention)
+                    return StageRuntime(plan, _i, cfg,
+                                        jax.random.PRNGKey(cfg.seed),
+                                        sample, microbatches=M,
+                                        apply_lag=lag,
+                                        mesh=_server_mesh(args),
+                                        ef_mode=chain_ef_mode)
+                srt = maybe_replicate(_make_stage, chain_replicas)
                 stage_rts.append(srt)
                 if args.transport == "device":
                     # zero-copy co-located wire: device buffers hand
@@ -726,11 +777,14 @@ def cmd_train(args) -> int:
                     write_extras(d, srt.export_runtime_extras(step))
 
         step = start_step
+        bad_losses = 0
         try:
             with _ckpt_drain(ckptr), trace_ctx:
                 for epoch in range(cfg.epochs):
                     for x, y in data_iter():
                         final_loss = runner.step(x, y, step)
+                        if not np.isfinite(final_loss):
+                            bad_losses += 1
                         logger.log_metric("loss", final_loss, step=step)
                         step += 1
                         if (args.checkpoint_every
@@ -773,6 +827,38 @@ def cmd_train(args) -> int:
                   f"densities={dc_snap['densities']} "
                   f"(budget {dc_snap['budget_nats']} nats / "
                   f"{dc_snap['window']}-step window)", file=sys.stderr)
+        if getattr(args, "gate_dropped_steps", False):
+            # fleet_sim's exactly-once gate, on the MPMD chain: every
+            # scheduled step produced a finite loss AND every stage
+            # party acknowledged the last step — a replica handoff or
+            # resharded hop that silently ate a microbatch shows up as
+            # a lagging health step
+            want = step - 1
+
+            def _stage_step(srt) -> int:
+                h = srt.health()
+                grp = h.get("replicas")
+                if grp is not None and "step_max" in grp:
+                    # replicated party: the trained state may sit on
+                    # any live replica — gate on the group-wide max
+                    return int(grp["step_max"])
+                return int(h.get("step", -1))
+
+            lagging = [(srt.stage_index, _stage_step(srt))
+                       for srt in stage_rts
+                       if _stage_step(srt) != want]
+            if bad_losses or lagging:
+                print(f"[gate] DROPPED-STEPS GATE FAILED: "
+                      f"nonfinite_losses={bad_losses} "
+                      f"lagging_stages={lagging} (want step {want})",
+                      file=sys.stderr)
+                return 1
+            handoffs = sum(
+                int(srt.counters().get("replica_handoffs", 0))
+                for srt in stage_rts if hasattr(srt, "counters"))
+            print(f"[gate] ok: {n_steps} steps completed, 0 dropped"
+                  + (f" ({handoffs} replica handoff(s))"
+                     if handoffs else ""), file=sys.stderr)
         if stage_rts:
             full_params = [runner.state.params] + [
                 srt.export_state().params for srt in stage_rts]
@@ -2116,6 +2202,13 @@ def main(argv: Optional[list] = None) -> int:
                     help="FedAvg the replicas' server tops every K group "
                          "steps (0 = never; with one client only its own "
                          "replica trains, so sync propagates the updates)")
+    pt.add_argument("--gate-dropped-steps", dest="gate_dropped_steps",
+                    action="store_true",
+                    help="chain runs (--stages > 2): exit 1 unless every "
+                         "scheduled step completed with a finite loss "
+                         "and every stage party's health step reached "
+                         "the last step — fleet_sim's exactly-once gate "
+                         "on the MPMD chain (composed-topology CI smoke)")
     pt.add_argument("--handoff", dest="handoff",
                     choices=["live", "checkpoint"], default="live",
                     help="how a dead replica's step state reaches its "

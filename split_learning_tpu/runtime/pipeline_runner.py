@@ -465,11 +465,27 @@ class PipelineRunner:
                           + (bwd.durations if bwd is not None else []))
             p50 = durs[len(durs) // 2] if durs else 0.0
             depth = None
+            mesh_info = None
             try:
                 h = t.health()
                 depth = h.get("counters", {}).get("deferred_apply_depth")
+                mesh_info = h.get("mesh")
             except Exception:  # noqa: BLE001 — report stays best-effort
                 pass
+            # per-stage MFU (ISSUE 20): the party's traced-only program
+            # accounting, best-effort — None off-trace, None over HTTP
+            # (the wire exposes health, not trace_metadata), and the
+            # honest None on CPU where no peak is known
+            mfu_val = None
+            srv = getattr(t, "server", None)
+            if srv is not None and hasattr(srv, "trace_metadata"):
+                try:
+                    progs = srv.trace_metadata().get("programs", {})
+                    mfus = [p.get("mfu") for p in progs.values()
+                            if p.get("mfu") is not None]
+                    mfu_val = max(mfus) if mfus else None
+                except Exception:  # noqa: BLE001 — best-effort
+                    pass
             row = {
                 "stage": i + 1,
                 "schedule": self.schedule,
@@ -482,6 +498,11 @@ class PipelineRunner:
                 "reply_p50_ms": p50 * 1e3,
                 "hop_calls": fwd.calls + (bwd.calls if bwd else 0),
                 "deferred_apply_depth": depth,
+                # per-stage mesh shape (ISSUE 20): the composed-topology
+                # report's sharding column — meshless stages report the
+                # honest 1-device layout, matching mesh_axes(None)
+                "mesh": mesh_info or {"devices": 1, "data": 1},
+                "mfu": mfu_val,
             }
             # compressed hop wire accounting (PR 18): cumulative ratio
             # from the transport's own counters, plus the controller's

@@ -6,12 +6,16 @@ inside ONE server process — a single point of failure and a hard
 ceiling at the ROADMAP's millions-of-clients scale. This module turns
 that one hardened server into a fleet of them:
 
-- :class:`ReplicaGroup` owns N independent ``ServerRuntime`` replicas
-  and presents the SAME duck-typed server surface transports already
-  speak (split_step / u_forward / u_backward / predict / aggregate /
-  health / metrics / replay hooks), so ``LocalTransport`` fleets and
-  the HTTP wire route identically — the router seam is the server
-  object itself, not a new protocol.
+- :class:`ReplicaGroup` owns N independent party runtimes — any
+  :class:`~split_learning_tpu.runtime.party.PartyRuntime`: 2-party
+  ``ServerRuntime`` replicas OR K-stage ``StageRuntime`` replicas
+  (ISSUE 20) — and presents the SAME duck-typed surface transports
+  already speak (split_step / u_forward / u_backward / the three hop
+  ops / predict / aggregate / health / metrics / replay hooks), so
+  ``LocalTransport`` fleets, ``DeviceTransport`` chains and the HTTP
+  wire route identically — the router seam is the server object
+  itself, not a new protocol. Sharded replicas compose: param adoption
+  and FedAvg sync re-scatter trees onto each recipient's own mesh.
 - **Sticky routing**: clients map to replicas by rendezvous (HRW)
   hashing over the *routable* set — deterministic across processes
   (blake2b, not the salted builtin ``hash``), minimal-churn on
@@ -592,6 +596,10 @@ class ReplicaGroup:
             params = jax.tree_util.tree_map(jnp.copy,
                                             donor.state.params)
         with runtime._lock:
+            if getattr(runtime, "_params_sharding", None) is not None:
+                # sharded recipient: re-scatter the adopted tree onto
+                # ITS mesh layout (the donor's placement is its own)
+                params = jax.device_put(params, runtime._params_sharding)
             runtime.state = runtime.state._replace(params=params)
 
     def remove_replica(self, idx: int) -> None:
@@ -626,6 +634,25 @@ class ReplicaGroup:
             fl.record(spans.FL_SCALE_DOWN, party="router", replica=idx,
                       live=live)
 
+    # -- party introspection (any PartyRuntime replicates, ISSUE 20) ----- #
+    @property
+    def stage_index(self) -> Any:
+        """Replicated stages duck-type the StageRuntime surface too:
+        transports read the stage index / plan off the server object,
+        and every replica shares them (same factory args)."""
+        return getattr(self._slots[0].runtime, "stage_index", None)
+
+    @property
+    def plan(self) -> Any:
+        return getattr(self._slots[0].runtime, "plan", None)
+
+    @property
+    def _mesh(self) -> Any:
+        """The primary's mesh (DeviceTransport reads this to decide the
+        reshard-to-hub move). Replicas share a mesh shape by
+        construction; param installs below re-scatter per recipient."""
+        return getattr(self._slots[0].runtime, "_mesh", None)
+
     # -- the duck-typed server surface ----------------------------------- #
     def split_step(self, activations: np.ndarray, labels: np.ndarray,
                    step: int, client_id: int = 0) -> Tuple[np.ndarray, float]:
@@ -651,6 +678,41 @@ class ReplicaGroup:
         slot = self._route(client_id)
         try:
             result = slot.runtime.u_backward(feat_grads, step, client_id)
+        finally:
+            self._release(slot)
+        self._note_group_step()
+        return result
+
+    # -- the hop surface (replicated pipeline stages, ISSUE 20) ---------- #
+    def hop_forward(self, x: Any, step: int, mb: int = 0,
+                    client_id: int = 0, *, device: bool = False) -> Any:
+        slot = self._route(client_id)
+        try:
+            return slot.runtime.hop_forward(x, step, mb, client_id,
+                                            device=device)
+        finally:
+            self._release(slot)
+
+    def hop_backward(self, g_out: Any, step: int, mb: int = 0,
+                     client_id: int = 0, *, device: bool = False) -> Any:
+        slot = self._route(client_id)
+        try:
+            result = slot.runtime.hop_backward(g_out, step, mb, client_id,
+                                               device=device)
+        finally:
+            self._release(slot)
+        # a middle stage's microbatch backward is its unit of group
+        # progress (M per step) — the FedAvg sync cadence ticks on it
+        self._note_group_step()
+        return result
+
+    def hop_loss(self, x: Any, labels: Any, step: int, mb: int = 0,
+                 client_id: int = 0, *,
+                 device: bool = False) -> Tuple[Any, Any]:
+        slot = self._route(client_id)
+        try:
+            result = slot.runtime.hop_loss(x, labels, step, mb, client_id,
+                                           device=device)
         finally:
             self._release(slot)
         self._note_group_step()
@@ -709,8 +771,14 @@ class ReplicaGroup:
         live = self.live_replicas()
         info = dict(self._slots[live[0]].runtime.health())
         coalescing: Dict[str, Any] = {}
+        step_max = -1
         for idx in live:
-            sub = self._slots[idx].runtime.health().get("coalescing")
+            sub_health = self._slots[idx].runtime.health()
+            # the sticky router may have parked the trained state on
+            # any live replica — the group-wide step is the furthest
+            # one, not slot live[0]'s (which can be an idle standby)
+            step_max = max(step_max, int(sub_health.get("step", -1)))
+            sub = sub_health.get("coalescing")
             if not sub:
                 continue
             for k, v in sub.items():
@@ -724,6 +792,7 @@ class ReplicaGroup:
             "n": len(self._slots), "live": live,
             "handoff": self.handoff_mode,
             "sync_every": self.sync_every,
+            "step_max": step_max,
             **{k: v for k, v in self.counters().items()}}
         return info
 
@@ -912,8 +981,13 @@ class ReplicaGroup:
             with r._lock:
                 # per-replica copy: the server's jitted step donates its
                 # params buffer, so replicas must never share one
-                r.state = r.state._replace(
-                    params=jax.tree_util.tree_map(jnp.copy, mean))
+                p = jax.tree_util.tree_map(jnp.copy, mean)
+                if getattr(r, "_params_sharding", None) is not None:
+                    # sharded replica: the mean re-scatters onto ITS
+                    # mesh layout before install (fresh per-replica
+                    # buffers either way)
+                    p = jax.device_put(p, r._params_sharding)
+                r.state = r.state._replace(params=p)
         with self._lock:
             self._counters["replica_syncs"] += 1
         return len(runtimes)

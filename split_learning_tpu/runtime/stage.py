@@ -39,10 +39,16 @@ materialized reply — a cotangent is never recomputed, a weight update
 never double-queued (slt-check scenario ``pipeline_hop_chain``,
 invariant SLT113).
 
-Optional per-stage admission gating (runtime/admission.py) and a
-per-stage mesh (PR 11: the forward/reply programs compile with
-NamedSharding specs over ``parallel.distributed.server_state_layout``)
-ride along exactly as on ServerRuntime.
+Since ISSUE 20 the shared machinery lives on
+:class:`split_learning_tpu.runtime.party.PartyRuntime` and a stage can
+carry its OWN ``mesh=``: the three hop programs (and the deferred
+apply) compile per-stage with NamedSharding specs over the PR-11
+``SpecLayout`` rules, incoming hop activations H2D-scatter straight
+onto the ``data`` axis (``_to_dev``), and hop replies leave through the
+sanctioned per-shard ``_host_gather`` (device-native replies skip it —
+the resharding between stage meshes is the transport's job). A
+1-device mesh collapses to the legacy single-device programs
+byte-for-byte.
 """
 
 from __future__ import annotations
@@ -59,14 +65,10 @@ from split_learning_tpu.core.losses import cross_entropy
 from split_learning_tpu.core.stage import SplitPlan
 from split_learning_tpu.obs import dispatch_debug as obs_dispatch
 from split_learning_tpu.obs import flight as obs_flight
-from split_learning_tpu.obs import locks as obs_locks
 from split_learning_tpu.obs import spans
 from split_learning_tpu.obs import trace as obs_trace
-from split_learning_tpu.obs.metrics import Registry
-from split_learning_tpu.parallel.distributed import server_state_layout
-from split_learning_tpu.runtime.admission import AdmissionController
-from split_learning_tpu.runtime.replay import ReplayCache
-from split_learning_tpu.runtime.server import ProtocolError, _DeferredApply
+from split_learning_tpu.runtime.party import (
+    PartyRuntime, ProtocolError, _DeferredApply, mesh_axes)
 from split_learning_tpu.runtime.state import (
     TrainState, apply_grads, make_state, make_tx)
 from split_learning_tpu.utils.config import Config
@@ -90,7 +92,7 @@ def hop_seq(step: int, mb: int) -> int:
     return int(step) * MB_STRIDE + int(mb)
 
 
-class StageRuntime:
+class StageRuntime(PartyRuntime):
     """One middle/last stage of the MPMD chain. Thread-safe: HTTP
     handler threads and the in-process driver's hop workers may call
     concurrently; all state transitions happen under one reentrant
@@ -117,14 +119,19 @@ class StageRuntime:
         loss-hop scaling and the deferred entry's stacked-residual
         arity. ``apply_lag`` is this stage's OWN staleness bound in
         steps (bounds compose per stage across the chain, arXiv:
-        1910.05104)."""
+        1910.05104). ``mesh`` shards THIS stage (per-stage pjit; stages
+        of one chain may carry different meshes — the hop wire reshards
+        between them)."""
         if not 0 < stage_index < plan.num_stages:
             raise ValueError(
                 f"stage_index must be in [1, {plan.num_stages - 1}] "
                 f"(stage 0 is the client's; got {stage_index})")
+        super().__init__(cfg, party=f"stage{int(stage_index)}",
+                         lock_name="StageRuntime._lock", mesh=mesh,
+                         replay_window=replay_window, tenants=tenants,
+                         quota=quota, slo_ms=slo_ms, ef_mode=ef_mode)
         self.plan = plan
         self.stage_index = int(stage_index)
-        self.cfg = cfg
         self.strict_steps = strict_steps
         self.microbatches = int(microbatches)
         if self.microbatches < 1:
@@ -134,49 +141,19 @@ class StageRuntime:
         if self.apply_lag < 0:
             raise ValueError(f"apply_lag must be >= 0 (got {apply_lag})")
         self.is_last = self.stage_index == plan.num_stages - 1
-        self.party = f"stage{self.stage_index}"
-
-        # first-class observability (PR 17): stages expose the same
-        # Registry-backed /metrics + /telemetry surface the 2-party
-        # server does; the lock feeds lock_hold into it when tracing
-        self._metrics = Registry()
-        self._lock = obs_locks.make_lock("StageRuntime._lock",
-                                         registry=self._metrics)
-        self._dd = obs_dispatch.attach()
-        self._ddtok = obs_dispatch.token()
-
-        # a 1-device mesh IS the legacy layout (ServerRuntime precedent)
-        if mesh is not None and mesh.size <= 1:
-            mesh = None
-        self._mesh = mesh
-        self._layout = None
 
         all_params = plan.init(rng, jnp.asarray(sample_input))
         self._tx = make_tx(cfg)
         self.state = make_state(all_params[self.stage_index], self._tx)
-        if self._mesh is not None:
-            self._layout = server_state_layout(self._mesh)
-            self._state_sharding = self._layout.state(self.state)
-            self._params_sharding = self._state_sharding.params
-            self._batch_sharding = self._layout.batch()
-            self.state = jax.device_put(self.state, self._state_sharding)
-        else:
-            # pin the stage's state to its device up front: device-native
-            # hop payloads arrive committed (transport/device.py), and a
-            # committed-ness flip after this stage's first apply would
-            # retrace every stage program on the next step
-            self.state = jax.device_put(self.state, jax.devices()[0])
+        # sharded layout (or, meshless, pin to device 0 up front:
+        # device-native hop payloads arrive committed, and a
+        # committed-ness flip after this stage's first apply would
+        # retrace every stage program on the next step)
+        self._install_layout(pin_single_device=True)
         self._build_jitted()
 
         self._deferred = _DeferredApply(
             self._apply_deferred_entry, self.apply_lag, self._lock)
-        self.replay: Optional[ReplayCache] = (
-            ReplayCache(window=replay_window) if replay_window > 0
-            else None)
-        self._admission: Optional[AdmissionController] = None
-        if tenants > 1 or quota is not None or slo_ms is not None:
-            self._admission = AdmissionController(
-                tenants=tenants, quota=quota, slo_ms=slo_ms)
 
         # per-(client, step) residual records: the pinned params
         # snapshot + per-microbatch device arrays, until the step's
@@ -187,17 +164,6 @@ class StageRuntime:
         self._last_seq: Dict[Tuple[int, str], int] = {}
         self._seq_floor = -1
         self._hops = {"hop_fwd": 0, "hop_bwd": 0, "hop_loss": 0}
-        self._ckpt_lineage = 0
-        # reply-direction error feedback for the compressed hop wire
-        # (PR 18), keyed (client_id, path) by the transports — per
-        # runtime, so the effective key is (client, stage, op).
-        # ef_mode "clapping" swaps in the storage-free ledger: same
-        # selection math, but nothing is checkpointed or migrated.
-        from split_learning_tpu.transport import codec as _codec
-        self.ef_mode = str(ef_mode)
-        self.wire_ef = _codec.make_wire_ef(self.ef_mode)
-        self._wire_totals = [0, 0]  # raw, wire — behind the ratio gauge
-        self._t_start = time.monotonic()
 
     # ------------------------------------------------------------------ #
     def _build_jitted(self) -> None:
@@ -212,18 +178,17 @@ class StageRuntime:
         # BIT-identical to chained sequential steps, not just equal.
         inv_m = 1.0 / float(M)
 
+        # per-stage pjit (PartyRuntime._jit): on a mesh every hop
+        # program compiles with explicit NamedSharding in/out specs;
+        # without one, _jit is jax.jit verbatim — the legacy programs.
         if self._mesh is not None:
             batch = self._batch_sharding
+            state_sh = self._state_sharding
             params_sh = self._params_sharding
             repl = self._layout.replicated()
-
-            def _jit(fn, in_sh, out_sh):
-                return jax.jit(fn, in_shardings=in_sh, out_shardings=out_sh)
         else:
-            batch = params_sh = repl = None
-
-            def _jit(fn, in_sh, out_sh):
-                return jax.jit(fn)
+            batch = state_sh = params_sh = repl = None
+        _jit = self._jit
 
         def fwd_fn(params, x):
             return stage.apply(params, x)
@@ -274,21 +239,14 @@ class StageRuntime:
         # tuples of M same-shaped microbatch arrays ride in as pytrees,
         # so the deferred program's signature is stable for a fixed M —
         # one compile, zero steady-state recompiles. No donation: with
-        # lag > 0 queued entries still hold the params snapshot.
-        self._deferred_apply_fn = jax.jit(deferred_apply_fn)
+        # lag > 0 queued entries still hold the params snapshot. The
+        # in_shardings leaves broadcast over the M-tuples (pytree
+        # prefix), so the sharded twin is still one compile.
+        self._deferred_apply_fn = _jit(
+            deferred_apply_fn, (state_sh, params_sh, batch, batch),
+            state_sh)
 
     # ------------------------------------------------------------------ #
-    def _to_dev(self, x: Any) -> jax.Array:
-        # device-native hop payloads (transport/device.py, PR 16) arrive
-        # as jax.Arrays: device_put/jnp.asarray move or alias them
-        # device-to-device; np.asarray on one would force the very D2H
-        # the device transport exists to remove.
-        if self._mesh is not None:
-            if not isinstance(x, jax.Array):
-                x = np.asarray(x)
-            return jax.device_put(x, self._batch_sharding)
-        return jnp.asarray(x)
-
     def _check_seq(self, op: str, seq: int, client_id: int) -> None:
         last = max(self._last_seq.get((client_id, op), -1),
                    self._seq_floor)
@@ -370,9 +328,11 @@ class StageRuntime:
         ``device=True`` (the co-located DeviceTransport's calling
         convention, PR 16) returns the reply as a jax.Array instead of
         materializing it to host numpy: the driver relays the buffer to
-        the next stage zero-copy. Replay claims store whatever the
-        owner resolved, so duplicates are served the same device buffer
-        — exactly-once semantics are unchanged."""
+        the next stage zero-copy (on a sharded stage, still sharded —
+        the transport reshards it onto the NEXT stage's mesh). Replay
+        claims store whatever the owner resolved, so duplicates are
+        served the same device buffer — exactly-once semantics are
+        unchanged."""
         seq = hop_seq(step, mb)
         entry = None
         if self.replay is not None:
@@ -388,6 +348,7 @@ class StageRuntime:
             with self._lock:
                 t0 = time.perf_counter() if tr is not None else 0.0
                 self._check_seq("hop_fwd", seq, client_id)
+                self._check_batch_rows(int(np.shape(x)[0]))
                 x_dev = self._to_dev(x)
                 if not self.is_last:
                     rec = self._rec_for(client_id, step)
@@ -404,8 +365,13 @@ class StageRuntime:
                 self._last_seq[(client_id, "hop_fwd")] = seq
                 self._hops["hop_fwd"] += 1
             # off the lock: overlap discipline (device replies skip the
-            # materialization entirely — dispatch stays async)
-            y_host = y if device else np.asarray(y)
+            # materialization entirely — dispatch stays async; host
+            # replies leave through the one sanctioned gather)
+            if device:
+                y_host = y
+            else:
+                with obs_dispatch.expected_d2h(self._dd):
+                    y_host = self._host_gather(y)
             if tr is not None:
                 # the stage's forward compute window (dispatch through
                 # materialization) — /telemetry's critical-path input
@@ -455,6 +421,7 @@ class StageRuntime:
             with self._lock:
                 t0 = time.perf_counter() if tr is not None else 0.0
                 self._check_seq("hop_bwd", seq, client_id)
+                self._check_batch_rows(int(np.shape(g_out)[0]))
                 rec = self._recs.get((int(client_id), int(step)))
                 if rec is None or int(mb) not in rec["xs"]:
                     raise ProtocolError(
@@ -473,7 +440,11 @@ class StageRuntime:
                 self._maybe_queue_apply(rec, "gs", client_id, step)
                 self._last_seq[(client_id, "hop_bwd")] = seq
                 self._hops["hop_bwd"] += 1
-            g_host = g_in if device else np.asarray(g_in)  # off the lock
+            if device:  # off the lock
+                g_host = g_in
+            else:
+                with obs_dispatch.expected_d2h(self._dd):
+                    g_host = self._host_gather(g_in)
             if tr is not None:
                 rw = time.perf_counter() - t0
                 tr.record(spans.REPLY_GRAD, t0, rw,
@@ -524,6 +495,7 @@ class StageRuntime:
             with self._lock:
                 t0 = time.perf_counter() if tr is not None else 0.0
                 self._check_seq("hop_loss", seq, client_id)
+                self._check_batch_rows(int(np.shape(x)[0]))
                 rec = self._rec_for(client_id, step)
                 x_dev = self._to_dev(x)
                 y_dev = self._to_dev(labels)
@@ -540,8 +512,13 @@ class StageRuntime:
                 self._maybe_queue_apply(rec, "ys", client_id, step)
                 self._last_seq[(client_id, "hop_loss")] = seq
                 self._hops["hop_loss"] += 1
-            g_host = g_x if device else np.asarray(g_x)  # off the lock
-            loss_f = loss if device else float(loss)
+            if device:  # off the lock
+                g_host, loss_f = g_x, loss
+            else:
+                # the loss edge: the chain's one sanctioned host exit
+                with obs_dispatch.expected_d2h(self._dd):
+                    g_host = self._host_gather(g_x)
+                    loss_f = float(loss)
             if tr is not None:
                 rw = time.perf_counter() - t0
                 tr.record(spans.REPLY_GRAD, t0, rw,
@@ -571,78 +548,27 @@ class StageRuntime:
     def predict(self, x: np.ndarray, client_id: int = 0) -> np.ndarray:
         """Forward-only, no residual, no handshake — but behind the
         flush barrier: a read of the stage's params must see every
-        update whose reply already shipped."""
+        update whose reply already shipped. On a sharded stage the
+        batch pads up to the ``data`` axis (forward-only, so padding is
+        exact) and only the real rows gather back."""
         with self._lock:
             self._deferred.flush()
-            y = self._fwd(self.state.params, self._to_dev(x))
-        return np.asarray(y)
+            xj = jnp.asarray(x)
+            n = int(xj.shape[0])
+            pad = (-n) % self._mesh_data
+            if pad:
+                xj = jnp.concatenate(
+                    [xj, jnp.zeros((pad,) + tuple(xj.shape[1:]),
+                                   xj.dtype)])
+            y = self._fwd(self.state.params, self._to_dev(xj))
+        with obs_dispatch.expected_d2h(self._dd):
+            return self._host_gather(y, rows=n)
 
-    # -- barriers / durability (the ServerRuntime surface) -------------- #
-    def flush_deferred(self) -> int:
-        return self._deferred.flush()
-
-    def export_state(self) -> TrainState:
-        with self._lock:
-            self._deferred.flush()
-            return self.state
-
-    def export_runtime_extras(self, step: int) -> Dict[str, Any]:
-        """Checksummed sidecar: replay cache (post-restart duplicates
-        served bit-identically) under the same lock-held flush as the
-        state snapshot (SLT112 flush-before-save)."""
-        from split_learning_tpu.runtime import checkpoint as _ckpt
-        with self._lock:
-            self._deferred.flush()
-            self._ckpt_lineage += 1
-            payload = _ckpt.build_extras(
-                step, self._ckpt_lineage,
-                replay=(self.replay.export_state()
-                        if self.replay is not None else None),
-                # clapping mode exports [] -> omitted: chain-stage
-                # handoff carries no EF ledger (PR 18 pin)
-                wire_ef=(self.wire_ef.export_state() or None))
-        fl = obs_flight.get_recorder()
-        if fl is not None:
-            fl.record(spans.FL_CKPT_CAPTURE, step=int(step),
-                      party=self.party, lineage=payload["lineage"])
-        return payload
-
-    def resume_from(self, state: TrainState, step: int,
-                    extras: Optional[Dict[str, Any]] = None) -> None:
-        """Adopt a restored TrainState; next hop must be step >= `step`.
-        Pending deferred applies are DROPPED (pre-restore lineage), the
-        replay cache restores from a valid matching sidecar or clears."""
-        from split_learning_tpu.runtime import checkpoint as _ckpt
-        use_extras = (extras is not None and _ckpt.extras_valid(extras)
-                      and extras["step"] == int(step))
-        with self._lock:
-            self._deferred.clear()
-            if self._mesh is not None:
-                state = jax.device_put(state, self._state_sharding)
-            self.state = state
-            self._recs.clear()
-            self._last_seq = {}
-            self._seq_floor = int(step) * MB_STRIDE - 1
-            if self.replay is not None:
-                if use_extras and "replay" in extras:
-                    self.replay.restore_state(
-                        _ckpt.decode_obj(extras["replay"]))
-                else:
-                    self.replay.clear()
-            if use_extras and "wire_ef" in extras:
-                self.wire_ef.restore_state(
-                    _ckpt.decode_obj(extras["wire_ef"]))
-            else:
-                # residuals predate the restored params — start clean
-                self.wire_ef.reset()
-            if use_extras:
-                self._ckpt_lineage = max(self._ckpt_lineage,
-                                         int(extras["lineage"]))
-        fl = obs_flight.get_recorder()
-        if fl is not None:
-            fl.record(spans.FL_CKPT_LINEAGE, step=int(step),
-                      party=self.party, use_extras=use_extras,
-                      lineage=self._ckpt_lineage)
+    # -- PartyRuntime hooks --------------------------------------------- #
+    def _reset_protocol_state(self, step: int) -> None:
+        self._recs.clear()
+        self._last_seq = {}
+        self._seq_floor = int(step) * MB_STRIDE - 1
 
     # ------------------------------------------------------------------ #
     def counters(self) -> Dict[str, Any]:
@@ -657,7 +583,10 @@ class StageRuntime:
     def health(self) -> Dict[str, Any]:
         from split_learning_tpu.version import __version__
         uptime = time.monotonic() - self._t_start
-        return {
+        with self._lock:
+            seq = max(self._last_seq.values(), default=-1)
+            seq = max(seq, self._seq_floor)
+        info = {
             "status": "ok",
             "role": "stage",
             "stage_index": self.stage_index,
@@ -665,11 +594,19 @@ class StageRuntime:
             "is_last": self.is_last,
             "microbatches": self.microbatches,
             "apply_lag": self.apply_lag,
+            # the highest step any hop of which this stage has
+            # acknowledged (or re-armed to via resume_from) — the same
+            # contract ServerRuntime.health() exposes, which is what
+            # lets ReplicaGroup fail a sharded stage over mid-run
+            "step": max(seq // MB_STRIDE, -1),
             "uptime_s": uptime,  # legacy spelling, pre-PR-17 callers
             "uptime_seconds": uptime,
             "version": __version__,
             "counters": self.counters(),
         }
+        if self._mesh is not None:
+            info["mesh"] = mesh_axes(self._mesh)
+        return info
 
     def metrics(self) -> Dict[str, Any]:
         """In-process equivalent of ``GET /metrics`` — the same
@@ -688,57 +625,6 @@ class StageRuntime:
                 snap["gauges"][k] = float(v)
             else:
                 snap["counters"][f"{k}_total"] = float(v)
-        snap["gauges"]["uptime_seconds"] = float(
-            time.monotonic() - self._t_start)
         snap["gauges"]["stage_index"] = float(self.stage_index)
-        if self._admission is not None:
-            for k, v in self._admission.counters().items():
-                snap["counters"][k] = float(v)
-            snap["gauges"].update(self._admission.gauges())
-        if self._dd is not None:
-            snap["gauges"].update(self._dd.gauges())
+        self._fold_shared_metrics(snap)
         return snap
-
-    def note_wire_compression(self, raw_bytes: int, wire_bytes: int) -> None:
-        """Fold one compressed hop exchange (logical fp32 bytes vs bytes
-        on the wire, both directions) into the metrics Registry:
-        cumulative byte counters plus the ``wire_compression_ratio``
-        gauge — same contract as ServerRuntime, so /metrics
-        distinguishes hop wires (stage-labeled via ``stage_index``)
-        from the 2-party cut wire."""
-        raw_i, wire_i = int(raw_bytes), int(wire_bytes)
-        raw_f, wire_f = float(raw_i), float(wire_i)
-        with self._lock:
-            self._wire_totals[0] += raw_i
-            self._wire_totals[1] += wire_i
-            self._metrics.incr("wire_raw_bytes", raw_f)
-            self._metrics.incr("wire_bytes", wire_f)
-            if self._wire_totals[1] > 0:
-                self._metrics.set_gauge(
-                    "wire_compression_ratio",
-                    self._wire_totals[0] / self._wire_totals[1])
-
-    # -- wire-server replay hooks (transport/http.py) ------------------- #
-    def replay_lookup(self, client_id: int, op: str,
-                      seq: int) -> Tuple[Optional[bytes], Optional[Any]]:
-        """Cached reply for a duplicate hop delivery, keyed by the
-        composite ``hop_seq(step, mb)`` ordinal (the wire server passes
-        the composite, never the bare step)."""
-        if self.replay is None:
-            return None, None
-        return self.replay.lookup(client_id, op, seq)
-
-    def attach_reply_body(self, client_id: int, op: str, seq: int,
-                          body: bytes) -> None:
-        """Pin the encoded wire reply so a replay ships the original
-        frame byte-for-byte."""
-        if self.replay is not None:
-            self.replay.attach_body(client_id, op, seq, body)
-
-    def close(self) -> None:
-        """Drain, never drop: replies for queued steps already shipped,
-        so a clean shutdown must land their updates (SLT108)."""
-        fl = obs_flight.get_recorder()
-        if fl is not None:
-            fl.record(spans.FL_CLOSE, party=self.party)
-        self._deferred.flush()

@@ -72,6 +72,12 @@ class DeviceTransport(Transport):
         self._num_stages = int(server.plan.num_stages) \
             if hasattr(server, "plan") else 0
         self._mesh = mesh
+        # a sharded peer (per-stage pjit, ISSUE 20) replies mesh-sharded
+        # jax.Arrays: the hop wire reshards them D2D (device_put), and
+        # stage-1 replies must land on the hub's device even without a
+        # pipe mesh — read the peer's mesh once here. ReplicaGroup
+        # exposes its primary's mesh under the same name.
+        self._stage_mesh = getattr(server, "_mesh", None)
         if mesh is not None:
             from split_learning_tpu.parallel.mesh import PIPE_AXIS
             if PIPE_AXIS not in mesh.axis_names:
@@ -86,7 +92,8 @@ class DeviceTransport(Transport):
         # device_put here so the hub's jits keep one stable placement —
         # D2D only, never through host
         self._hub_dev = (mesh.devices.flat[0] if mesh is not None
-                         else None)
+                         else (jax.devices()[0]
+                               if self._stage_mesh is not None else None))
         # one jitted shuttle per (src, dst, shape, dtype) — cached so
         # steady state never recompiles (the watchdog step_scope below
         # pins that)
@@ -133,9 +140,11 @@ class DeviceTransport(Transport):
         wire's cotangents) move to the hub's rank-0 device: without
         this the mesh-sharded reply would re-lay the hub's params after
         the first apply and retrace every hub program at step 2. Pure
-        D2D — device_put across devices is the sanctioned move."""
-        if self._mesh is not None and self.stage_index == 1 \
-                and isinstance(g, jax.Array):
+        D2D — device_put across devices is the sanctioned move. A
+        sharded stage 1 (its own pjit mesh) needs the same gather-to-hub
+        even without a pipe mesh: its reply spans the stage's devices."""
+        if (self._mesh is not None or self._stage_mesh is not None) \
+                and self.stage_index == 1 and isinstance(g, jax.Array):
             return jax.device_put(g, self._hub_dev)
         return g
 

@@ -761,3 +761,38 @@ def test_bench_mpmd_compressed_role_quick():
     assert r["topk8_extras_carry_ledger"] is True
     assert r["steady_state_recompiles"] == 0
     assert r["valid"] is True, r["invalid_reason"]
+
+
+def test_bench_composed_topology_role_quick():
+    """The composed_topology leg's contract fields (composable party
+    runtime): a 3-stage chain whose middle stage runs a data=2 pjit
+    mesh vs the flat twin at the same per-device rows-per-microbatch
+    ceiling, plus a replicated (N=2) x sharded x 3-stage run with a
+    mid-run replica kill. Gates carried by the leg itself: mesh=1
+    bit-identity, data=2 float parity, a strict throughput win for the
+    sharded chain, zero dropped steps with >= 1 handoff across the
+    kill, zero steady-state recompiles, and the stage_report mesh
+    column reporting the sharded axis (MFU honestly None on CPU)."""
+    sys.path.insert(0, REPO)
+    from bench import measure_composed_topology
+
+    r = measure_composed_topology(quick=True)
+    assert r["leg"] == "composed_topology"
+    assert r["valid"] is True, r["invalid_reason"]
+    assert r["stages"] == 3
+    assert r["batch_ceiling_relative"] is True
+    assert "ceiling" in r["note"]  # the honesty caveat ships with the leg
+    assert r["mesh"]["devices"] == 2 and r["mesh"]["data"] == 2
+    # same 16-row step either way: data=2 admits double-size microbatches
+    assert r["microbatches"]["data1"] == 2 * r["microbatches"]["data2"]
+    assert r["steps_per_sec_data2"] > r["steps_per_sec_data1"] > 0
+    assert r["speedup_data2_vs_data1"] > 1.0
+    assert r["loss_mesh1_max_abs_diff"] == 0.0
+    assert r["loss_data2_max_abs_diff"] <= r["parity_tol"]
+    assert (r["replicated_steps_completed"]
+            == r["replicated_steps_expected"])
+    assert r["replica_handoffs"] >= 1
+    assert r["compile_count"]["steady_state"] == 0
+    rep = {row["stage"]: row for row in r["stage_report_data2"]}
+    assert rep[1]["mesh"]["data"] == 2 and rep[1]["mfu"] is None
+    assert rep[2]["mesh"]["data"] == 1

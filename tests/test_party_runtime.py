@@ -1,0 +1,286 @@
+"""Composable party runtime (ISSUE 20): ServerRuntime and StageRuntime
+are thin configurations of one ``runtime/party.py`` core — the jitted
+program table, replay + exactly-once claims, the 2BP deferred queue,
+extras export/restore, and the flight/metrics surfaces all live there
+once.
+
+Pins, in order: the collapse (``mesh=None`` / size-1 mesh / one
+replica) is BIT-identical on every legacy path — the fused serialized
+2-party server, coalesced groups, 2BP lag 0/2, the U-split server, the
+M=1 chain, and a 1-replica group; a ``data=2`` sharded middle stage
+reproduces the flat 3-stage chain to float tolerance; a replicated
+(N=2) x sharded x 3-stage topology keeps loss parity with the flat run
+across a mid-run replica kill (and drops zero steps when the SERVING
+replica is the victim); and a sharded stage's checkpoint round-trips
+onto a successor with a DIFFERENT mesh — the restore re-scatters the
+captured tree onto the new party's layout. Runs on the forced 8-device
+CPU host topology from conftest.py."""
+
+import jax
+import numpy as np
+import pytest
+
+from split_learning_tpu.models import get_plan
+from split_learning_tpu.parallel.mesh import make_host_mesh
+from split_learning_tpu.runtime import (ServerRuntime,
+                                        SplitClientTrainer,
+                                        USplitClientTrainer)
+from split_learning_tpu.runtime.party import PartyRuntime
+from split_learning_tpu.runtime.pipeline_runner import PipelineRunner
+from split_learning_tpu.runtime.replica import ReplicaGroup, maybe_replicate
+from split_learning_tpu.runtime.stage import StageRuntime
+from split_learning_tpu.transport.local import LocalTransport
+from split_learning_tpu.utils import Config
+
+BATCH = 8
+SEED = 2
+M = 2
+PARITY = dict(rtol=1e-4, atol=5e-4)
+
+
+# ---------------------------------------------------------------------- #
+# the core is shared, the public names stay
+# ---------------------------------------------------------------------- #
+
+def test_both_runtimes_are_party_core_configurations():
+    """ServerRuntime and StageRuntime subclass the one PartyRuntime
+    core, and the exception type is ONE class however it is imported —
+    transports catch ``server.ProtocolError`` against stage parties."""
+    from split_learning_tpu.runtime import party, server, stage
+    assert issubclass(ServerRuntime, PartyRuntime)
+    assert issubclass(StageRuntime, PartyRuntime)
+    assert server.ProtocolError is party.ProtocolError
+    assert stage.ProtocolError is party.ProtocolError
+
+
+# ---------------------------------------------------------------------- #
+# 2-party server collapse: size-1 mesh == legacy, bit for bit
+# ---------------------------------------------------------------------- #
+
+def _batch(seed, batch=BATCH):
+    rs = np.random.RandomState(seed)
+    return (rs.randn(batch, 28, 28, 1).astype(np.float32),
+            rs.randint(0, 10, batch).astype(np.int64))
+
+
+def _server_series(steps=4, mesh=None, **kw):
+    cfg = Config(mode="split", batch_size=BATCH, num_clients=2)
+    plan = get_plan(mode="split")
+    sample = np.zeros((BATCH, 28, 28, 1), np.float32)
+    server = ServerRuntime(plan, cfg, jax.random.PRNGKey(SEED), sample,
+                           mesh=mesh, **kw)
+    client = SplitClientTrainer(plan, cfg, jax.random.PRNGKey(0),
+                                LocalTransport(server))
+    try:
+        return [client.train_step(*_batch(i), i) for i in range(steps)]
+    finally:
+        server.close()
+
+
+@pytest.mark.parametrize("kw", [
+    {},                                              # fused serialized
+    {"coalesce_max": 4, "coalesce_window_ms": 5.0},  # coalesced groups
+    {"decouple_bwd": True, "apply_lag": 0},          # 2BP, lag 0
+    {"decouple_bwd": True, "apply_lag": 2},          # 2BP, lag 2
+], ids=["fused", "coalesced", "2bp_lag0", "2bp_lag2"])
+def test_mesh1_collapse_bit_identical_server_paths(kw):
+    legacy = _server_series(**kw)
+    m1 = _server_series(mesh=make_host_mesh(data=1), **kw)
+    assert legacy == m1
+
+
+def test_mesh1_collapse_bit_identical_u_split():
+    """The U-shaped trunk server through the party core: a size-1 mesh
+    normalizes away and the u_forward/u_backward trajectory is the
+    legacy one exactly."""
+    def series(mesh):
+        cfg = Config(mode="u_split", batch_size=BATCH)
+        plan = get_plan(mode="u_split")
+        sample = np.zeros((BATCH, 28, 28, 1), np.float32)
+        server = ServerRuntime(plan, cfg, jax.random.PRNGKey(SEED),
+                               sample, mesh=mesh)
+        client = USplitClientTrainer(plan, cfg, jax.random.PRNGKey(0),
+                                     LocalTransport(server))
+        try:
+            return [client.train_step(*_batch(i), i) for i in range(4)]
+        finally:
+            server.close()
+
+    assert series(None) == series(make_host_mesh(data=1))
+
+
+# ---------------------------------------------------------------------- #
+# K-stage chain: collapse, sharded parity, replicated composition
+# ---------------------------------------------------------------------- #
+
+def _chain(mesh_mid=None, microbatches=M, replicas=1, mesh_last=None):
+    cfg = Config(mode="split", model="split_cnn_chain3",
+                 batch_size=BATCH, num_stages=3,
+                 microbatches=microbatches, seed=SEED)
+    plan = get_plan(model="split_cnn_chain3", mode="split")
+    sample = np.zeros((BATCH, 28, 28, 1), np.float32)
+
+    def factory(i, mesh):
+        def make(_ridx=0):
+            return StageRuntime(plan, i, cfg, jax.random.PRNGKey(SEED),
+                                sample, microbatches=microbatches,
+                                mesh=mesh)
+        return make
+
+    parties = [maybe_replicate(factory(1, mesh_mid), replicas),
+               maybe_replicate(factory(2, mesh_last), replicas)]
+    runner = PipelineRunner(plan, cfg, jax.random.PRNGKey(SEED), sample,
+                            [LocalTransport(p) for p in parties],
+                            microbatches=microbatches)
+    return runner, parties
+
+
+def _chain_series(steps=4, kill=None, **kw):
+    """Loss series of a 3-stage chain; ``kill=(step, pick)`` kills one
+    middle-stage replica before that step — ``pick`` maps the driver's
+    assigned replica index to the victim."""
+    runner, parties = _chain(**kw)
+    try:
+        losses = []
+        for s in range(steps):
+            if kill is not None and s == kill[0]:
+                parties[0].kill(kill[1](parties[0].assignment(0)))
+            losses.append(runner.step(*_batch(s), step=s))
+        return losses, parties
+    finally:
+        runner.close()
+        for p in parties:
+            p.close()
+
+
+def test_m1_chain_mesh1_bit_identical():
+    """The serialized M=1 chain through per-stage size-1 meshes is the
+    legacy chain bit for bit — on BOTH stage parties."""
+    legacy, _ = _chain_series(microbatches=1)
+    m1, parties = _chain_series(microbatches=1,
+                                mesh_mid=make_host_mesh(data=1),
+                                mesh_last=make_host_mesh(data=1))
+    assert legacy == m1
+
+
+def test_replicas1_collapse_bit_identical():
+    """``maybe_replicate(f, 1)`` is the bare runtime (no router on the
+    step path) and an explicit 1-replica group still reproduces the
+    bare chain exactly — the routing layer adds no math."""
+    assert isinstance(maybe_replicate(
+        lambda i: object(), 1), object().__class__)
+    legacy, _ = _chain_series()
+    runner, parties = _chain(mesh_mid=None)
+    for i, p in enumerate(parties):
+        assert isinstance(p, StageRuntime)  # n=1 never builds a group
+    try:
+        grouped = [ReplicaGroup([p]) for p in parties]
+        runner2 = PipelineRunner(
+            get_plan(model="split_cnn_chain3", mode="split"),
+            Config(mode="split", model="split_cnn_chain3",
+                   batch_size=BATCH, num_stages=3, microbatches=M,
+                   seed=SEED),
+            jax.random.PRNGKey(SEED),
+            np.zeros((BATCH, 28, 28, 1), np.float32),
+            [LocalTransport(g) for g in grouped], microbatches=M)
+        try:
+            got = [runner2.step(*_batch(s), step=s) for s in range(4)]
+        finally:
+            runner2.close()
+        assert got == legacy
+    finally:
+        runner.close()
+        for p in parties:
+            p.close()
+
+
+def test_data2_middle_stage_float_parity():
+    """Per-stage pjit: a data=2 sharded middle stage reproduces the
+    flat chain's trajectory to float tolerance (same math, different
+    reduction shapes), and reports its mesh through stage_report."""
+    flat, _ = _chain_series()
+    runner, parties = _chain(mesh_mid=make_host_mesh(data=2))
+    try:
+        sharded = [runner.step(*_batch(s), step=s) for s in range(4)]
+        report = runner.stage_report()
+    finally:
+        runner.close()
+        for p in parties:
+            p.close()
+    np.testing.assert_allclose(sharded, flat, **PARITY)
+    assert report[0]["mesh"]["data"] == 2
+    assert report[1]["mesh"]["data"] == 1
+
+
+def test_replicated_sharded_chain_parity_across_idle_kill():
+    """Replicated (N=2) x sharded (data=2) x 3-stage: killing the
+    middle stage's IDLE replica mid-run exercises the full handoff
+    (fence, capture, migrate) without touching the serving trajectory —
+    the loss series stays in float parity with the flat chain end to
+    end."""
+    flat, _ = _chain_series(steps=8)
+    repl, parties = _chain_series(
+        steps=8, mesh_mid=make_host_mesh(data=2), replicas=2,
+        kill=(4, lambda serving: 1 - serving))
+    np.testing.assert_allclose(repl, flat, **PARITY)
+    assert parties[0].counters()["replica_handoffs"] == 1
+
+
+def test_replicated_sharded_chain_zero_drop_on_serving_kill():
+    """Killing the SERVING replica of the sharded middle stage mid-run:
+    the successor adopts the migrated claims and every step completes
+    finite — zero drops across the handoff."""
+    repl, parties = _chain_series(
+        steps=8, mesh_mid=make_host_mesh(data=2), replicas=2,
+        kill=(4, lambda serving: serving))
+    assert len(repl) == 8
+    assert np.all(np.isfinite(repl))
+    assert parties[0].counters()["replica_handoffs"] == 1
+    assert parties[0].health()["step"] == 7
+
+
+# ---------------------------------------------------------------------- #
+# sharded-stage checkpoint round trip: restore reshards onto a new mesh
+# ---------------------------------------------------------------------- #
+
+def test_sharded_stage_checkpoint_roundtrip_reshards():
+    """Capture a data=2 middle stage at step 4, restore it into a chain
+    whose middle stage is FLAT (and the flat capture into a data=2
+    successor): both resumes re-scatter the tree onto the new party's
+    layout and continue the reference trajectory to float tolerance."""
+    # reference: uninterrupted sharded run
+    ref, _ = _chain_series(steps=8, mesh_mid=make_host_mesh(data=2))
+
+    def resume_run(capture_mesh, resume_mesh, want_devices):
+        runner, parties = _chain(mesh_mid=capture_mesh)
+        try:
+            for s in range(4):
+                runner.step(*_batch(s), step=s)
+            states = [p.export_state() for p in parties]
+            extras = [p.export_runtime_extras(4) for p in parties]
+            client_state = runner.state
+        finally:
+            runner.close()
+            for p in parties:
+                p.close()
+        runner2, parties2 = _chain(mesh_mid=resume_mesh)
+        try:
+            runner2.state = client_state
+            runner2.steps_done = 4
+            for p, st, ex in zip(parties2, states, extras):
+                p.resume_from(st, 4, extras=ex)
+            leaf = jax.tree_util.tree_leaves(parties2[0].state.params)[0]
+            assert len(leaf.sharding.device_set) == want_devices
+            return [runner2.step(*_batch(s), step=s)
+                    for s in range(4, 8)]
+        finally:
+            runner2.close()
+            for p in parties2:
+                p.close()
+
+    # sharded capture -> flat successor (gather onto one device)
+    onto_flat = resume_run(make_host_mesh(data=2), None, 1)
+    np.testing.assert_allclose(onto_flat, ref[4:], **PARITY)
+    # flat capture -> sharded successor (H2D re-scatter onto the mesh)
+    onto_sharded = resume_run(None, make_host_mesh(data=2), 2)
+    np.testing.assert_allclose(onto_sharded, ref[4:], **PARITY)
